@@ -1,0 +1,50 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+2018-era PaddlePaddle (Fluid + v2), re-designed for JAX/XLA/Pallas/pjit.
+
+Public API mirrors ``python/paddle/fluid/__init__.py`` of the reference:
+Program/Block IR built by a layers DSL, IR-level autodiff and graph-op
+optimizers, an Executor that compiles whole blocks to single XLA
+computations, and mesh-sharded data/model parallelism in place of
+NCCL/pserver distribution.
+"""
+
+from paddle_tpu import framework
+from paddle_tpu.framework import (
+    Program, Block, Operator, Variable, Parameter,
+    default_main_program, default_startup_program, program_guard,
+    switch_main_program, switch_startup_program, unique_name,
+)
+from paddle_tpu.place import CPUPlace, TPUPlace, CUDAPlace, is_tpu_available
+from paddle_tpu.scope import Scope, global_scope, scope_guard
+from paddle_tpu import ops  # registers all op lowerings
+from paddle_tpu.executor import Executor, fetch_var
+from paddle_tpu.backward import append_backward, calc_gradient
+from paddle_tpu import initializer
+from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
+from paddle_tpu import layers
+from paddle_tpu import nets
+from paddle_tpu import optimizer
+from paddle_tpu.optimizer import (
+    SGD, Momentum, Adagrad, Adam, Adamax, DecayedAdagrad, Adadelta, RMSProp,
+    Ftrl, SGDOptimizer, MomentumOptimizer, AdagradOptimizer, AdamOptimizer,
+    AdamaxOptimizer, DecayedAdagradOptimizer, AdadeltaOptimizer,
+    RMSPropOptimizer, FtrlOptimizer, ModelAverage,
+)
+from paddle_tpu import regularizer
+from paddle_tpu import clip
+from paddle_tpu import metrics
+from paddle_tpu import profiler
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu import io
+from paddle_tpu.io import (
+    save_vars, save_params, save_persistables, load_vars, load_params,
+    load_persistables, save_inference_model, load_inference_model,
+)
+from paddle_tpu.parallel import ParallelExecutor
+from paddle_tpu import parallel
+from paddle_tpu import reader
+from paddle_tpu import dataset
+
+__version__ = "0.1.0"
+
+Tensor = Variable  # convenience alias

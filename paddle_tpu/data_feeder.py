@@ -1,0 +1,101 @@
+"""DataFeeder: python lists/numpy -> feed dict with LoD handling
+(reference ``python/paddle/fluid/data_feeder.py:69``:
+``DataToLoDTensorConverter:25``).
+
+Ragged (lod_level>0) slots are converted to (flattened_values,
+recursive_sequence_lengths) pairs; the executor stores the row-splits next
+to the array (see ``paddle_tpu.lod``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.framework import Variable
+
+__all__ = ["DataFeeder"]
+
+
+class DataToLoDTensorConverter:
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = shape
+        self.dtype = np.dtype(dtype) if dtype != "bfloat16" else dtype
+        self.data = []
+        self.lod = [[] for _ in range(lod_level)]
+
+    def feed(self, data):
+        self._feed_impl_(data, self.lod, self.lod_level)
+
+    def _feed_impl_(self, data, lod, lod_level):
+        if lod_level == 0:
+            self.data.append(data)
+        else:
+            lod[0].append(len(data))
+            for each_data in data:
+                self._feed_impl_(each_data, lod[1:], lod_level - 1)
+
+    def done(self):
+        if self.lod_level == 0:
+            arr = np.array(self.data, dtype=self.dtype)
+            if self.shape:
+                want = [d for d in self.shape]
+                # allow flattened rows: reshape to declared shape w/ -1 batch
+                try:
+                    arr = arr.reshape([-1] + [d for d in want[1:]])
+                except ValueError:
+                    pass
+            return arr
+        flat = []
+
+        def _flatten(x):
+            if isinstance(x, (list, tuple)):
+                for e in x:
+                    _flatten(e)
+            else:
+                flat.append(x)
+
+        _flatten(self.data)
+        arr = np.array(flat, dtype=self.dtype)
+        inner = [d for d in self.shape if d != -1]
+        if inner:
+            arr = arr.reshape([-1] + inner)
+        return (arr, self.lod)
+
+
+class DataFeeder:
+    """reference ``data_feeder.py:69``."""
+
+    def __init__(self, feed_list, place, program=None):
+        from paddle_tpu.framework import default_main_program
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        program = program or default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("feed_list should contain Variables or names")
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(each_var.shape)
+            self.feed_dtypes.append(each_var.dtype)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = [
+            DataToLoDTensorConverter(self.place, lod_level=lod, shape=shape,
+                                     dtype=dtype)
+            for lod, shape, dtype in zip(self.feed_lod_level,
+                                         self.feed_shapes, self.feed_dtypes)]
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), \
+                "sample arity != feed arity"
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        return {name: conv.done()
+                for name, conv in zip(self.feed_names, converters)}

@@ -1,0 +1,29 @@
+"""Datasets (reference ``python/paddle/dataset/``: mnist, cifar, imdb,
+imikolov, movielens, conll05, uci_housing, wmt14/16, flowers, voc2012,
+mq2007, sentiment — each downloads + caches + yields samples).
+
+This environment has zero network egress, so each module first looks for a
+local cache under ``$PADDLE_TPU_DATA_HOME`` (default ``~/.cache/paddle_tpu``)
+in the reference's format and otherwise falls back to a deterministic
+synthetic generator with the same sample shapes/vocab sizes, so models and
+tests exercise identical code paths.
+"""
+
+from paddle_tpu.dataset import common
+from paddle_tpu.dataset import mnist
+from paddle_tpu.dataset import cifar
+from paddle_tpu.dataset import uci_housing
+from paddle_tpu.dataset import imdb
+from paddle_tpu.dataset import imikolov
+from paddle_tpu.dataset import movielens
+from paddle_tpu.dataset import conll05
+from paddle_tpu.dataset import wmt14
+from paddle_tpu.dataset import wmt16
+from paddle_tpu.dataset import flowers
+from paddle_tpu.dataset import sentiment
+from paddle_tpu.dataset import mq2007
+from paddle_tpu.dataset import voc2012
+
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "movielens", "conll05", "wmt14", "wmt16", "flowers", "sentiment",
+           "mq2007", "voc2012"]

@@ -1,0 +1,54 @@
+"""PTB-style n-gram LM data (reference ``python/paddle/dataset/imikolov.py``
+builds n-grams for word2vec).  Synthetic fallback: Markov-chain text."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+__all__ = ["train", "test", "build_dict"]
+
+N_WORDS = 2073  # reference vocab ~2073 after min-freq cut
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(N_WORDS)}
+
+
+def _synthetic_sentences(split, n_sent):
+    rng = common.synthetic_rng("imikolov", split)
+    # sparse Markov transitions give learnable structure
+    next_words = rng.randint(0, N_WORDS, size=(N_WORDS, 4))
+    for _ in range(n_sent):
+        length = int(rng.randint(6, 25))
+        w = int(rng.randint(0, N_WORDS))
+        sent = [w]
+        for _ in range(length - 1):
+            w = int(next_words[w, rng.randint(0, 4)])
+            sent.append(w)
+        yield sent
+
+
+def train(word_idx=None, n=5, data_type=1):
+    def reader():
+        for sent in _synthetic_sentences("train", 2000):
+            if len(sent) >= n:
+                sent_arr = np.asarray(sent)
+                for i in range(n - 1, len(sent)):
+                    yield tuple(sent_arr[i - n + 1:i + 1])
+    return reader
+
+
+def test(word_idx=None, n=5, data_type=1):
+    def reader():
+        for sent in _synthetic_sentences("test", 400):
+            if len(sent) >= n:
+                sent_arr = np.asarray(sent)
+                for i in range(n - 1, len(sent)):
+                    yield tuple(sent_arr[i - n + 1:i + 1])
+    return reader
+
+
+def fetch():
+    pass

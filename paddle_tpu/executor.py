@@ -1,0 +1,273 @@
+"""Executor: lowers a Program block to ONE compiled XLA computation.
+
+This replaces the reference's per-op interpreter hot loop
+(``paddle/fluid/framework/executor.cc:334-352`` — CreateOp / InferShape /
+kernel dispatch per op per step) with trace-once/compile-once semantics:
+
+  1. Partition block variables into feeds, read-only state, in-out state
+     (persistables written by ops, e.g. parameters under SGD), and scratch.
+  2. Trace every op's registered lowering into a single jaxpr.
+  3. ``jax.jit`` the whole step with in-out state donated, cache by
+     (program version, feed shapes/dtypes, fetch names).
+
+Each subsequent ``run`` with the same signature is one XLA executable
+launch — no Python per-op work at all.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.framework import Program, default_main_program
+from paddle_tpu.place import CPUPlace, TPUPlace
+from paddle_tpu.scope import Scope, global_scope
+from paddle_tpu.ops import registry
+
+__all__ = ["Executor", "fetch_var"]
+
+logger = logging.getLogger(__name__)
+
+# op types that exist for API parity but are no-ops inside a lowered block
+_SKIP_OPS = frozenset({"feed", "fetch"})
+
+
+def _as_device_array(value, dtype=None, device=None):
+    if isinstance(value, (int, float, bool)):
+        value = np.asarray(value, dtype=dtype or None)
+    if isinstance(value, np.ndarray) and dtype is not None:
+        want = jnp.dtype(dtype) if dtype != "bfloat16" else jnp.bfloat16
+        if value.dtype != want and dtype not in (None,):
+            value = value.astype(want)
+    arr = jnp.asarray(value)
+    if device is not None:
+        arr = jax.device_put(arr, device)
+    return arr
+
+
+class _CompiledBlock:
+    """A traced+jitted block for one feed/fetch signature."""
+
+    def __init__(self, fn, feed_names, ro_names, inout_names, fetch_names,
+                 uses_rng):
+        self.fn = fn
+        self.feed_names = feed_names
+        self.ro_names = ro_names
+        self.inout_names = inout_names
+        self.fetch_names = fetch_names
+        self.uses_rng = uses_rng
+
+
+def lower_block(block, env, rng_key, training, aux):
+    """Trace all ops of ``block`` into ``env`` (used for the main block and,
+    recursively, by control-flow op lowerings for sub-blocks)."""
+    for op in block.ops:
+        if op.type in _SKIP_OPS:
+            continue
+        opdef = registry.resolve_lowering(op.type)
+        key = None
+        if rng_key is not None:
+            aux["rng_counter"] += 1
+            key = jax.random.fold_in(rng_key, aux["rng_counter"])
+        ctx = registry.LowerContext(op, env, block, rng_key=key,
+                                    training=training, aux=aux)
+        opdef.lower(ctx)
+        env.update(ctx.outputs)
+    return env
+
+
+class Executor:
+    """Reference: ``python/paddle/fluid/executor.py:181`` +
+    ``paddle/fluid/framework/executor.cc:133``."""
+
+    def __init__(self, place=None):
+        self.place = place if place is not None else (
+            TPUPlace(0) if any(d.platform != "cpu" for d in jax.devices())
+            else CPUPlace())
+        self._cache = {}
+        self._run_counter = 0
+
+    # ------------------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        program = program if program is not None else default_main_program()
+        if not isinstance(program, Program):
+            raise TypeError("executor requires a Program")
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope if scope is not None else global_scope()
+
+        block = program.global_block()
+        fetch_names = [f.name if isinstance(f, framework.Variable) else f
+                       for f in fetch_list]
+
+        feed_arrays = {}
+        device = self.place.jax_device()
+        for name, value in feed.items():
+            var = block.var(name) if block.has_var(name) else None
+            lod = None
+            if isinstance(value, tuple) and len(value) == 2 and \
+                    isinstance(value[1], (list, tuple)):
+                value, lod = value
+            dtype = var.dtype if var is not None else None
+            feed_arrays[name] = _as_device_array(value, dtype, device)
+            if lod is not None:
+                scope.set_lod(name, lod)
+
+        compiled = self._get_compiled(program, block, feed_arrays,
+                                      tuple(fetch_names), scope)
+
+        ro_state = {n: self._state_value(scope, n, device)
+                    for n in compiled.ro_names}
+        inout_state = {n: self._state_value(scope, n, device)
+                       for n in compiled.inout_names}
+
+        self._run_counter += 1
+        key = jax.random.PRNGKey(
+            (program.random_seed or 0) * 1000003 + self._run_counter)
+
+        fetches, new_state = compiled.fn(feed_arrays, ro_state, inout_state,
+                                         key)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    # ------------------------------------------------------------------
+    def _state_value(self, scope, name, device):
+        v = scope.find_var(name)
+        if v is None:
+            raise RuntimeError(
+                f"variable {name!r} is not initialized in the scope — "
+                f"run the startup program first")
+        if isinstance(v, np.ndarray):
+            v = jnp.asarray(v)
+            scope.set_var(name, v)
+        return v
+
+    # ------------------------------------------------------------------
+    def _get_compiled(self, program, block, feed_arrays, fetch_names, scope):
+        sig = (id(program), program._version, block.idx,
+               tuple(sorted((n, str(a.dtype), a.shape)
+                            for n, a in feed_arrays.items())),
+               fetch_names)
+        if sig in self._cache:
+            self._cache[sig] = self._cache.pop(sig)  # LRU bump
+            return self._cache[sig]
+
+        feed_names = tuple(sorted(feed_arrays))
+
+        # classify non-feed external inputs (state) and written persistables
+        produced = set(feed_names)
+        reads = []
+        writes = []
+        for op in block.ops:
+            if op.type in _SKIP_OPS:
+                continue
+            for n in op.input_arg_names:
+                if n and n not in produced:
+                    reads.append(n)
+            for n in op.output_arg_names:
+                if n:
+                    produced.add(n)
+                    writes.append(n)
+        # also: sub-block reads of outer vars.  Conservatively include any
+        # var referenced by sub-blocks of ops in this block.
+        for op in block.ops:
+            for a in op.attrs.values():
+                if isinstance(a, framework.Block):
+                    for n in _external_reads(a, produced):
+                        reads.append(n)
+
+        state_names = []
+        seen = set()
+        for n in reads:
+            if n not in seen and n not in feed_names:
+                seen.add(n)
+                state_names.append(n)
+
+        written_state = []
+        for n in writes:
+            try:
+                var = block.var(n)
+            except KeyError:
+                continue
+            if var.persistable and n not in written_state:
+                written_state.append(n)
+        # fetched non-persistable vars that are never produced in this block
+        # (e.g. fetching a param) are state reads handled below.
+        for n in fetch_names:
+            if n not in produced and n not in state_names and \
+                    n not in feed_names:
+                state_names.append(n)
+
+        inout_names = tuple(n for n in state_names if n in written_state)
+        ro_names = tuple(n for n in state_names if n not in written_state)
+        # persistables written but never read still need write-back
+        create_state = tuple(n for n in written_state if n not in inout_names)
+
+        uses_rng = True  # cheap: always thread a key; XLA drops it if unused
+
+        training = not program._is_inference
+
+        def step(feeds, ro_state, inout_state, rng_key):
+            env = {}
+            env.update(feeds)
+            env.update(ro_state)
+            env.update(inout_state)
+            aux = {"rng_counter": 0, "scope": scope,
+                   "lower_block": lower_block}
+            lower_block(block, env, rng_key, training, aux)
+            fetches = [env[n] for n in self.fetch_missing_check(fetch_names, env)]
+            new_state = {n: env[n] for n in inout_names + create_state
+                         if n in env}
+            return fetches, new_state
+
+        fn = jax.jit(step, donate_argnums=(2,))
+        compiled = _CompiledBlock(fn, feed_names, ro_names, inout_names,
+                                  tuple(fetch_names), uses_rng)
+        if len(self._cache) >= 64:  # LRU-evict the coldest executable
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[sig] = compiled
+        return compiled
+
+    @staticmethod
+    def fetch_missing_check(fetch_names, env):
+        for n in fetch_names:
+            if n not in env:
+                raise KeyError(f"fetch target {n!r} was not produced by the "
+                               f"program and is not in the scope")
+        return fetch_names
+
+    def close(self):
+        self._cache.clear()
+
+
+def _external_reads(block, produced_outer):
+    """Names read inside ``block`` (recursively) that neither the block nor
+    the outer trace produces — they must come from scope state."""
+    produced = set(produced_outer)
+    ext = []
+    for op in block.ops:
+        for n in op.input_arg_names:
+            if n and n not in produced and not block.has_var_local(n):
+                ext.append(n)
+        for n in op.output_arg_names:
+            produced.add(n)
+        for a in op.attrs.values():
+            if isinstance(a, framework.Block):
+                ext.extend(_external_reads(a, produced))
+    return ext
+
+
+def fetch_var(name, scope=None, return_numpy=True):
+    scope = scope or global_scope()
+    v = scope.find_var(name)
+    if v is None:
+        raise KeyError(f"variable {name!r} not found in scope")
+    return np.asarray(v) if return_numpy else v

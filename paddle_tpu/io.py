@@ -1,0 +1,150 @@
+"""Model save/load + inference-model export
+(reference ``python/paddle/fluid/io.py``: ``save_vars:66``,
+``save_persistables:145``, ``load_persistables:234``,
+``save_inference_model:298``, ``load_inference_model:383``).
+
+Serialization: one ``.npz``-style file per variable (numpy format, TPU
+arrays are pulled to host) plus a JSON ``__model__`` for inference programs
+— replacing the reference's save_op tensor-proto files.  Sharded /
+multi-host checkpointing lives in ``paddle_tpu.checkpoint`` (orbax-style).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.framework import Program, Parameter, Variable, default_main_program
+from paddle_tpu.scope import global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "get_inference_program",
+]
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _var_path(dirname, name):
+    return os.path.join(dirname, name.replace("/", "%2F"))
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference ``io.py:66``."""
+    scope = global_scope()
+    if vars is None:
+        main_program = main_program or default_main_program()
+        vars = list(filter(predicate, main_program.list_vars()))
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        arrs = {}
+        for var in vars:
+            val = scope.find_var(var.name)
+            if val is None:
+                continue
+            arrs[var.name] = np.asarray(val)
+        np.savez(os.path.join(dirname, filename), **arrs)
+        return
+    for var in vars:
+        val = scope.find_var(var.name)
+        if val is None:
+            continue
+        np.save(_var_path(dirname, var.name) + ".npy", np.asarray(val))
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, None, is_parameter, filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, None, is_persistable,
+              filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference ``io.py`` load_vars."""
+    scope = global_scope()
+    if vars is None:
+        main_program = main_program or default_main_program()
+        vars = list(filter(predicate, main_program.list_vars()))
+    if filename is not None:
+        data = np.load(os.path.join(dirname, filename))
+        for var in vars:
+            if var.name in data:
+                scope.set_var(var.name, data[var.name])
+        return
+    for var in vars:
+        path = _var_path(dirname, var.name) + ".npy"
+        if os.path.exists(path):
+            scope.set_var(var.name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, is_parameter, filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, is_persistable,
+              filename)
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    pruned = main_program.prune(target_vars)
+    return pruned.inference_optimize()
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    """reference ``io.py:298``: prune to targets, record feed/fetch, save
+    params."""
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+
+    pruned = main_program.prune(target_vars)
+    inference_program = pruned.inference_optimize()
+    fetch_var_names = [v.name for v in target_vars]
+
+    model = {
+        "program": inference_program.to_dict(),
+        "feed_var_names": feeded_var_names,
+        "fetch_var_names": fetch_var_names,
+    }
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "w") as f:
+        json.dump(model, f)
+    save_persistables(executor, dirname, inference_program, params_filename)
+    return fetch_var_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """reference ``io.py:383``. Returns (program, feed_names, fetch_vars)."""
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename)) as f:
+        model = json.load(f)
+    program = Program.from_dict(model["program"])
+    program._is_inference = True
+    load_persistables(executor, dirname, program, params_filename)
+    fetch_vars = [program.global_block().var(n)
+                  for n in model["fetch_var_names"]]
+    return program, model["feed_var_names"], fetch_vars

@@ -1,0 +1,14 @@
+"""Layers DSL (reference ``python/paddle/fluid/layers/``)."""
+
+from paddle_tpu.layers import math_op_patch  # applies Variable overloading
+from paddle_tpu.layers.nn import *  # noqa: F401,F403
+from paddle_tpu.layers.tensor import *  # noqa: F401,F403
+from paddle_tpu.layers.ops import *  # noqa: F401,F403
+from paddle_tpu.layers.io import *  # noqa: F401,F403
+from paddle_tpu.layers import learning_rate_scheduler  # noqa: F401
+from paddle_tpu.layers.learning_rate_scheduler import *  # noqa: F401,F403
+
+from paddle_tpu.layers import nn  # noqa: F401
+from paddle_tpu.layers import tensor  # noqa: F401
+from paddle_tpu.layers import ops  # noqa: F401
+from paddle_tpu.layers import io  # noqa: F401

@@ -1,0 +1,19 @@
+"""Op registry + all op lowerings.
+
+Importing this package registers every op type (the analogue of the
+reference's ``USE_OP`` generated pybind stubs,
+``paddle/fluid/operators/CMakeLists.txt:6-8``).
+"""
+
+from paddle_tpu.ops import registry  # noqa: F401
+from paddle_tpu.ops import (  # noqa: F401
+    math_ops,
+    tensor_ops,
+    activation_ops,
+    nn_ops,
+    loss_ops,
+    optimizer_ops,
+    logic_ops,
+    metric_ops,
+    io_ops,
+)

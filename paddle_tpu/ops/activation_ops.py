@@ -1,0 +1,151 @@
+"""Activation family — reference ``paddle/fluid/operators/activation_op.cc``
+registers ~20 activations via functor macros; here each is a one-line
+jax.numpy lowering (XLA fuses them into adjacent matmuls/convs).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op, infer_shape_unary
+
+
+def _unary(name, fn):
+    @register_op(name, infer_shape=infer_shape_unary())
+    def lower(ctx):
+        ctx.set_output("Out", fn(ctx.input("X")))
+    lower.__name__ = name + "_lower"
+    return lower
+
+
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("logsigmoid", jax.nn.log_sigmoid)
+_unary("exp", jnp.exp)
+_unary("relu", jax.nn.relu)
+_unary("tanh", jnp.tanh)
+_unary("tanh_shrink", lambda x: x - jnp.tanh(x))
+_unary("sqrt", jnp.sqrt)
+_unary("abs", jnp.abs)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("round", jnp.round)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("log", jnp.log)
+_unary("square", jnp.square)
+_unary("softplus", jax.nn.softplus)
+_unary("softsign", lambda x: x / (1.0 + jnp.abs(x)))
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+
+
+@register_op("leaky_relu", infer_shape=infer_shape_unary())
+def leaky_relu_lower(ctx):
+    x = ctx.input("X")
+    alpha = ctx.attr("alpha", 0.02)
+    ctx.set_output("Out", jnp.where(x > 0, x, alpha * x))
+
+
+@register_op("elu", infer_shape=infer_shape_unary())
+def elu_lower(ctx):
+    x = ctx.input("X")
+    alpha = ctx.attr("alpha", 1.0)
+    ctx.set_output("Out", jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0)))
+
+
+@register_op("relu6", infer_shape=infer_shape_unary())
+def relu6_lower(ctx):
+    threshold = ctx.attr("threshold", 6.0)
+    ctx.set_output("Out", jnp.clip(ctx.input("X"), 0.0, threshold))
+
+
+@register_op("pow", infer_shape=infer_shape_unary())
+def pow_lower(ctx):
+    ctx.set_output("Out", jnp.power(ctx.input("X"), ctx.attr("factor", 1.0)))
+
+
+@register_op("stanh", infer_shape=infer_shape_unary())
+def stanh_lower(ctx):
+    x = ctx.input("X")
+    a = ctx.attr("scale_a", 2.0 / 3.0)
+    b = ctx.attr("scale_b", 1.7159)
+    ctx.set_output("Out", b * jnp.tanh(a * x))
+
+
+@register_op("brelu", infer_shape=infer_shape_unary())
+def brelu_lower(ctx):
+    ctx.set_output("Out", jnp.clip(ctx.input("X"), ctx.attr("t_min", 0.0),
+                                   ctx.attr("t_max", 24.0)))
+
+
+@register_op("soft_relu", infer_shape=infer_shape_unary())
+def soft_relu_lower(ctx):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 40.0)
+    ctx.set_output("Out", jnp.log(1.0 + jnp.exp(jnp.clip(x, -t, t))))
+
+
+@register_op("hard_sigmoid", infer_shape=infer_shape_unary())
+def hard_sigmoid_lower(ctx):
+    x = ctx.input("X")
+    slope = ctx.attr("slope", 0.2)
+    offset = ctx.attr("offset", 0.5)
+    ctx.set_output("Out", jnp.clip(slope * x + offset, 0.0, 1.0))
+
+
+@register_op("swish", infer_shape=infer_shape_unary())
+def swish_lower(ctx):
+    x = ctx.input("X")
+    beta = ctx.attr("beta", 1.0)
+    ctx.set_output("Out", x * jax.nn.sigmoid(beta * x))
+
+
+@register_op("hard_shrink", infer_shape=infer_shape_unary())
+def hard_shrink_lower(ctx):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 0.5)
+    ctx.set_output("Out", jnp.where(jnp.abs(x) > t, x, 0.0))
+
+
+@register_op("softshrink", infer_shape=infer_shape_unary())
+def softshrink_lower(ctx):
+    x = ctx.input("X")
+    lam = ctx.attr("lambda", 0.5)
+    ctx.set_output("Out", jnp.where(x > lam, x - lam,
+                                    jnp.where(x < -lam, x + lam, 0.0)))
+
+
+@register_op("thresholded_relu", infer_shape=infer_shape_unary())
+def thresholded_relu_lower(ctx):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 1.0)
+    ctx.set_output("Out", jnp.where(x > t, x, 0.0))
+
+
+@register_op("prelu", infer_shape=infer_shape_unary())
+def prelu_lower(ctx):
+    x = ctx.input("X")
+    alpha = ctx.input("Alpha")
+    mode = ctx.attr("mode", "all")
+    if mode == "all":
+        a = alpha.reshape(())
+    elif mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:  # element
+        a = alpha.reshape((1,) + x.shape[1:])
+    ctx.set_output("Out", jnp.where(x > 0, x, a * x))
+
+
+@register_op("gelu", infer_shape=infer_shape_unary())
+def gelu_lower(ctx):
+    ctx.set_output("Out", jax.nn.gelu(ctx.input("X"),
+                                      approximate=ctx.attr("approximate", True)))
+
+
+@register_op("maxout", infer_shape=None)
+def maxout_lower(ctx):
+    x = ctx.input("X")  # NCHW
+    groups = ctx.attr("groups")
+    n, c, h, w = x.shape
+    ctx.set_output("Out",
+                   x.reshape(n, c // groups, groups, h, w).max(axis=2))

@@ -1,0 +1,35 @@
+"""IO-ish ops: print (debug tensor peeking, reference ``print_op.cc``),
+feed/fetch placeholders (the executor handles feed/fetch at the block
+boundary, reference ``feed_op.cc``/``fetch_op.cc``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op, infer_shape_unary
+
+
+@register_op("feed", no_gradient=True)
+def feed_lower(ctx):  # pragma: no cover - executor skips feed ops
+    pass
+
+
+@register_op("fetch", no_gradient=True)
+def fetch_lower(ctx):  # pragma: no cover - executor skips fetch ops
+    pass
+
+
+@register_op("print", infer_shape=infer_shape_unary("In", "Out"))
+def print_lower(ctx):
+    x = ctx.input("In")
+    msg = ctx.attr("message", "")
+    phase = ctx.attr("print_phase", "BOTH")
+    if phase in ("FORWARD", "BOTH"):
+        jax.debug.print(msg + " {x}", x=x)
+    ctx.set_output("Out", x)
+
+
+@register_op("assign_from_scope", no_gradient=True)
+def assign_from_scope_lower(ctx):  # internal helper
+    pass

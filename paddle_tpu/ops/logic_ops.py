@@ -1,0 +1,64 @@
+"""Comparison / logical / increment ops (reference ``compare_op.cc``,
+``logical_op.cc``, ``increment_op.cc``, ``is_empty_op.cc``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op, infer_shape_unary
+
+
+def _infer_compare(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape = x.shape
+    out.dtype = "bool"
+
+
+def _make_compare(name, fn):
+    @register_op(name, infer_shape=_infer_compare, no_gradient=True)
+    def lower(ctx):
+        ctx.set_output("Out", fn(ctx.input("X"), ctx.input("Y")))
+    lower.__name__ = name + "_lower"
+
+
+_make_compare("less_than", jnp.less)
+_make_compare("less_equal", jnp.less_equal)
+_make_compare("greater_than", jnp.greater)
+_make_compare("greater_equal", jnp.greater_equal)
+_make_compare("equal", jnp.equal)
+_make_compare("not_equal", jnp.not_equal)
+
+
+def _make_logical(name, fn, binary=True):
+    @register_op(name, infer_shape=_infer_compare, no_gradient=True)
+    def lower(ctx):
+        if binary:
+            ctx.set_output("Out", fn(ctx.input("X"), ctx.input("Y")))
+        else:
+            ctx.set_output("Out", fn(ctx.input("X")))
+    lower.__name__ = name + "_lower"
+
+
+_make_logical("logical_and", jnp.logical_and)
+_make_logical("logical_or", jnp.logical_or)
+_make_logical("logical_xor", jnp.logical_xor)
+_make_logical("logical_not", jnp.logical_not, binary=False)
+
+
+@register_op("increment", infer_shape=infer_shape_unary(), no_gradient=True)
+def increment_lower(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", x + jnp.asarray(ctx.attr("step", 1.0), x.dtype))
+
+
+@register_op("is_empty", no_gradient=True)
+def is_empty_lower(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.asarray(x.size == 0))
+
+
+@register_op("isfinite", no_gradient=True)
+def isfinite_lower(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.all(jnp.isfinite(x)).reshape(1))

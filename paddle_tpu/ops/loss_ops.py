@@ -1,0 +1,187 @@
+"""Loss ops — reference ``paddle/fluid/operators/{cross_entropy_op,
+softmax_with_cross_entropy_op,sigmoid_cross_entropy_with_logits_op,
+hinge_loss_op,huber_loss_op,log_loss_op,rank_loss_op,margin_rank_loss_op,
+smooth_l1_loss_op,squared_l2_distance_op,...}``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import (
+    register_op, infer_shape_unary, ShapeInferenceSkip)
+
+
+def _infer_rowwise_loss(op, block, x_slot="X"):
+    x = block.var(op.input(x_slot)[0])
+    out = block.var(op.output("Out")[0] if op.output("Out")
+                    else op.output("Loss")[0])
+    if x.shape is not None:
+        out.shape = (x.shape[0], 1)
+    out.dtype = x.dtype
+
+
+def _take_along_label(x, label):
+    """x: (N, D), label: (N,) or (N,1) int -> x[i, label[i]] as (N,1)."""
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label.reshape(-1)
+    picked = jnp.take_along_axis(x, label[:, None].astype(jnp.int32), axis=1)
+    return picked
+
+
+def _infer_cross_entropy(op, block):
+    _infer_rowwise_loss(op, block, "X")
+
+
+@register_op("cross_entropy", infer_shape=_infer_cross_entropy,
+             no_grad_inputs=("Label",))
+def cross_entropy_lower(ctx):
+    x = ctx.input("X")  # probabilities (N, D)
+    label = ctx.input("Label")
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), axis=-1,
+                        keepdims=True)
+    else:
+        picked = _take_along_label(x, label)
+        loss = -jnp.log(jnp.maximum(picked, 1e-20))
+    ctx.set_output("Y", loss)
+    ctx.set_output("Out", loss)
+
+
+def _infer_softmax_ce(op, block):
+    logits = block.var(op.input("Logits")[0])
+    if logits.shape is None:
+        raise ShapeInferenceSkip()
+    sm = block.var(op.output("Softmax")[0])
+    sm.shape = logits.shape
+    sm.dtype = logits.dtype
+    loss = block.var(op.output("Loss")[0])
+    loss.shape = tuple(logits.shape[:-1]) + (1,)
+    loss.dtype = logits.dtype
+
+
+@register_op("softmax_with_cross_entropy", infer_shape=_infer_softmax_ce,
+             no_grad_inputs=("Label",),
+             stop_gradient_outputs=("Softmax",))
+def softmax_with_cross_entropy_lower(ctx):
+    logits = ctx.input("Logits")
+    label = ctx.input("Label")
+    log_sm = jax.nn.log_softmax(logits, axis=-1)
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * log_sm, axis=-1, keepdims=True)
+    else:
+        lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 \
+            else label
+        picked = jnp.take_along_axis(log_sm, lbl[..., None].astype(jnp.int32),
+                                     axis=-1)
+        loss = -picked
+    ctx.set_output("Softmax", jnp.exp(log_sm))
+    ctx.set_output("Loss", loss)
+
+
+@register_op("sigmoid_cross_entropy_with_logits",
+             infer_shape=infer_shape_unary(), no_grad_inputs=("Label",))
+def sigmoid_ce_lower(ctx):
+    x = ctx.input("X")
+    label = ctx.input("Label")
+    # max(x,0) - x*z + log(1 + exp(-|x|)) (numerically stable)
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ctx.set_output("Out", loss)
+
+
+def _infer_sqdist(op, block):
+    x = block.var(op.input("X")[0])
+    if x.shape is not None:
+        sub = block.var(op.output("sub_result")[0])
+        sub.shape = x.shape
+        sub.dtype = x.dtype
+        out = block.var(op.output("Out")[0])
+        out.shape = (x.shape[0], 1)
+        out.dtype = x.dtype
+
+
+@register_op("squared_l2_distance", infer_shape=_infer_sqdist)
+def squared_l2_distance_lower(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    sub = x - y
+    ctx.set_output("sub_result", sub)
+    ctx.set_output("Out", jnp.sum(jnp.square(sub), axis=-1, keepdims=True))
+
+
+@register_op("smooth_l1_loss", no_grad_inputs=("InsideWeight",
+                                               "OutsideWeight"))
+def smooth_l1_loss_lower(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    inw = ctx.input("InsideWeight")
+    outw = ctx.input("OutsideWeight")
+    diff = x - y
+    if inw is not None:
+        diff = diff * inw
+    ad = jnp.abs(diff)
+    val = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    ctx.set_output("Diff", diff)
+    if outw is not None:
+        val = val * outw
+    ctx.set_output("Out", jnp.sum(val, axis=tuple(range(1, x.ndim)),
+                                  keepdims=False)[:, None])
+
+
+@register_op("hinge_loss", no_grad_inputs=("Labels",))
+def hinge_loss_lower(ctx):
+    logits = ctx.input("Logits")
+    labels = ctx.input("Labels")
+    ctx.set_output("Loss",
+                   jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0))
+
+
+@register_op("huber_loss", no_grad_inputs=())
+def huber_loss_lower(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    delta = ctx.attr("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r,
+                     delta * (ar - 0.5 * delta))
+    ctx.set_output("Residual", r)
+    ctx.set_output("Out", loss)
+
+
+@register_op("log_loss", no_grad_inputs=("Labels",))
+def log_loss_lower(ctx):
+    p = ctx.input("Predicted")
+    y = ctx.input("Labels")
+    eps = ctx.attr("epsilon", 1e-4)
+    loss = -y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps)
+    ctx.set_output("Loss", loss)
+
+
+@register_op("rank_loss", no_grad_inputs=("Label",))
+def rank_loss_lower(ctx):
+    label = ctx.input("Label")
+    left, right = ctx.input("Left"), ctx.input("Right")
+    d = left - right
+    loss = jnp.log1p(jnp.exp(d)) - label * d
+    ctx.set_output("Out", loss)
+
+
+@register_op("margin_rank_loss", no_grad_inputs=("Label",))
+def margin_rank_loss_lower(ctx):
+    label = ctx.input("Label")
+    x1, x2 = ctx.input("X1"), ctx.input("X2")
+    margin = ctx.attr("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    ctx.set_output("Out", out)
+    ctx.set_output("Activated", (out > 0).astype(x1.dtype))
+
+
+@register_op("modified_huber_loss", no_grad_inputs=("Y",))
+def modified_huber_loss_lower(ctx):
+    x = ctx.input("X")
+    y = ctx.input("Y")  # in {0, 1}
+    z = (2.0 * y - 1.0) * x
+    inter = jnp.where(z < -1.0, -4.0 * z, jnp.square(jnp.maximum(1.0 - z, 0)))
+    ctx.set_output("IntermediateVal", z)
+    ctx.set_output("Out", inter)

@@ -1,0 +1,104 @@
+"""Metric ops — reference ``accuracy_op.cc``, ``auc_op.cc``,
+``precision_recall_op.cc``, ``edit_distance_op.cc``."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op, ShapeInferenceSkip
+
+
+def _infer_accuracy(op, block):
+    for slot in ("Accuracy", "Correct", "Total"):
+        names = op.output(slot)
+        if names:
+            v = block.var(names[0])
+            v.shape = (1,)
+            v.dtype = "float32" if slot == "Accuracy" else "int64"
+
+
+@register_op("accuracy", infer_shape=_infer_accuracy, no_gradient=True)
+def accuracy_lower(ctx):
+    # Out: top-k indices from top_k op (N, k); Label: (N, 1)
+    indices = ctx.input("Indices")
+    label = ctx.input("Label")
+    if label.ndim == 2:
+        label = label.reshape(-1)
+    correct = jnp.any(indices == label[:, None], axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int64))
+    total = jnp.asarray(indices.shape[0], dtype=jnp.int64)
+    ctx.set_output("Accuracy",
+                   (num_correct.astype(jnp.float32) / total).reshape(1))
+    ctx.set_output("Correct", num_correct.reshape(1))
+    ctx.set_output("Total", total.reshape(1))
+
+
+@register_op("auc", no_gradient=True)
+def auc_lower(ctx):
+    """Streaming AUC using histogram buckets (reference auc_op.cc)."""
+    predict = ctx.input("Predict")  # (N, 2) softmax probs or (N,1)
+    label = ctx.input("Label").reshape(-1)
+    pos_score = predict[:, -1]
+    num_buckets = ctx.attr("num_thresholds", 200) + 1
+    bucket = jnp.clip((pos_score * (num_buckets - 1)).astype(jnp.int32),
+                      0, num_buckets - 1)
+    is_pos = (label > 0).astype(jnp.int64)
+    tp_hist = jnp.zeros(num_buckets, jnp.int64).at[bucket].add(is_pos)
+    fp_hist = jnp.zeros(num_buckets, jnp.int64).at[bucket].add(1 - is_pos)
+    stat_pos = ctx.input("StatPos")
+    stat_neg = ctx.input("StatNeg")
+    if stat_pos is not None:
+        tp_hist = tp_hist + stat_pos.astype(jnp.int64)
+        fp_hist = fp_hist + stat_neg.astype(jnp.int64)
+    # AUC by trapezoid over descending-threshold cumulative counts
+    tp_cum = jnp.cumsum(tp_hist[::-1])
+    fp_cum = jnp.cumsum(fp_hist[::-1])
+    tot_pos = tp_cum[-1]
+    tot_neg = fp_cum[-1]
+    tp_prev = jnp.concatenate([jnp.zeros(1, jnp.int64), tp_cum[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, jnp.int64), fp_cum[:-1]])
+    area = jnp.sum((fp_cum - fp_prev) * (tp_cum + tp_prev) / 2.0)
+    denom = (tot_pos * tot_neg).astype(jnp.float64).astype(jnp.float32)
+    auc = jnp.where(denom > 0, area.astype(jnp.float32) / jnp.maximum(denom, 1.0), 0.0)
+    ctx.set_output("AUC", auc.reshape(1))
+    ctx.set_output("StatPosOut", tp_hist)
+    ctx.set_output("StatNegOut", fp_hist)
+
+
+@register_op("precision_recall", no_gradient=True)
+def precision_recall_lower(ctx):
+    """Multi-class precision/recall (macro + micro averaged)."""
+    max_probs = ctx.input("MaxProbs")
+    indices = ctx.input("Indices").reshape(-1)
+    labels = ctx.input("Labels").reshape(-1)
+    cls = ctx.attr("class_number")
+    weights = ctx.input("Weights")
+    w = weights.reshape(-1) if weights is not None else \
+        jnp.ones_like(labels, dtype=jnp.float32)
+    pred = indices
+    tp = jnp.zeros(cls, jnp.float32).at[labels].add(
+        w * (pred == labels).astype(jnp.float32))
+    fp = jnp.zeros(cls, jnp.float32).at[pred].add(
+        w * (pred != labels).astype(jnp.float32))
+    fn = jnp.zeros(cls, jnp.float32).at[labels].add(
+        w * (pred != labels).astype(jnp.float32))
+    states = ctx.input("StatesInfo")
+    if states is not None:  # (cls, 4): tp, fp, tn, fn accumulated
+        tp = tp + states[:, 0]
+        fp = fp + states[:, 1]
+        fn = fn + states[:, 3]
+    eps = 1e-6
+    prec = tp / jnp.maximum(tp + fp, eps)
+    rec = tp / jnp.maximum(tp + fn, eps)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, eps)
+    macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+    mtp, mfp, mfn = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+    mprec = mtp / jnp.maximum(mtp + mfp, eps)
+    mrec = mtp / jnp.maximum(mtp + mfn, eps)
+    micro = jnp.stack([mprec, mrec,
+                       2 * mprec * mrec / jnp.maximum(mprec + mrec, eps)])
+    ctx.set_output("BatchMetrics", jnp.concatenate([macro, micro]))
+    ctx.set_output("AccumMetrics", jnp.concatenate([macro, micro]))
+    zeros = jnp.zeros(cls, jnp.float32)
+    ctx.set_output("AccumStatesInfo", jnp.stack([tp, fp, zeros, fn], axis=1))

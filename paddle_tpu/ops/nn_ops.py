@@ -1,0 +1,452 @@
+"""Neural-net structural ops: conv, pool, normalization, dropout, softmax.
+
+Reference: ``paddle/fluid/operators/{conv_op,conv_transpose_op,pool_op,
+batch_norm_op,layer_norm_op,lrn_op,dropout_op,softmax_op}``.  Data layout is
+NCHW like the reference's default; XLA re-lays out for the MXU internally.
+Convolutions lower to ``lax.conv_general_dilated`` (one XLA HLO, tiled onto
+the MXU) instead of the reference's im2col+GEMM / cuDNN split.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.registry import (
+    register_op, register_grad_lower, infer_shape_unary, ShapeInferenceSkip)
+
+
+# ---------------------------------------------------------------------------
+# conv2d / depthwise_conv2d / conv2d_transpose / conv3d
+# ---------------------------------------------------------------------------
+
+def _conv_out_size(i, k, s, p, d=1):
+    if i == -1:
+        return -1
+    ke = d * (k - 1) + 1
+    return (i + 2 * p - ke) // s + 1
+
+
+def _infer_conv2d(op, block):
+    x = block.var(op.input("Input")[0])
+    w = block.var(op.input("Filter")[0])
+    if x.shape is None or w.shape is None:
+        raise ShapeInferenceSkip()
+    strides = op.attr("strides", [1, 1])
+    paddings = op.attr("paddings", [0, 0])
+    dilations = op.attr("dilations", [1, 1])
+    n, _, h, wd = x.shape
+    oc, _, kh, kw = w.shape
+    out = block.var(op.output("Output")[0])
+    out.shape = (n, oc,
+                 _conv_out_size(h, kh, strides[0], paddings[0], dilations[0]),
+                 _conv_out_size(wd, kw, strides[1], paddings[1], dilations[1]))
+    out.dtype = x.dtype
+
+
+def _conv2d_lower_impl(ctx, depthwise=False):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")
+    strides = tuple(ctx.attr("strides", [1, 1]))
+    paddings = ctx.attr("paddings", [0, 0])
+    dilations = tuple(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    if depthwise:
+        groups = x.shape[1]
+    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    ctx.set_output("Output", out.astype(x.dtype))
+
+
+@register_op("conv2d", infer_shape=_infer_conv2d)
+def conv2d_lower(ctx):
+    _conv2d_lower_impl(ctx)
+
+
+@register_op("depthwise_conv2d", infer_shape=_infer_conv2d)
+def depthwise_conv2d_lower(ctx):
+    _conv2d_lower_impl(ctx, depthwise=True)
+
+
+def _infer_conv2d_transpose(op, block):
+    x = block.var(op.input("Input")[0])
+    w = block.var(op.input("Filter")[0])
+    if x.shape is None or w.shape is None:
+        raise ShapeInferenceSkip()
+    strides = op.attr("strides", [1, 1])
+    paddings = op.attr("paddings", [0, 0])
+    dilations = op.attr("dilations", [1, 1])
+    n, _, h, wd = x.shape
+    _, oc, kh, kw = w.shape  # filter layout (C_in, C_out/groups, kh, kw)
+    def osize(i, k, s, p, d):
+        if i == -1:
+            return -1
+        return (i - 1) * s - 2 * p + d * (k - 1) + 1
+    out = block.var(op.output("Output")[0])
+    out.shape = (n, oc * (op.attr("groups", 1) or 1),
+                 osize(h, kh, strides[0], paddings[0], dilations[0]),
+                 osize(wd, kw, strides[1], paddings[1], dilations[1]))
+    out.dtype = x.dtype
+
+
+@register_op("conv2d_transpose", infer_shape=_infer_conv2d_transpose)
+def conv2d_transpose_lower(ctx):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")  # (C_in, C_out, kh, kw)
+    strides = tuple(ctx.attr("strides", [1, 1]))
+    paddings = ctx.attr("paddings", [0, 0])
+    dilations = tuple(ctx.attr("dilations", [1, 1]))
+    pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides, padding=pad, rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"))
+    ctx.set_output("Output", out)
+
+
+def _infer_conv3d(op, block):
+    x = block.var(op.input("Input")[0])
+    w = block.var(op.input("Filter")[0])
+    if x.shape is None or w.shape is None:
+        raise ShapeInferenceSkip()
+    s = op.attr("strides", [1, 1, 1])
+    p = op.attr("paddings", [0, 0, 0])
+    d = op.attr("dilations", [1, 1, 1])
+    n, _, d0, h, wd = x.shape
+    oc, _, kd, kh, kw = w.shape
+    out = block.var(op.output("Output")[0])
+    out.shape = (n, oc, _conv_out_size(d0, kd, s[0], p[0], d[0]),
+                 _conv_out_size(h, kh, s[1], p[1], d[1]),
+                 _conv_out_size(wd, kw, s[2], p[2], d[2]))
+    out.dtype = x.dtype
+
+
+@register_op("conv3d", infer_shape=_infer_conv3d)
+def conv3d_lower(ctx):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")
+    s = tuple(ctx.attr("strides", [1, 1, 1]))
+    p = ctx.attr("paddings", [0, 0, 0])
+    d = tuple(ctx.attr("dilations", [1, 1, 1]))
+    pad = [(p[i], p[i]) for i in range(3)]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=s, padding=pad, rhs_dilation=d,
+        feature_group_count=ctx.attr("groups", 1) or 1,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    ctx.set_output("Output", out)
+
+
+# ---------------------------------------------------------------------------
+# pooling  (reference pool_op.cc + math/pooling.cc)
+# ---------------------------------------------------------------------------
+
+def _infer_pool2d(op, block):
+    x = block.var(op.input("X")[0])
+    if x.shape is None:
+        raise ShapeInferenceSkip()
+    ksize = op.attr("ksize")
+    strides = op.attr("strides", [1, 1])
+    paddings = op.attr("paddings", [0, 0])
+    gp = op.attr("global_pooling", False)
+    ceil_mode = op.attr("ceil_mode", False)
+    n, c, h, w = x.shape
+    if gp:
+        oh = ow = 1
+    else:
+        def osize(i, k, s, p):
+            if i == -1:
+                return -1
+            if ceil_mode:
+                return (i - k + 2 * p + s - 1) // s + 1
+            return (i - k + 2 * p) // s + 1
+        oh = osize(h, ksize[0], strides[0], paddings[0])
+        ow = osize(w, ksize[1], strides[1], paddings[1])
+    out = block.var(op.output("Out")[0])
+    out.shape = (n, c, oh, ow)
+    out.dtype = x.dtype
+
+
+@register_op("pool2d", infer_shape=_infer_pool2d)
+def pool2d_lower(ctx):
+    x = ctx.input("X")
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = list(ctx.attr("ksize"))
+    strides = list(ctx.attr("strides", [1, 1]))
+    paddings = list(ctx.attr("paddings", [0, 0]))
+    if ctx.attr("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3]]
+        strides = [1, 1]
+        paddings = [0, 0]
+    window = (1, 1, ksize[0], ksize[1])
+    strides4 = (1, 1, strides[0], strides[1])
+    pad4 = [(0, 0), (0, 0), (paddings[0], paddings[0]),
+            (paddings[1], paddings[1])]
+    if ctx.attr("ceil_mode", False):
+        # extend right/bottom padding so the last partial window is included
+        def extra(i, k, s, p):
+            out = (i - k + 2 * p + s - 1) // s + 1
+            needed = (out - 1) * s + k - i - p
+            return max(needed - p, 0) + p
+        pad4[2] = (paddings[0], extra(x.shape[2], ksize[0], strides[0],
+                                      paddings[0]))
+        pad4[3] = (paddings[1], extra(x.shape[3], ksize[1], strides[1],
+                                      paddings[1]))
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4,
+                                    pad4)
+    else:
+        ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4,
+                                     pad4)
+        if ctx.attr("exclusive", True):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                           strides4, pad4)
+            out = ssum / counts
+        else:
+            out = ssum / (ksize[0] * ksize[1])
+    ctx.set_output("Out", out)
+
+
+@register_op("pool2d_with_index", infer_shape=None, no_grad_inputs=())
+def pool2d_with_index_lower(ctx):
+    x = ctx.input("X")
+    ksize = list(ctx.attr("ksize"))
+    strides = list(ctx.attr("strides", [1, 1]))
+    paddings = list(ctx.attr("paddings", [0, 0]))
+    if ctx.attr("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3]]
+        strides = [1, 1]
+        paddings = [0, 0]
+    window = (1, 1, ksize[0], ksize[1])
+    strides4 = (1, 1, strides[0], strides[1])
+    pad4 = [(0, 0), (0, 0), (paddings[0], paddings[0]),
+            (paddings[1], paddings[1])]
+    out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides4,
+                                pad4)
+    # index of max within flattened H*W of input
+    n, c, h, w = x.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    # select index where value equals the max of its window: use a paired
+    # reduce on (value, index)
+    def sel_max(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+    vals, idxs = jax.lax.reduce_window(
+        (x, flat_idx), (-jnp.inf, 0.0), sel_max, window, strides4, pad4)
+    ctx.set_output("Out", vals)
+    ctx.set_output("Mask", idxs.astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# batch_norm  (reference batch_norm_op.cc)
+# ---------------------------------------------------------------------------
+
+def _infer_batch_norm(op, block):
+    x = block.var(op.input("X")[0])
+    y = block.var(op.output("Y")[0])
+    y.shape = x.shape
+    y.dtype = x.dtype
+    if x.shape is not None:
+        layout = op.attr("data_layout", "NCHW")
+        c = x.shape[1] if layout == "NCHW" else x.shape[-1]
+        for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+            names = op.output(slot)
+            if names:
+                v = block.var(names[0])
+                v.shape = (c,)
+                v.dtype = "float32"
+
+
+@register_op("batch_norm", infer_shape=_infer_batch_norm,
+             no_grad_inputs=("Mean", "Variance"))
+def batch_norm_lower(ctx):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    mean, var = ctx.input("Mean"), ctx.input("Variance")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    layout = ctx.attr("data_layout", "NCHW")
+    is_test = ctx.attr("is_test", False) or not ctx.training
+
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (1 if layout == "NCHW" and x.ndim > 2 else x.ndim - 1))
+    caxis = 1 if (layout == "NCHW" and x.ndim > 2) else x.ndim - 1
+    bshape = [1] * x.ndim
+    bshape[caxis] = x.shape[caxis]
+
+    xf = x.astype(jnp.float32)
+    if is_test:
+        use_mean, use_var = mean, var
+        saved_mean, saved_var = mean, var
+        mean_out, var_out = mean, var
+    else:
+        use_mean = jnp.mean(xf, axis=axes)
+        use_var = jnp.mean(jnp.square(xf - use_mean.reshape(bshape)),
+                           axis=axes)
+        saved_mean, saved_var = use_mean, use_var
+        mean_out = mean * momentum + use_mean * (1.0 - momentum)
+        var_out = var * momentum + use_var * (1.0 - momentum)
+
+    inv_std = jax.lax.rsqrt(use_var + eps)
+    y = (xf - use_mean.reshape(bshape)) * inv_std.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    ctx.set_output("Y", y.astype(x.dtype))
+    ctx.set_output("MeanOut", mean_out)
+    ctx.set_output("VarianceOut", var_out)
+    ctx.set_output("SavedMean", saved_mean)
+    ctx.set_output("SavedVariance", jax.lax.rsqrt(saved_var + eps))
+
+
+# ---------------------------------------------------------------------------
+# layer_norm  (reference layer_norm_op.cc)
+# ---------------------------------------------------------------------------
+
+def _infer_layer_norm(op, block):
+    x = block.var(op.input("X")[0])
+    y = block.var(op.output("Y")[0])
+    y.shape = x.shape
+    y.dtype = x.dtype
+
+
+@register_op("layer_norm", infer_shape=_infer_layer_norm)
+def layer_norm_lower(ctx):
+    x = ctx.input("X")
+    begin = ctx.attr("begin_norm_axis", 1)
+    eps = ctx.attr("epsilon", 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    norm_shape = (1,) * begin + tuple(x.shape[begin:])
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    ctx.set_output("Y", y.astype(x.dtype))
+    ctx.set_output("Mean", mean.reshape(x.shape[:begin]))
+    ctx.set_output("Variance", var.reshape(x.shape[:begin]))
+
+
+# ---------------------------------------------------------------------------
+# lrn (local response normalization)
+# ---------------------------------------------------------------------------
+
+@register_op("lrn", infer_shape=infer_shape_unary())
+def lrn_lower(ctx):
+    x = ctx.input("X")  # NCHW
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    half = n // 2
+    sq = jnp.square(x)
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = jnp.zeros_like(x)
+    for i in range(n):
+        acc = acc + pad[:, i:i + x.shape[1]]
+    mid = k + alpha * acc
+    ctx.set_output("Out", x / jnp.power(mid, beta))
+    ctx.set_output("MidOut", mid)
+
+
+# ---------------------------------------------------------------------------
+# dropout  (reference dropout_op.cc; old-fluid "downgrade_in_infer": train
+# multiplies by the 0/1 mask, inference scales by (1-p))
+# ---------------------------------------------------------------------------
+
+def _infer_dropout(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape = x.shape
+    out.dtype = x.dtype
+    masks = op.output("Mask")
+    if masks:
+        m = block.var(masks[0])
+        m.shape = x.shape
+        m.dtype = x.dtype
+
+
+def _dropout_grad_lower(ctx):
+    g_out = ctx.env[ctx.op.input("Out@GRAD")[0]]
+    mask = ctx.env[ctx.op.input("Mask")[0]]
+    gname = ctx.op.output("X@GRAD")[0]
+    ctx.outputs[gname] = g_out * mask
+
+
+def _dropout_grad_maker(op, block, no_grad_set):
+    from paddle_tpu.framework import grad_var_name
+    x = op.input("X")[0]
+    if x in no_grad_set:
+        return [], {}
+    g_x = grad_var_name(x)
+    desc = {"type": "dropout_grad",
+            "inputs": {"Out@GRAD": [grad_var_name(op.output("Out")[0])],
+                       "Mask": [op.output("Mask")[0]]},
+            "outputs": {"X@GRAD": [g_x]},
+            "attrs": dict(op.attrs)}
+    return [desc], {x: g_x}
+
+
+@register_op("dropout", infer_shape=_infer_dropout, uses_rng=True,
+             grad_maker=_dropout_grad_maker, grad_lower=_dropout_grad_lower)
+def dropout_lower(ctx):
+    x = ctx.input("X")
+    p = ctx.attr("dropout_prob", 0.5)
+    is_test = ctx.attr("is_test", False) or not ctx.training
+    impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
+        ctx.set_output("Out", out)
+        ctx.set_output("Mask", jnp.ones_like(x))
+        return
+    seed = ctx.attr("seed", 0)
+    key = jax.random.PRNGKey(seed) if ctx.attr("fix_seed", False) \
+        else ctx.rng_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        mask = keep.astype(x.dtype) / (1.0 - p)
+    else:
+        mask = keep.astype(x.dtype)
+    ctx.set_output("Out", x * mask)
+    ctx.set_output("Mask", mask)
+
+
+# ---------------------------------------------------------------------------
+# softmax / log_softmax  (reference softmax_op.cc: normalizes the last dim)
+# ---------------------------------------------------------------------------
+
+@register_op("softmax", infer_shape=infer_shape_unary())
+def softmax_lower(ctx):
+    ctx.set_output("Out", jax.nn.softmax(ctx.input("X"), axis=-1))
+
+
+@register_op("log_softmax", infer_shape=infer_shape_unary())
+def log_softmax_lower(ctx):
+    ctx.set_output("Out", jax.nn.log_softmax(ctx.input("X"), axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# label_smooth / im2sequence helpers
+# ---------------------------------------------------------------------------
+
+@register_op("label_smooth", infer_shape=infer_shape_unary())
+def label_smooth_lower(ctx):
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 0.0)
+    dist = ctx.input("PriorDist")
+    k = x.shape[-1]
+    if dist is not None:
+        out = (1.0 - eps) * x + eps * dist
+    else:
+        out = (1.0 - eps) * x + eps / k
+    ctx.set_output("Out", out)

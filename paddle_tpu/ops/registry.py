@@ -1,0 +1,425 @@
+"""Operator registry: shape inference, XLA lowering, grad-op makers.
+
+TPU-native replacement for the reference's op machinery
+(``paddle/fluid/framework/op_registry.h:62``, ``op_proto_maker.h:23``,
+``grad_op_desc_maker.h:33``).  Where the reference registers per-device
+CPU/CUDA kernels keyed by ``OpKernelType``, here each op registers ONE
+``lower`` function: a pure jax.numpy function from input arrays to output
+arrays.  The Executor traces every op lowering in a block into a single
+jaxpr and compiles it once with XLA — there is no per-op kernel dispatch
+at run time.
+
+Gradients: like the reference, autodiff is IR-level (``backward.py`` appends
+``<type>_grad`` ops).  Unlike the reference — which hand-writes every grad
+kernel — the default grad op lowering computes ``jax.vjp`` of the forward
+lowering, so analytic gradients come from the same code path XLA compiles
+for the forward (and XLA CSE folds the recomputed forward away when fwd and
+bwd live in one computation).  Ops with data-dependent randomness or integer
+semantics register explicit grad lowerings instead.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+__all__ = [
+    "OpDef", "register_op", "lookup", "all_ops", "LowerContext",
+    "ShapeInferenceSkip", "infer_shape_unary", "infer_shape_elementwise",
+    "GRAD_SUFFIX",
+]
+
+GRAD_SUFFIX = "@GRAD"
+
+_REGISTRY = {}
+
+
+class ShapeInferenceSkip(Exception):
+    """Raised by infer_shape when shapes cannot be determined at build time."""
+
+
+class OpDef:
+    def __init__(self, type, lower=None, infer_shape=None, grad_maker=None,
+                 grad_lower=None, no_grad_inputs=(), stop_gradient_outputs=(),
+                 uses_rng=False, stateful_outputs=()):
+        self.type = type
+        self.lower = lower
+        self.infer_shape = infer_shape
+        # grad_maker: fn(op, block, no_grad_set) -> (list of op-desc dicts,
+        #   dict fwd_input_name -> grad_name).  None => default auto maker.
+        self.grad_maker = grad_maker
+        # explicit grad lowering (lower fn for the <type>_grad op); None =>
+        # auto-vjp of self.lower.
+        self.grad_lower = grad_lower
+        self.no_grad_inputs = frozenset(no_grad_inputs)  # slot names
+        self.stop_gradient_outputs = frozenset(stop_gradient_outputs)
+        self.uses_rng = uses_rng
+        # outputs that alias an input buffer across steps (e.g. ParamOut for
+        # optimizer ops); informs donation, not semantics.
+        self.stateful_outputs = frozenset(stateful_outputs)
+        self.has_grad = True  # flipped by register_op(no_gradient=True)
+
+
+def register_op(type, *, infer_shape=None, grad_maker=None, grad_lower=None,
+                no_grad_inputs=(), stop_gradient_outputs=(), uses_rng=False,
+                no_gradient=False, stateful_outputs=()):
+    """Decorator: register ``fn(ctx)`` as the lowering for op ``type``."""
+
+    def deco(fn):
+        opdef = OpDef(type, lower=fn, infer_shape=infer_shape,
+                      grad_maker=grad_maker, grad_lower=grad_lower,
+                      no_grad_inputs=no_grad_inputs,
+                      stop_gradient_outputs=stop_gradient_outputs,
+                      uses_rng=uses_rng, stateful_outputs=stateful_outputs)
+        opdef.has_grad = not no_gradient
+        _REGISTRY[type] = opdef
+        return fn
+
+    return deco
+
+
+def register_grad_lower(fwd_type):
+    """Decorator: register an explicit lowering for ``<fwd_type>_grad``."""
+
+    def deco(fn):
+        opdef = _REGISTRY[fwd_type]
+        opdef.grad_lower = fn
+        return fn
+
+    return deco
+
+
+def lookup(type):
+    return _REGISTRY.get(type)
+
+
+def all_ops():
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# common shape-inference helpers
+# ---------------------------------------------------------------------------
+
+def infer_shape_unary(in_slot="X", out_slot="Out"):
+    """Out has the same shape/dtype as the (first) input."""
+
+    def fn(op, block):
+        xs = op.input(in_slot)
+        outs = op.output(out_slot)
+        if not xs or not outs:
+            raise ShapeInferenceSkip()
+        x = block.var(xs[0])
+        for o in outs:
+            ov = block.var(o)
+            ov.shape = x.shape
+            ov.dtype = x.dtype
+            ov.lod_level = x.lod_level
+
+    return fn
+
+
+def _broadcast_shapes(a, b):
+    if a is None or b is None:
+        return None
+    # numpy-style broadcast over trailing dims; -1 propagates
+    out = []
+    for da, db in zip(_pad(a, len(b)), _pad(b, len(a))):
+        if da == -1 or db == -1:
+            out.append(-1)
+        else:
+            out.append(max(da, db))
+    return tuple(out)
+
+
+def _pad(shape, n):
+    shape = tuple(shape)
+    return (1,) * (n - len(shape)) + shape
+
+
+def infer_shape_elementwise(op, block):
+    x = block.var(op.input("X")[0])
+    ys = op.input("Y")
+    out = block.var(op.output("Out")[0])
+    if ys:
+        y = block.var(ys[0])
+        out.shape = x.shape  # paddle semantics: Out matches X (Y broadcasts)
+    else:
+        out.shape = x.shape
+    out.dtype = x.dtype
+    out.lod_level = x.lod_level
+
+
+# ---------------------------------------------------------------------------
+# Lowering context
+# ---------------------------------------------------------------------------
+
+class LowerContext:
+    """Hands an op lowering its input arrays / attrs; collects outputs.
+
+    ``env`` maps variable name -> jax array (tracers during tracing).
+    """
+
+    def __init__(self, op, env, block, rng_key=None, training=True,
+                 aux=None):
+        self.op = op
+        self.env = env
+        self.block = block
+        self._rng_key = rng_key
+        self.training = training
+        # aux: executor-level services (scope access for control flow, mesh
+        # info for collective ops, etc.)
+        self.aux = aux or {}
+        self.outputs = {}
+
+    # -- inputs ------------------------------------------------------------
+    def has_input(self, slot):
+        names = self.op.input(slot)
+        return bool(names) and names[0] in self.env
+
+    def input(self, slot):
+        names = self.op.input(slot)
+        if not names:
+            return None
+        return self.env[names[0]]
+
+    def inputs(self, slot):
+        return [self.env[n] for n in self.op.input(slot)]
+
+    def input_var(self, slot):
+        names = self.op.input(slot)
+        return self.block.var(names[0]) if names else None
+
+    # -- attrs -------------------------------------------------------------
+    def attr(self, name, default=None):
+        return self.op.attr(name, default)
+
+    # -- outputs -----------------------------------------------------------
+    def set_output(self, slot, value):
+        names = self.op.output(slot)
+        if not names:
+            return
+        self.outputs[names[0]] = value
+
+    def set_outputs(self, slot, values):
+        names = self.op.output(slot)
+        for n, v in zip(names, values):
+            self.outputs[n] = v
+
+    def output_var(self, slot):
+        names = self.op.output(slot)
+        return self.block.var(names[0]) if names else None
+
+    # -- rng ---------------------------------------------------------------
+    def rng_key(self):
+        if self._rng_key is None:
+            raise RuntimeError(
+                f"op {self.op.type} needs an RNG key but none was provided")
+        return self._rng_key
+
+
+def run_lowering(op, env, block, rng_key=None, training=True, aux=None):
+    """Execute one op's lowering against ``env``; merge outputs into env."""
+    opdef = lookup(op.type)
+    if opdef is None or opdef.lower is None:
+        raise NotImplementedError(f"no lowering registered for op {op.type!r}")
+    ctx = LowerContext(op, env, block, rng_key=rng_key, training=training,
+                       aux=aux)
+    opdef.lower(ctx)
+    env.update(ctx.outputs)
+    return ctx.outputs
+
+
+# ---------------------------------------------------------------------------
+# default grad maker (reference: DefaultGradOpDescMaker, grad_op_desc_maker.h)
+# ---------------------------------------------------------------------------
+
+def default_grad_maker(op, block, no_grad_set):
+    """Build the ``<type>_grad`` op desc for a forward op.
+
+    Inputs:  every forward input slot (same names), every forward output
+             slot, and ``<out_slot>@GRAD`` for each forward output.
+    Outputs: ``<in_slot>@GRAD`` for each differentiable forward input.
+    Returns (grad_op_descs, input_grad_map) where input_grad_map maps
+    forward input var name -> its grad var name.
+    """
+    from paddle_tpu.framework import grad_var_name
+
+    opdef = lookup(op.type)
+    inputs = {}
+    outputs = {}
+    input_grad_map = {}
+    for slot, names in op.inputs.items():
+        inputs[slot] = list(names)
+    for slot, names in op.outputs.items():
+        inputs[slot] = list(names)
+        inputs[slot + GRAD_SUFFIX] = [grad_var_name(n) for n in names]
+    for slot, names in op.inputs.items():
+        if opdef is not None and slot in opdef.no_grad_inputs:
+            continue
+        grads = []
+        has_any = False
+        for n in names:
+            try:
+                v = block.var(n)
+            except KeyError:
+                v = None
+            if n in no_grad_set or (v is not None and (
+                    v.stop_gradient or v.dtype in ("int32", "int64", "bool",
+                                                   "int8", "uint8", "int16"))):
+                grads.append("")  # empty = no grad needed for this arg
+            else:
+                g = grad_var_name(n)
+                grads.append(g)
+                input_grad_map[n] = g
+                has_any = True
+        if has_any:
+            outputs[slot + GRAD_SUFFIX] = grads
+    if not outputs:
+        return [], {}
+    desc = {"type": op.type + "_grad", "inputs": inputs, "outputs": outputs,
+            "attrs": dict(op.attrs)}
+    return [desc], input_grad_map
+
+
+# ---------------------------------------------------------------------------
+# auto-vjp lowering for <type>_grad ops
+# ---------------------------------------------------------------------------
+
+def auto_vjp_grad_lower(fwd_type):
+    """Generic lowering for a grad op: jax.vjp of the forward lowering.
+
+    Works for any forward op whose lowering is a pure function of its
+    inputs+attrs (no RNG).  Integer/missing input grads are skipped.
+    """
+    fwd_def = _REGISTRY[fwd_type]
+
+    def lower(ctx):
+        op = ctx.op
+        # Which forward input args need grads (slot, idx) -> grad var name
+        wanted = []  # list of (slot, idx, grad_name)
+        for slot, grad_names in op.outputs.items():
+            if not slot.endswith(GRAD_SUFFIX):
+                continue
+            in_slot = slot[:-len(GRAD_SUFFIX)]
+            for i, g in enumerate(grad_names):
+                if g:
+                    wanted.append((in_slot, i, g))
+        if not wanted:
+            return
+
+        # Grad-op inputs partition into: forward outputs (slots S where both
+        # S and S@GRAD are inputs), their grads, and forward inputs (the rest).
+        fwd_out_slots = _fwd_output_slots(op)
+        fwd_in_slots = [s for s in op.inputs
+                        if not s.endswith(GRAD_SUFFIX) and s not in fwd_out_slots]
+        wanted_set = {(s, i) for s, i, _ in wanted}
+
+        diff_args = []      # (slot, idx) of differentiable args
+        primal_vals = []
+        const_env = {}      # (slot, idx) -> value for non-diff args
+        for slot in fwd_in_slots:
+            for i, n in enumerate(op.input(slot)):
+                val = ctx.env[n]
+                if (slot, i) in wanted_set:
+                    diff_args.append((slot, i))
+                    primal_vals.append(val)
+                else:
+                    const_env[(slot, i)] = val
+        diff_set = set(diff_args)
+
+        def fwd_fn(*primals):
+            env = {}
+            fake_op_inputs = {}
+            k = 0
+            for slot in fwd_in_slots:
+                fake_names = []
+                for i in range(len(op.input(slot))):
+                    fname = f"__in_{slot}_{i}"
+                    fake_names.append(fname)
+                    if (slot, i) in diff_set:
+                        env[fname] = primals[k]
+                        k += 1
+                    else:
+                        env[fname] = const_env[(slot, i)]
+                fake_op_inputs[slot] = fake_names
+            # forward output arity = len of the S slot among grad-op inputs
+            fake_op_outputs = {
+                slot: [f"__out_{slot}_{i}"
+                       for i in range(len(op.inputs.get(slot, [])))]
+                for slot in fwd_out_slots}
+            from paddle_tpu.framework import Operator
+            fop = Operator(ctx.block, fwd_type, {}, {}, dict(op.attrs))
+            fop.inputs = fake_op_inputs
+            fop.outputs = fake_op_outputs
+            fctx = LowerContext(fop, env, ctx.block, rng_key=None,
+                                training=ctx.training, aux=ctx.aux)
+            fwd_def.lower(fctx)
+            return tuple(fctx.outputs[n]
+                         for slot in fwd_out_slots
+                         for n in fake_op_outputs[slot])
+
+        _, vjp_fn = jax.vjp(fwd_fn, *primal_vals)
+
+        # cotangents: Out@GRAD inputs, in fwd_out_slots order
+        cots = []
+        for slot in fwd_out_slots:
+            onames = op.inputs.get(slot, [])
+            gnames = op.inputs.get(slot + GRAD_SUFFIX, [])
+            for i, n in enumerate(onames):
+                if i < len(gnames) and gnames[i] in ctx.env:
+                    cots.append(ctx.env[gnames[i]])
+                else:
+                    cots.append(jax.numpy.zeros_like(ctx.env[n]))
+        grads = vjp_fn(tuple(cots))
+
+        for (slot, i), g in zip(diff_args, grads):
+            for ws, wi, gname in wanted:
+                if ws == slot and wi == i:
+                    ctx.outputs[gname] = g
+
+    return lower
+
+
+def _fwd_output_slots(grad_op):
+    """Forward output slots present on a default-maker grad op: slots S such
+    that both S and S@GRAD appear among the grad op's inputs."""
+    slots = []
+    for slot in grad_op.inputs:
+        if slot.endswith(GRAD_SUFFIX):
+            base = slot[:-len(GRAD_SUFFIX)]
+            if base in grad_op.inputs and base not in slots:
+                slots.append(base)
+    return slots
+
+
+def _fwd_input_slots(grad_op):
+    outs = _fwd_output_slots(grad_op)
+    return [s for s in grad_op.inputs
+            if not s.endswith(GRAD_SUFFIX) and s not in outs]
+
+
+def resolve_lowering(op_type):
+    """Find the lowering function for ``op_type``, synthesizing auto-vjp
+    lowerings for ``*_grad`` ops whose forward registered no explicit one."""
+    opdef = lookup(op_type)
+    if opdef is not None and opdef.lower is not None:
+        return opdef
+    if op_type.endswith("_grad"):
+        fwd = op_type[:-len("_grad")]
+        fwd_def = lookup(fwd)
+        if fwd_def is not None:
+            if fwd_def.grad_lower is not None:
+                lower = fwd_def.grad_lower
+            else:
+                if fwd_def.uses_rng:
+                    raise NotImplementedError(
+                        f"op {fwd!r} uses RNG; register an explicit grad "
+                        f"lowering instead of auto-vjp")
+                lower = auto_vjp_grad_lower(fwd)
+            opdef = OpDef(op_type, lower=lower)
+            _REGISTRY[op_type] = opdef
+            return opdef
+    raise NotImplementedError(f"no lowering registered for op {op_type!r}")

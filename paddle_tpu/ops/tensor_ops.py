@@ -1,0 +1,562 @@
+"""Shape / data-movement / creation ops.
+
+Reference: ``paddle/fluid/operators/{reshape,transpose,concat,split,expand,
+pad,crop,gather,scatter,cast,assign,fill_*,uniform_random,gaussian_random,
+one_hot,top_k,...}_op``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.registry import (
+    register_op, register_grad_lower, infer_shape_unary, ShapeInferenceSkip)
+
+
+def _np_dtype(name):
+    import jax.numpy as jnp
+    return jnp.bfloat16 if name == "bfloat16" else np.dtype(name)
+
+
+# ---------------------------------------------------------------------------
+# creation ops
+# ---------------------------------------------------------------------------
+
+def _infer_fill_constant(op, block):
+    out = block.var(op.output("Out")[0])
+    out.shape = tuple(op.attr("shape"))
+    out.dtype = op.attr("dtype", "float32")
+
+
+@register_op("fill_constant", infer_shape=_infer_fill_constant,
+             no_gradient=True)
+def fill_constant_lower(ctx):
+    shape = tuple(ctx.attr("shape"))
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    value = ctx.attr("value", 0.0)
+    ctx.set_output("Out", jnp.full(shape, value, dtype=dtype))
+
+
+def _infer_fill_batch_like(op, block):
+    x = block.var(op.input("Input")[0])
+    shape = list(op.attr("shape"))
+    in_idx = op.attr("input_dim_idx", 0)
+    out_idx = op.attr("output_dim_idx", 0)
+    if x.shape is not None:
+        shape[out_idx] = x.shape[in_idx]
+    out = block.var(op.output("Out")[0])
+    out.shape = tuple(shape)
+    out.dtype = op.attr("dtype", "float32")
+
+
+@register_op("fill_constant_batch_size_like",
+             infer_shape=_infer_fill_batch_like, no_gradient=True)
+def fill_constant_batch_size_like_lower(ctx):
+    x = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    shape[ctx.attr("output_dim_idx", 0)] = x.shape[ctx.attr("input_dim_idx", 0)]
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    ctx.set_output("Out", jnp.full(tuple(shape), ctx.attr("value", 0.0),
+                                   dtype=dtype))
+
+
+@register_op("fill_zeros_like", infer_shape=infer_shape_unary(),
+             no_gradient=True)
+def fill_zeros_like_lower(ctx):
+    ctx.set_output("Out", jnp.zeros_like(ctx.input("X")))
+
+
+@register_op("fill", infer_shape=_infer_fill_constant, no_gradient=True)
+def fill_lower(ctx):
+    shape = tuple(ctx.attr("shape"))
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    value = np.asarray(ctx.attr("value"), dtype=dtype).reshape(shape)
+    ctx.set_output("Out", jnp.asarray(value))
+
+
+@register_op("assign", infer_shape=infer_shape_unary())
+def assign_lower(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+def _infer_assign_value(op, block):
+    out = block.var(op.output("Out")[0])
+    out.shape = tuple(op.attr("shape"))
+    out.dtype = op.attr("dtype", "float32")
+
+
+@register_op("assign_value", infer_shape=_infer_assign_value,
+             no_gradient=True)
+def assign_value_lower(ctx):
+    shape = tuple(ctx.attr("shape"))
+    dtype = ctx.attr("dtype", "float32")
+    if dtype in ("float32", "float64", "bfloat16", "float16"):
+        values = ctx.attr("fp32_values")
+    else:
+        values = ctx.attr("int32_values")
+    arr = np.asarray(values, dtype=_np_dtype(dtype)).reshape(shape)
+    ctx.set_output("Out", jnp.asarray(arr))
+
+
+@register_op("uniform_random", infer_shape=_infer_fill_constant,
+             no_gradient=True, uses_rng=True)
+def uniform_random_lower(ctx):
+    shape = tuple(ctx.attr("shape"))
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    lo = ctx.attr("min", -1.0)
+    hi = ctx.attr("max", 1.0)
+    seed = ctx.attr("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.rng_key()
+    ctx.set_output("Out", jax.random.uniform(key, shape, dtype=jnp.float32,
+                                             minval=lo, maxval=hi).astype(dtype))
+
+
+@register_op("gaussian_random", infer_shape=_infer_fill_constant,
+             no_gradient=True, uses_rng=True)
+def gaussian_random_lower(ctx):
+    shape = tuple(ctx.attr("shape"))
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    seed = ctx.attr("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.rng_key()
+    out = jax.random.normal(key, shape, dtype=jnp.float32) * std + mean
+    ctx.set_output("Out", out.astype(dtype))
+
+
+@register_op("uniform_random_batch_size_like",
+             infer_shape=_infer_fill_batch_like, no_gradient=True,
+             uses_rng=True)
+def uniform_random_batch_size_like_lower(ctx):
+    x = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    shape[ctx.attr("output_dim_idx", 0)] = x.shape[ctx.attr("input_dim_idx", 0)]
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    ctx.set_output("Out", jax.random.uniform(
+        ctx.rng_key(), tuple(shape), dtype=jnp.float32,
+        minval=ctx.attr("min", -1.0), maxval=ctx.attr("max", 1.0)).astype(dtype))
+
+
+@register_op("gaussian_random_batch_size_like",
+             infer_shape=_infer_fill_batch_like, no_gradient=True,
+             uses_rng=True)
+def gaussian_random_batch_size_like_lower(ctx):
+    x = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    shape[ctx.attr("output_dim_idx", 0)] = x.shape[ctx.attr("input_dim_idx", 0)]
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    out = jax.random.normal(ctx.rng_key(), tuple(shape), dtype=jnp.float32) \
+        * ctx.attr("std", 1.0) + ctx.attr("mean", 0.0)
+    ctx.set_output("Out", out.astype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# cast / shape
+# ---------------------------------------------------------------------------
+
+def _infer_cast(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape = x.shape
+    out.dtype = op.attr("out_dtype", "float32")
+
+
+def _cast_grad_maker(op, block, no_grad_set):
+    """cast grad = cast back (reference cast_op.cc CastOpGradMaker)."""
+    from paddle_tpu.framework import grad_var_name
+    x = op.input("X")[0]
+    if x in no_grad_set:
+        return [], {}
+    g_out = grad_var_name(op.output("Out")[0])
+    g_x = grad_var_name(x)
+    in_dtype = op.attr("in_dtype", "float32")
+    desc = {"type": "cast", "inputs": {"X": [g_out]},
+            "outputs": {"Out": [g_x]},
+            "attrs": {"in_dtype": op.attr("out_dtype"), "out_dtype": in_dtype}}
+    return [desc], {x: g_x}
+
+
+@register_op("cast", infer_shape=_infer_cast, grad_maker=_cast_grad_maker)
+def cast_lower(ctx):
+    ctx.set_output("Out", ctx.input("X").astype(
+        _np_dtype(ctx.attr("out_dtype", "float32"))))
+
+
+def _infer_shape_op(op, block):
+    x = block.var(op.input("Input")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape = (len(x.shape),) if x.shape is not None else None
+    out.dtype = "int64"
+
+
+@register_op("shape", infer_shape=_infer_shape_op, no_gradient=True)
+def shape_lower(ctx):
+    x = ctx.input("Input")
+    ctx.set_output("Out", jnp.asarray(x.shape, dtype=jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# reshape / transpose / squeeze / unsqueeze
+# ---------------------------------------------------------------------------
+
+def _resolve_reshape(shape, in_shape):
+    shape = list(shape)
+    for i, d in enumerate(shape):
+        if d == 0:
+            shape[i] = in_shape[i]
+    return shape
+
+
+def _infer_reshape(op, block):
+    x = block.var(op.input("X")[0])
+    shape = op.attr("shape")
+    out = block.var(op.output("Out")[0])
+    if x.shape is None or any(d == -1 for d in x.shape):
+        out.shape = tuple(shape)
+    else:
+        out.shape = tuple(np.reshape(np.empty(x.shape, dtype=np.int8),
+                                     _resolve_reshape(shape, x.shape)).shape)
+    out.dtype = x.dtype
+
+
+@register_op("reshape", infer_shape=_infer_reshape)
+def reshape_lower(ctx):
+    x = ctx.input("X")
+    shape = _resolve_reshape(ctx.attr("shape"), x.shape)
+    ctx.set_output("Out", x.reshape(shape))
+
+
+def _infer_transpose(op, block):
+    x = block.var(op.input("X")[0])
+    axis = op.attr("axis")
+    out = block.var(op.output("Out")[0])
+    if x.shape is not None:
+        out.shape = tuple(x.shape[a] for a in axis)
+    out.dtype = x.dtype
+
+
+@register_op("transpose", infer_shape=_infer_transpose)
+def transpose_lower(ctx):
+    ctx.set_output("Out", jnp.transpose(ctx.input("X"), ctx.attr("axis")))
+
+
+def _infer_squeeze(op, block):
+    x = block.var(op.input("X")[0])
+    axes = op.attr("axes", [])
+    out = block.var(op.output("Out")[0])
+    if x.shape is not None:
+        if axes:
+            out.shape = tuple(d for i, d in enumerate(x.shape)
+                              if not (i in axes and d == 1))
+        else:
+            out.shape = tuple(d for d in x.shape if d != 1)
+    out.dtype = x.dtype
+
+
+@register_op("squeeze", infer_shape=_infer_squeeze)
+def squeeze_lower(ctx):
+    x = ctx.input("X")
+    axes = ctx.attr("axes", [])
+    if axes:
+        out = x
+        for a in sorted([a % x.ndim for a in axes], reverse=True):
+            if out.shape[a] == 1:
+                out = jnp.squeeze(out, axis=a)
+    else:
+        out = jnp.squeeze(x)
+    ctx.set_output("Out", out)
+
+
+def _infer_unsqueeze(op, block):
+    x = block.var(op.input("X")[0])
+    axes = op.attr("axes", [])
+    out = block.var(op.output("Out")[0])
+    if x.shape is not None:
+        shape = list(x.shape)
+        for a in sorted(axes):
+            shape.insert(a, 1)
+        out.shape = tuple(shape)
+    out.dtype = x.dtype
+
+
+@register_op("unsqueeze", infer_shape=_infer_unsqueeze)
+def unsqueeze_lower(ctx):
+    x = ctx.input("X")
+    for a in sorted(ctx.attr("axes", [])):
+        x = jnp.expand_dims(x, a)
+    ctx.set_output("Out", x)
+
+
+# ---------------------------------------------------------------------------
+# concat / split / expand / pad / crop / slice
+# ---------------------------------------------------------------------------
+
+def _infer_concat(op, block):
+    xs = [block.var(n) for n in op.input("X")]
+    axis = op.attr("axis", 0)
+    out = block.var(op.output("Out")[0])
+    if all(x.shape is not None for x in xs):
+        shape = list(xs[0].shape)
+        shape[axis] = sum(x.shape[axis] for x in xs) \
+            if all(x.shape[axis] != -1 for x in xs) else -1
+        out.shape = tuple(shape)
+    out.dtype = xs[0].dtype
+    out.lod_level = xs[0].lod_level
+
+
+@register_op("concat", infer_shape=_infer_concat)
+def concat_lower(ctx):
+    xs = ctx.inputs("X")
+    ctx.set_output("Out", jnp.concatenate(xs, axis=ctx.attr("axis", 0)))
+
+
+def _infer_split(op, block):
+    x = block.var(op.input("X")[0])
+    axis = op.attr("axis", 0)
+    num = op.attr("num", 0)
+    sections = op.attr("sections", [])
+    outs = [block.var(n) for n in op.output("Out")]
+    if x.shape is not None:
+        for i, o in enumerate(outs):
+            shape = list(x.shape)
+            if num:
+                shape[axis] = x.shape[axis] // num if x.shape[axis] != -1 else -1
+            elif sections:
+                shape[axis] = sections[i]
+            o.shape = tuple(shape)
+            o.dtype = x.dtype
+
+
+@register_op("split", infer_shape=_infer_split)
+def split_lower(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    num = ctx.attr("num", 0)
+    sections = ctx.attr("sections", [])
+    if num:
+        outs = jnp.split(x, num, axis=axis)
+    else:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    ctx.set_outputs("Out", outs)
+
+
+def _infer_expand(op, block):
+    x = block.var(op.input("X")[0])
+    times = op.attr("expand_times")
+    out = block.var(op.output("Out")[0])
+    if x.shape is not None:
+        out.shape = tuple(d * t if d != -1 else -1
+                          for d, t in zip(x.shape, times))
+    out.dtype = x.dtype
+
+
+@register_op("expand", infer_shape=_infer_expand)
+def expand_lower(ctx):
+    ctx.set_output("Out", jnp.tile(ctx.input("X"),
+                                   tuple(ctx.attr("expand_times"))))
+
+
+def _infer_pad(op, block):
+    x = block.var(op.input("X")[0])
+    paddings = op.attr("paddings")
+    out = block.var(op.output("Out")[0])
+    if x.shape is not None:
+        out.shape = tuple(
+            d + paddings[2 * i] + paddings[2 * i + 1] if d != -1 else -1
+            for i, d in enumerate(x.shape))
+    out.dtype = x.dtype
+
+
+@register_op("pad", infer_shape=_infer_pad)
+def pad_lower(ctx):
+    x = ctx.input("X")
+    p = ctx.attr("paddings")
+    pad_width = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    ctx.set_output("Out", jnp.pad(x, pad_width, mode="constant",
+                                  constant_values=ctx.attr("pad_value", 0.0)))
+
+
+def _infer_crop(op, block):
+    out = block.var(op.output("Out")[0])
+    out.shape = tuple(op.attr("shape"))
+    out.dtype = block.var(op.input("X")[0]).dtype
+
+
+@register_op("crop", infer_shape=_infer_crop)
+def crop_lower(ctx):
+    x = ctx.input("X")
+    offsets = ctx.attr("offsets", [0] * x.ndim)
+    shape = ctx.attr("shape")
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    ctx.set_output("Out", x[slices])
+
+
+def _infer_slice(op, block):
+    x = block.var(op.input("Input")[0])
+    out = block.var(op.output("Out")[0])
+    if x.shape is not None:
+        shape = list(x.shape)
+        for ax, st, en in zip(op.attr("axes"), op.attr("starts"),
+                              op.attr("ends")):
+            d = shape[ax]
+            if d == -1:
+                continue
+            st2 = st if st >= 0 else st + d
+            en2 = min(en if en >= 0 else en + d, d)
+            shape[ax] = max(en2 - st2, 0)
+        out.shape = tuple(shape)
+    out.dtype = x.dtype
+
+
+@register_op("slice", infer_shape=_infer_slice)
+def slice_lower(ctx):
+    x = ctx.input("Input")
+    slices = [slice(None)] * x.ndim
+    for ax, st, en in zip(ctx.attr("axes"), ctx.attr("starts"),
+                          ctx.attr("ends")):
+        slices[ax] = slice(st, en)
+    ctx.set_output("Out", x[tuple(slices)])
+
+
+# ---------------------------------------------------------------------------
+# gather / scatter / multiplex / one_hot
+# ---------------------------------------------------------------------------
+
+def _infer_gather(op, block):
+    x = block.var(op.input("X")[0])
+    ids = block.var(op.input("Index")[0])
+    out = block.var(op.output("Out")[0])
+    if x.shape is not None and ids.shape is not None:
+        out.shape = (ids.shape[0],) + tuple(x.shape[1:])
+    out.dtype = x.dtype
+
+
+@register_op("gather", infer_shape=_infer_gather, no_grad_inputs=("Index",))
+def gather_lower(ctx):
+    x, idx = ctx.input("X"), ctx.input("Index")
+    ctx.set_output("Out", jnp.take(x, idx.reshape(-1), axis=0))
+
+
+@register_op("scatter", infer_shape=infer_shape_unary("X"),
+             no_grad_inputs=("Ids",))
+def scatter_lower(ctx):
+    x = ctx.input("X")
+    ids = ctx.input("Ids").reshape(-1)
+    upd = ctx.input("Updates")
+    ctx.set_output("Out", x.at[ids].set(upd))
+
+
+def _infer_multiplex(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape = x.shape
+    out.dtype = x.dtype
+
+
+@register_op("multiplex", infer_shape=_infer_multiplex,
+             no_grad_inputs=("Ids",))
+def multiplex_lower(ctx):
+    xs = jnp.stack(ctx.inputs("X"))  # (K, B, ...)
+    ids = ctx.input("Ids").reshape(-1)  # (B,)
+    rows = jnp.arange(ids.shape[0])
+    ctx.set_output("Out", xs[ids, rows])
+
+
+def _infer_one_hot(op, block):
+    x = block.var(op.input("X")[0])
+    depth = op.attr("depth")
+    out = block.var(op.output("Out")[0])
+    if x.shape is not None:
+        out.shape = tuple(x.shape[:-1]) + (depth,)
+    out.dtype = "float32"
+
+
+@register_op("one_hot", infer_shape=_infer_one_hot, no_gradient=True)
+def one_hot_lower(ctx):
+    x = ctx.input("X")
+    depth = ctx.attr("depth")
+    if x.shape and x.shape[-1] == 1:
+        x = x.reshape(x.shape[:-1])
+    ctx.set_output("Out", jax.nn.one_hot(x, depth, dtype=jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# top_k / argsort / arg_min_max
+# ---------------------------------------------------------------------------
+
+def _infer_top_k(op, block):
+    x = block.var(op.input("X")[0])
+    k = op.attr("k", 1)
+    out = block.var(op.output("Out")[0])
+    idx = block.var(op.output("Indices")[0])
+    if x.shape is not None:
+        out.shape = tuple(x.shape[:-1]) + (k,)
+        idx.shape = out.shape
+    out.dtype = x.dtype
+    idx.dtype = "int64"
+
+
+@register_op("top_k", infer_shape=_infer_top_k, no_gradient=True)
+def top_k_lower(ctx):
+    x = ctx.input("X")
+    vals, idx = jax.lax.top_k(x, ctx.attr("k", 1))
+    ctx.set_output("Out", vals)
+    ctx.set_output("Indices", idx.astype(jnp.int64))
+
+
+@register_op("argsort", no_gradient=True)
+def argsort_lower(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    ctx.set_output("Out", jnp.sort(x, axis=axis))
+    ctx.set_output("Indices", idx.astype(jnp.int64))
+
+
+@register_op("arg_max", no_gradient=True)
+def arg_max_lower(ctx):
+    ctx.set_output("Out", jnp.argmax(ctx.input("X"),
+                                     axis=ctx.attr("axis", -1)).astype(jnp.int64))
+
+
+@register_op("arg_min", no_gradient=True)
+def arg_min_lower(ctx):
+    ctx.set_output("Out", jnp.argmin(ctx.input("X"),
+                                     axis=ctx.attr("axis", -1)).astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# lookup_table (embedding)  — reference lookup_table_op.cc; the sparse
+# SelectedRows gradient path is realized as dense scatter-add here (XLA
+# lowers jnp.take VJP to scatter-add on TPU); the SelectedRows-typed variant
+# lives with the sparse subsystem.
+# ---------------------------------------------------------------------------
+
+def _infer_lookup_table(op, block):
+    w = block.var(op.input("W")[0])
+    ids = block.var(op.input("Ids")[0])
+    out = block.var(op.output("Out")[0])
+    if w.shape is not None and ids.shape is not None:
+        ids_shape = ids.shape
+        if ids_shape and ids_shape[-1] == 1:
+            ids_shape = ids_shape[:-1]
+        out.shape = tuple(ids_shape) + (w.shape[-1],)
+    out.dtype = w.dtype
+    out.lod_level = ids.lod_level
+
+
+@register_op("lookup_table", infer_shape=_infer_lookup_table,
+             no_grad_inputs=("Ids",))
+def lookup_table_lower(ctx):
+    w, ids = ctx.input("W"), ctx.input("Ids")
+    if ids.shape and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    padding_idx = ctx.attr("padding_idx", -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    ctx.set_output("Out", out)

@@ -1,0 +1,23 @@
+"""Mesh-sharded parallel execution.
+
+Replaces the reference's multi-device stack (§2.8 of SURVEY.md):
+  * ``ParallelExecutor`` + SSA graph + NCCLAllReduceOpHandle
+    (``paddle/fluid/framework/parallel_executor.cc:53``,
+    ``details/multi_devices_graph_builder.cc:79``) → one ``jit`` of the
+    whole training step with the batch dimension sharded over a
+    ``jax.sharding.Mesh`` and parameters replicated; XLA's SPMD partitioner
+    inserts the gradient all-reduce over ICI automatically.
+  * ``DistributeTranspiler`` pserver rewrite → sharding-spec partitioning
+    (``paddle_tpu.parallel.distribute_transpiler``).
+  * NCCL collective ops → collective IR ops lowering to
+    ``lax.psum``/``all_gather``/... (``paddle_tpu.ops.collective_ops``).
+"""
+
+from paddle_tpu.parallel.mesh import (default_mesh, make_mesh,
+                                      device_count, set_default_mesh)
+from paddle_tpu.parallel.parallel_executor import ParallelExecutor
+from paddle_tpu.parallel.distribute_transpiler import (DistributeTranspiler,
+                                                       DistributedSpec)
+
+__all__ = ["ParallelExecutor", "default_mesh", "make_mesh", "device_count",
+           "set_default_mesh", "DistributeTranspiler", "DistributedSpec"]

@@ -1,0 +1,93 @@
+"""DistributeTranspiler — program partitioning over the mesh.
+
+The reference (``python/paddle/fluid/distribute_transpiler.py:138``)
+rewrites the program into a trainer program (send/recv grads over gRPC) and
+per-pserver programs (ListenAndServ + optimize blocks), splitting parameters
+into round-robin blocks (``distributed_splitter.py:37``).
+
+On TPU there is no parameter server: gradients are all-reduced over the ICI
+mesh inside the one compiled step (see ``ParallelExecutor``), and parameter
+*sharding* (the pserver's raison d'être — params too big for one device)
+is expressed as PartitionSpecs consumed by the executor.  This class keeps
+the transpiler-shaped API and produces a ``DistributedSpec``: the mapping
+param name -> PartitionSpec, plus the trainer program (unchanged ops, since
+collectives are implicit in XLA's SPMD partitioning).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.framework import default_main_program, default_startup_program
+from paddle_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+__all__ = ["DistributeTranspiler", "DistributedSpec", "round_robin_split"]
+
+
+class DistributedSpec:
+    """Where each parameter lives on the mesh (replaces the reference's
+    param-block -> pserver-endpoint placement map)."""
+
+    def __init__(self):
+        self.param_specs = {}   # name -> PartitionSpec
+        self.grad_specs = {}
+        self.num_shards = 1
+
+    def spec_for(self, name):
+        return self.param_specs.get(name, P())
+
+
+def round_robin_split(params, num_shards):
+    """reference ``distributed_splitter.py:37`` round_robin."""
+    shards = [[] for _ in range(num_shards)]
+    for i, p in enumerate(params):
+        shards[i % num_shards].append(p)
+    return shards
+
+
+class DistributeTranspiler:
+    """API parity with reference ``DistributeTranspiler:138``."""
+
+    def __init__(self):
+        self.spec = DistributedSpec()
+        self._program = None
+        self._startup = None
+
+    def transpile(self, trainer_id=0, program=None, pservers="", trainers=1,
+                  split_method=round_robin_split, startup_program=None,
+                  shard_params=False, mesh_axis=MODEL_AXIS):
+        """Record the distribution plan.
+
+        ``pservers``/``trainers`` are accepted for API parity; the TPU plan
+        ignores endpoints (no gRPC) and instead decides, per parameter,
+        whether to shard it over ``mesh_axis`` (the pserver-sharding analog)
+        or replicate it.
+        """
+        self._program = program or default_main_program()
+        self._startup = startup_program or default_startup_program()
+        num_shards = max(len(pservers.split(",")) if pservers else 1, 1)
+        self.spec.num_shards = num_shards
+        params = self._program.global_block().all_parameters()
+        for p in params:
+            if shard_params and p.shape and p.shape[0] % num_shards == 0 \
+                    and len(p.shape) >= 1:
+                # shard the first (output/vocab) dim — the same dim the
+                # reference splits into pserver blocks
+                self.spec.param_specs[p.name] = P(mesh_axis)
+            else:
+                self.spec.param_specs[p.name] = P()
+        return self
+
+    def get_trainer_program(self):
+        """On TPU the trainer program IS the program: collectives are
+        implicit (reference :311 strips optimize ops instead)."""
+        return self._program
+
+    def get_pserver_program(self, endpoint=None):
+        """No parameter server exists; return the program so existing
+        call-sites keep working, with the spec describing placement
+        (reference :319 builds a ListenAndServ program)."""
+        return self._program
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        return self._startup

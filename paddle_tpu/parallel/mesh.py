@@ -1,0 +1,49 @@
+"""Device-mesh management (the TPU-native analog of the reference's
+``platform/nccl_helper.h`` NCCLContextMap: device discovery + communicator
+setup — here, a ``jax.sharding.Mesh`` whose collectives ride the ICI).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "default_mesh", "set_default_mesh", "device_count"]
+
+_default_mesh = None
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+
+
+def device_count():
+    return jax.device_count()
+
+
+def make_mesh(mesh_shape=None, axis_names=None, devices=None):
+    """Build a Mesh.  Default: all devices on one ``data`` axis."""
+    devices = devices if devices is not None else jax.devices()
+    if mesh_shape is None:
+        mesh_shape = (len(devices),)
+        axis_names = axis_names or (DATA_AXIS,)
+    axis_names = axis_names or tuple(
+        f"axis{i}" for i in range(len(mesh_shape)))
+    arr = np.asarray(devices[:int(np.prod(mesh_shape))]).reshape(mesh_shape)
+    return Mesh(arr, axis_names)
+
+
+def default_mesh():
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
+def set_default_mesh(mesh):
+    global _default_mesh
+    _default_mesh = mesh
+    return mesh

@@ -1,0 +1,57 @@
+"""Device identity (reference ``paddle/fluid/platform/place.h:25-49``).
+
+The reference's CPUPlace/CUDAPlace/CUDAPinnedPlace variant becomes
+CPUPlace/TPUPlace; pinned host memory has no user-visible analog (XLA's
+runtime owns transfer staging).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["CPUPlace", "TPUPlace", "CUDAPlace", "core_devices", "is_tpu_available"]
+
+
+class Place:
+    def __eq__(self, other):
+        return type(self) is type(other) and getattr(self, "device_id", 0) == \
+            getattr(other, "device_id", 0)
+
+    def __hash__(self):
+        return hash((type(self).__name__, getattr(self, "device_id", 0)))
+
+
+class CPUPlace(Place):
+    def __repr__(self):
+        return "CPUPlace"
+
+    def jax_device(self):
+        cpus = [d for d in jax.devices() if d.platform == "cpu"]
+        return cpus[0] if cpus else jax.devices()[0]
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if d.platform != "cpu"]
+        if not devs:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+# Compatibility alias: reference code says CUDAPlace; on this stack the
+# accelerator is a TPU.
+CUDAPlace = TPUPlace
+
+
+def core_devices():
+    return jax.devices()
+
+
+def is_tpu_available():
+    return any(d.platform != "cpu" for d in jax.devices())

@@ -1,0 +1,65 @@
+"""Profiler bridge (reference ``python/paddle/fluid/profiler.py`` over the
+C++ host/device tracer ``paddle/fluid/platform/profiler.cc`` + CUPTI
+``device_tracer.h:32``).
+
+TPU-native realization: ``jax.profiler`` traces (viewable in
+TensorBoard/XProf) carry both host and device timelines — the role CUPTI
+plays on GPU.  Op-level annotation uses ``jax.named_scope`` markers inserted
+by the executor; ``profiler(state, sorted_key)`` context mirrors the
+reference API.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import jax
+
+__all__ = ["cuda_profiler", "reset_profiler", "profiler",
+           "start_profiler", "stop_profiler"]
+
+_trace_dir = None
+_start_time = None
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """Name kept for API parity; on TPU this is an XLA/XProf trace."""
+    with profiler("All", profile_path=output_file):
+        yield
+
+
+def reset_profiler():
+    pass
+
+
+def start_profiler(state="All", profile_path="/tmp/paddle_tpu_profile"):
+    global _trace_dir, _start_time
+    _trace_dir = profile_path
+    _start_time = time.time()
+    try:
+        jax.profiler.start_trace(profile_path)
+    except Exception:  # already tracing
+        pass
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    global _trace_dir
+    try:
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+    _trace_dir = None
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None,
+             profile_path="/tmp/paddle_tpu_profile"):
+    """reference ``profiler.py:76``."""
+    start_profiler(state, profile_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,10 @@
+"""Reader creators/decorators (reference ``python/paddle/reader/``)."""
+
+from paddle_tpu.reader.decorator import (
+    map_readers, buffered, compose, chain, shuffle, firstn, xmap_readers,
+    cache)
+from paddle_tpu.reader import creator
+from paddle_tpu.reader import decorator
+
+__all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
+           "firstn", "xmap_readers", "cache", "creator", "decorator"]

@@ -1,0 +1,41 @@
+"""Reader creators (reference ``python/paddle/reader/creator.py``)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["np_array", "text_file", "recordio"]
+
+
+def np_array(x):
+    def reader():
+        if x.ndim < 1:
+            yield x
+        for e in x:
+            yield e
+    return reader
+
+
+def text_file(path):
+    def reader():
+        with open(path) as f:
+            for l in f:
+                yield l.rstrip("\n")
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Read from recordio files (native reader in paddle_tpu.recordio)."""
+    from paddle_tpu.recordio import RecordIOReader
+
+    def reader():
+        if isinstance(paths, str):
+            path_list = paths.split(",")
+        else:
+            path_list = list(paths)
+        for path in path_list:
+            with RecordIOReader(path) as r:
+                yield from r
+    return reader

@@ -1,0 +1,96 @@
+"""Scope: hierarchical name -> value store (reference ``scope.h:39``).
+
+Values are jax arrays (committed to device) or host numpy arrays; the
+Executor moves values to/from device as needed.  Unlike the reference —
+where every op reads and writes Variables in the Scope — only block
+*boundaries* touch the scope here: feeds, fetches, and persistable state.
+Everything intermediate lives inside the compiled XLA computation.
+"""
+
+from __future__ import annotations
+
+__all__ = ["Scope", "global_scope", "scope_guard"]
+
+import contextlib
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self.kids = []
+        # LoD metadata (row-splits per level) carried next to ragged tensors
+        self._lod = {}
+
+    def new_scope(self):
+        kid = Scope(parent=self)
+        self.kids.append(kid)
+        return kid
+
+    def var(self, name):
+        """Find-or-create (reference Scope::Var)."""
+        s = self.find_scope(name)
+        if s is not None:
+            return s._vars[name]
+        self._vars[name] = None
+        return None
+
+    def find_scope(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s
+            s = s.parent
+        return None
+
+    def find_var(self, name):
+        s = self.find_scope(name)
+        return None if s is None else s._vars[name]
+
+    def has_var(self, name):
+        return self.find_scope(name) is not None
+
+    def set_var(self, name, value):
+        s = self.find_scope(name)
+        (s or self)._vars[name] = value
+
+    def erase(self, names):
+        for n in names:
+            self._vars.pop(n, None)
+            self._lod.pop(n, None)
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    # -- LoD metadata ------------------------------------------------------
+    def set_lod(self, name, lod):
+        self._lod[name] = lod
+
+    def find_lod(self, name):
+        s = self
+        while s is not None:
+            if name in s._lod:
+                return s._lod[name]
+            s = s.parent
+        return None
+
+    def drop_kids(self):
+        self.kids = []
+
+
+_global_scope = Scope()
+_current_scope = _global_scope
+
+
+def global_scope():
+    return _current_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _current_scope
+    prev, _current_scope = _current_scope, scope
+    try:
+        yield
+    finally:
+        _current_scope = prev

@@ -1,0 +1,39 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+initializes, so multi-chip sharding tests run without TPU hardware
+(mirrors the reference's strategy of simulating clusters on one host,
+SURVEY.md §4.5)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon sitecustomize force-sets jax_platforms="axon,cpu" via
+# jax.config.update at interpreter boot; override it back before any
+# backend initializes so tests run on the 8-device virtual CPU platform.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs and a fresh scope."""
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import Program
+    from paddle_tpu.scope import Scope, scope_guard
+
+    main, startup = Program(), Program()
+    prev_main = fluid.switch_main_program(main)
+    prev_startup = fluid.switch_startup_program(startup)
+    scope = Scope()
+    with scope_guard(scope):
+        yield
+    fluid.switch_main_program(prev_main)
+    fluid.switch_startup_program(prev_startup)

@@ -1,0 +1,174 @@
+"""OpTest: per-op numeric test harness.
+
+Port of the reference workhorse ``python/paddle/fluid/tests/unittests/
+op_test.py:212``: build a single-op program from declared inputs/attrs, run
+it through the real Executor (whole-block XLA lowering), compare outputs
+against the test's numpy reference, and check analytic gradients (from IR
+append_backward over the registered grad ops) against central-difference
+numeric gradients (``get_numeric_gradient:97``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard, grad_var_name
+from paddle_tpu.scope import Scope, scope_guard
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class OpTest:
+    """Subclasses set: ``op_type``, ``inputs`` (slot -> ndarray or list of
+    (name, ndarray)), ``outputs`` (slot -> expected ndarray or list),
+    ``attrs`` (optional)."""
+
+    op_type = None
+    inputs = {}
+    outputs = {}
+    attrs = {}
+
+    # -- helpers -----------------------------------------------------------
+    def _input_items(self):
+        for slot, val in self.inputs.items():
+            if isinstance(val, list) and val and isinstance(val[0], tuple):
+                for name, arr in val:
+                    yield slot, name, arr
+            else:
+                yield slot, f"{slot}__var", val
+
+    def _output_items(self):
+        for slot, val in self.outputs.items():
+            if isinstance(val, list) and val and isinstance(val[0], tuple):
+                for name, arr in val:
+                    yield slot, name, arr
+            else:
+                yield slot, f"{slot}__out", val
+
+    def _build(self):
+        program = Program()
+        block = program.global_block()
+        op_inputs = {}
+        feed = {}
+        for slot, name, arr in self._input_items():
+            arr = np.asarray(arr)
+            block.create_var(name=name, shape=arr.shape,
+                             dtype=str(arr.dtype), is_data=True)
+            op_inputs.setdefault(slot, []).append(name)
+            feed[name] = arr
+        op_outputs = {}
+        for slot, name, _ in self._output_items():
+            block.create_var(name=name)
+            op_outputs.setdefault(slot, []).append(name)
+        block.append_op(type=self.op_type, inputs=op_inputs,
+                        outputs=op_outputs, attrs=dict(self.attrs))
+        return program, feed
+
+    # -- forward check -----------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
+        program, feed = self._build()
+        fetch_names = []
+        expected = []
+        for slot, name, arr in self._output_items():
+            if arr is None or slot in no_check_set:
+                continue
+            fetch_names.append(name)
+            expected.append(np.asarray(arr))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            outs = exe.run(program, feed=feed, fetch_list=fetch_names)
+        for name, got, want in zip(fetch_names, outs, expected):
+            np.testing.assert_allclose(
+                np.asarray(got, dtype=np.float64),
+                np.asarray(want, dtype=np.float64),
+                atol=atol, rtol=rtol,
+                err_msg=f"{self.op_type} output {name} mismatch")
+
+    # -- gradient check ----------------------------------------------------
+    def check_grad(self, inputs_to_check, output_name, max_relative_error=
+                   0.005, no_grad_set=None, numeric_grad_delta=0.005):
+        program, feed = self._build()
+        block = program.global_block()
+        out_var = block.var(self._resolve_output(output_name))
+
+        # scalarize: loss = mean(out)
+        block.create_var(name="__loss__")
+        block.append_op(type="mean", inputs={"X": [out_var.name]},
+                        outputs={"Out": ["__loss__"]})
+        loss = block.var("__loss__")
+        loss.shape = (1,)
+        loss.dtype = out_var.dtype
+
+        with program_guard(program):
+            fluid.append_backward(loss, no_grad_set=no_grad_set,
+                                  parameter_list=[])
+
+        check_names = [self._resolve_input(n) for n in
+                       _as_list(inputs_to_check)]
+        grad_names = [grad_var_name(n) for n in check_names]
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            analytic = exe.run(program, feed=feed, fetch_list=grad_names)
+
+        for name, g_analytic in zip(check_names, analytic):
+            g_numeric = self._numeric_grad(name, output_name, feed,
+                                           numeric_grad_delta)
+            abs_a = np.abs(np.asarray(g_analytic, np.float64)).ravel()
+            abs_n = np.abs(g_numeric).ravel()
+            diff = np.abs(np.asarray(g_analytic, np.float64).ravel() -
+                          g_numeric.ravel())
+            denom = np.maximum(np.maximum(abs_a, abs_n), 1e-3)
+            max_diff = (diff / denom).max() if diff.size else 0.0
+            assert max_diff <= max_relative_error, (
+                f"{self.op_type} grad wrt {name}: max relative error "
+                f"{max_diff:.6f} > {max_relative_error}")
+
+    def _resolve_input(self, name_or_slot):
+        for slot, name, arr in self._input_items():
+            if name_or_slot in (slot, name):
+                return name
+        raise KeyError(name_or_slot)
+
+    def _resolve_output(self, name_or_slot):
+        for slot, name, arr in self._output_items():
+            if name_or_slot in (slot, name):
+                return name
+        raise KeyError(name_or_slot)
+
+    def _numeric_grad(self, wrt_name, output_name, feed, delta):
+        """Central differences of mean(out) wrt feed[wrt_name]
+        (reference ``op_test.py get_numeric_gradient:97``)."""
+        program, _ = self._build()
+        block = program.global_block()
+        out_name = self._resolve_output(output_name)
+        block.create_var(name="__loss__")
+        block.append_op(type="mean", inputs={"X": [out_name]},
+                        outputs={"Out": ["__loss__"]})
+        exe = fluid.Executor(fluid.CPUPlace())
+
+        def loss_at(feed_dict):
+            with scope_guard(Scope()):
+                out, = exe.run(program, feed=feed_dict,
+                               fetch_list=["__loss__"])
+            return float(np.asarray(out).reshape(-1)[0])
+
+        base = {k: np.array(v) for k, v in feed.items()}
+        x = base[wrt_name].astype(np.float64)
+        grad = np.zeros_like(x, dtype=np.float64)
+        flat = x.ravel()
+        gflat = grad.ravel()
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + delta
+            base[wrt_name] = x.astype(base[wrt_name].dtype)
+            hi = loss_at(base)
+            flat[i] = orig - delta
+            base[wrt_name] = x.astype(base[wrt_name].dtype)
+            lo = loss_at(base)
+            flat[i] = orig
+            base[wrt_name] = x.astype(base[wrt_name].dtype)
+            gflat[i] = (hi - lo) / (2.0 * delta)
+        return grad

@@ -1,0 +1,60 @@
+"""Book test: linear regression converges + save/load inference model
+(reference ``python/paddle/fluid/tests/book/test_fit_a_line.py``)."""
+
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_fit_a_line_converges(tmp_path):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(cost)
+        sgd = fluid.optimizer.SGD(learning_rate=0.05)
+        sgd.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    train_reader = fluid.reader.shuffle(fluid.dataset.uci_housing.train(),
+                                        buf_size=500)
+    feeder = fluid.DataFeeder(place=fluid.CPUPlace(), feed_list=[x, y],
+                              program=main)
+
+    def batches(reader, bs):
+        batch = []
+        for sample in reader():
+            batch.append(sample)
+            if len(batch) == bs:
+                yield batch
+                batch = []
+
+    first_loss = last_loss = None
+    for epoch in range(12):
+        for batch in batches(train_reader, 32):
+            loss, = exe.run(main, feed=feeder.feed(batch),
+                            fetch_list=[avg_cost])
+            if first_loss is None:
+                first_loss = float(loss)
+            last_loss = float(loss)
+    assert last_loss < first_loss * 0.25, (first_loss, last_loss)
+
+    # save + reload inference model, check same predictions
+    model_dir = str(tmp_path / "fit_a_line_model")
+    fluid.io.save_inference_model(model_dir, ["x"], [y_predict], exe, main)
+
+    infer_prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+        model_dir, exe)
+    xs = np.random.RandomState(0).uniform(-1, 1, (8, 13)).astype("float32")
+    ref_prog = fluid.io.get_inference_program([y_predict], main)
+    ref, = exe.run(ref_prog, feed={"x": xs}, fetch_list=[y_predict])
+    got, = exe.run(infer_prog, feed={feed_names[0]: xs},
+                   fetch_list=fetch_vars)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

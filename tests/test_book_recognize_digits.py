@@ -1,0 +1,72 @@
+"""Book test: MNIST MLP + convnet converge
+(reference ``python/paddle/fluid/tests/book/test_recognize_digits.py``)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _mlp(img, label):
+    hidden = fluid.layers.fc(input=img, size=64, act="relu")
+    hidden = fluid.layers.fc(input=hidden, size=64, act="relu")
+    prediction = fluid.layers.fc(input=hidden, size=10, act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return prediction, avg_cost, acc
+
+
+def _conv_net(img, label):
+    img2d = fluid.layers.reshape(img, [-1, 1, 28, 28])
+    conv_pool_1 = fluid.nets.simple_img_conv_pool(
+        input=img2d, filter_size=5, num_filters=8, pool_size=2,
+        pool_stride=2, act="relu")
+    conv_pool_2 = fluid.nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=16, pool_size=2,
+        pool_stride=2, act="relu")
+    prediction = fluid.layers.fc(input=conv_pool_2, size=10, act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return prediction, avg_cost, acc
+
+
+@pytest.mark.parametrize("net", ["mlp", "conv"])
+def test_recognize_digits(net):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        builder = _mlp if net == "mlp" else _conv_net
+        prediction, avg_cost, acc = builder(img, label)
+        opt = fluid.optimizer.Adam(learning_rate=1e-3)
+        opt.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    reader = fluid.dataset.mnist.train()
+    batch = []
+    accs = []
+    steps = 0
+    max_steps = 60 if net == "conv" else 150
+    for epoch in range(4):
+        for sample in reader():
+            batch.append(sample)
+            if len(batch) < 64:
+                continue
+            imgs = np.stack([b[0] for b in batch]).astype("float32")
+            labels = np.asarray([[b[1]] for b in batch], dtype="int64")
+            batch = []
+            loss, a = exe.run(main, feed={"img": imgs, "label": labels},
+                              fetch_list=[avg_cost, acc])
+            accs.append(float(np.asarray(a)))
+            steps += 1
+            if steps >= max_steps:
+                break
+        if steps >= max_steps:
+            break
+    # synthetic digits are separable: expect strong accuracy by the end
+    assert np.mean(accs[-10:]) > 0.85, np.mean(accs[-10:])

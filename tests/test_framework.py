@@ -1,0 +1,101 @@
+"""IR core tests (mirrors reference ``framework/ddim_test.cc``,
+``scope_test.cc``, ``test_program.py``, ``test_operator_desc.py``)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, Variable, Operator
+from paddle_tpu.scope import Scope
+
+
+class TestProgram:
+    def test_block_structure(self):
+        p = Program()
+        assert p.num_blocks == 1
+        b1 = p.create_block()
+        assert b1.parent_idx == 0
+        assert p.current_block() is b1
+        p.rollback()
+        assert p.current_block() is p.global_block()
+
+    def test_append_op_and_vars(self):
+        p = Program()
+        b = p.global_block()
+        x = b.create_var(name="x", shape=[2, 3], dtype="float32")
+        y = b.create_var(name="y", shape=[2, 3], dtype="float32")
+        op = b.append_op(type="elementwise_add",
+                         inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": ["z"]})
+        assert op.input("X") == ["x"]
+        assert "z" in b.vars  # auto-declared
+        assert b.var("z").shape == (2, 3)  # shape inferred
+
+    def test_serialization_roundtrip(self):
+        p = Program()
+        b = p.global_block()
+        b.create_var(name="x", shape=[4], dtype="float32", persistable=True)
+        b.append_op(type="scale", inputs={"X": ["x"]},
+                    outputs={"Out": ["y"]}, attrs={"scale": 2.0})
+        d = p.to_dict()
+        p2 = Program.from_dict(d)
+        assert p2.global_block().var("x").persistable
+        assert p2.global_block().ops[0].type == "scale"
+        assert p2.global_block().ops[0].attr("scale") == 2.0
+
+    def test_clone_for_test_flips_is_test(self):
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = fluid.layers.data(name="img", shape=[8], dtype="float32")
+            d = fluid.layers.dropout(x, dropout_prob=0.5)
+        t = main.clone(for_test=True)
+        dropout_ops = [op for b in t.blocks for op in b.ops
+                       if op.type == "dropout"]
+        assert dropout_ops and all(op.attr("is_test") for op in dropout_ops)
+
+    def test_prune(self):
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            h = fluid.layers.fc(input=x, size=8)
+            out1 = fluid.layers.fc(input=h, size=2)
+            out2 = fluid.layers.fc(input=h, size=3)  # should be pruned away
+        pruned = main.prune([out1])
+        kept_outputs = {n for op in pruned.global_block().ops
+                        for n in op.output_arg_names}
+        assert out1.name in kept_outputs
+        assert out2.name not in kept_outputs
+
+
+class TestScope:
+    def test_hierarchy(self):
+        s = Scope()
+        s.set_var("a", np.ones(3))
+        kid = s.new_scope()
+        assert kid.find_var("a") is not None
+        kid.set_var("b", np.zeros(2))
+        assert s.find_var("b") is None
+
+    def test_var_create(self):
+        s = Scope()
+        assert s.var("x") is None  # created empty
+        assert s.has_var("x")
+
+
+class TestVariable:
+    def test_dtype_normalization(self):
+        p = Program()
+        v = p.global_block().create_var(name="v", shape=[1], dtype="fp32")
+        assert v.dtype == "float32"
+        v2 = p.global_block().create_var(name="v2", shape=[1],
+                                         dtype=np.float64)
+        assert v2.dtype == "float64"
+
+    def test_operator_overloading_builds_ops(self):
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = x * 2.0 + 1.0
+        types = [op.type for op in main.global_block().ops]
+        assert "elementwise_mul" in types
+        assert "elementwise_add" in types

@@ -1,0 +1,384 @@
+"""Per-op forward + numeric-gradient tests for the tier-1 op set
+(pattern: reference ``tests/unittests/test_*_op.py``)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestMulOp(OpTest):
+    op_type = "mul"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(1)
+        x = rng.uniform(-1, 1, (4, 5)).astype("float32")
+        y = rng.uniform(-1, 1, (5, 3)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(2)
+        x = rng.uniform(-1, 1, (3, 4)).astype("float32")
+        y = rng.uniform(-1, 1, (5, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_Y": True}
+        self.outputs = {"Out": x @ y.T}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(3)
+        x = rng.uniform(-1, 1, (2, 3, 4)).astype("float32")
+        y = rng.uniform(-1, 1, (3,)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(4)
+        x = rng.uniform(-2, 2, (5, 7)).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(5)
+        probs = rng.uniform(0.1, 1.0, (6, 4)).astype("float32")
+        probs /= probs.sum(-1, keepdims=True)
+        labels = rng.randint(0, 4, (6, 1)).astype("int64")
+        loss = -np.log(probs[np.arange(6), labels.ravel()]).reshape(6, 1)
+        self.inputs = {"X": probs, "Label": labels}
+        self.outputs = {"Out": loss}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.05)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(6)
+        logits = rng.uniform(-2, 2, (5, 7)).astype("float32")
+        labels = rng.randint(0, 7, (5, 1)).astype("int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), labels.ravel()]).reshape(5, 1)
+        self.inputs = {"Logits": logits, "Label": labels}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Logits"], "Loss", max_relative_error=0.02)
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(7)
+        x = rng.uniform(-1, 1, (3, 4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False}
+        self.outputs = {"Out": x.sum(axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(8)
+        a = rng.uniform(-1, 1, (2, 3)).astype("float32")
+        b = rng.uniform(-1, 1, (2, 4)).astype("float32")
+        self.inputs = {"X": [("a", a), ("b", b)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["a"], "Out")
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(9)
+        x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+        w = rng.uniform(-0.5, 0.5, (4, 3, 3, 3)).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+        # reference conv via explicit loops (small sizes)
+        out = np.zeros((2, 4, 8, 8), dtype=np.float64)
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        for n in range(2):
+            for f in range(4):
+                for i in range(8):
+                    for j in range(8):
+                        out[n, f, i, j] = np.sum(
+                            xp[n, :, i:i + 3, j:j + 3] * w[f])
+        self.outputs = {"Output": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.03)
+
+
+class TestPool2dAvg(OpTest):
+    op_type = "pool2d"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(10)
+        x = rng.uniform(-1, 1, (2, 3, 4, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        out = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(11)
+        x = rng.uniform(-1, 1, (2, 3, 4, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        out = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(12)
+        x = rng.uniform(-1, 1, (4, 6)).astype("float32")
+        scale = rng.uniform(0.5, 1.5, (6,)).astype("float32")
+        bias = rng.uniform(-0.5, 0.5, (6,)).astype("float32")
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+        self.outputs = {"Y": y, "Mean": mean.ravel(), "Variance": var.ravel()}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=0.02)
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(13)
+        w = rng.uniform(-1, 1, (10, 4)).astype("float32")
+        ids = rng.randint(0, 10, (5, 1)).astype("int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids.ravel()]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["W"], "Out")
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(14)
+        x = rng.uniform(-1, 1, (2, 3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [1, 0, 2]}
+        self.outputs = {"Out": x.transpose(1, 0, 2)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReshape(OpTest):
+    op_type = "reshape"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(15)
+        x = rng.uniform(-1, 1, (2, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [4, 3]}
+        self.outputs = {"Out": x.reshape(4, 3)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSigmoid(OpTest):
+    op_type = "sigmoid"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(16)
+        x = rng.uniform(-3, 3, (4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": 1.0 / (1.0 + np.exp(-x))}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestTanh(OpTest):
+    op_type = "tanh"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(17)
+        x = rng.uniform(-2, 2, (4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.tanh(x)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = "batch_norm"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(18)
+        x = rng.uniform(-1, 1, (4, 3, 2, 2)).astype("float32")
+        scale = rng.uniform(0.5, 1.5, 3).astype("float32")
+        bias = rng.uniform(-0.5, 0.5, 3).astype("float32")
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        y = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(
+            bv.reshape(1, 3, 1, 1) + 1e-5)
+        y = y * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        momentum = 0.9
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+                       "Variance": var}
+        self.attrs = {"momentum": momentum, "epsilon": 1e-5,
+                      "is_test": False}
+        self.outputs = {
+            "Y": y,
+            "MeanOut": mean * momentum + bm * (1 - momentum),
+            "VarianceOut": var * momentum + bv * (1 - momentum),
+            "SavedMean": bm,
+            "SavedVariance": None,  # inv-std convention; skip value check
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(19)
+        x = rng.uniform(-1, 1, (3, 6)).astype("float32")
+        k = 2
+        idx = np.argsort(-x, axis=1)[:, :k]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"k": k}
+        self.outputs = {"Out": vals, "Indices": idx.astype("int64")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestDropoutInference(OpTest):
+    op_type = "dropout"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(20)
+        x = rng.uniform(-1, 1, (4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True}
+        self.outputs = {"Out": x * 0.7, "Mask": None}
+
+    def test_output(self):
+        self.check_output()
