@@ -1,0 +1,99 @@
+"""Benchmark driver: Transformer-base training throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": "transformer_base_tokens_per_sec_per_chip", "value": N,
+   "unit": "tokens/sec", "vs_baseline": R}
+
+``vs_baseline`` is achieved MFU / 0.45 — the BASELINE.json north-star target
+(Transformer-base >=45% MFU).  MFU uses the dense-transformer estimate
+6*params + attention FLOPs per token against the chip's peak.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip():
+    """Best-effort peak (bf16) FLOP/s for the local accelerator."""
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower()
+    table = {
+        "v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12,
+        "v4": 275e12, "v3": 123e12, "v2": 45e12, "v6e": 918e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    if "tpu" in kind or "axon" in kind:
+        return 197e12
+    return 1e12  # CPU fallback; MFU number will be meaningless but finite
+
+
+def main():
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as T
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    hp = T.ModelHyperParams()
+    if on_tpu:
+        batch, seq = 32, 256
+        warmup, iters = 3, 10
+    else:  # tiny smoke config for dev machines
+        hp.d_model, hp.d_inner_hid, hp.n_layer = 64, 128, 2
+        hp.n_head, hp.d_key, hp.d_value = 4, 16, 16
+        hp.src_vocab_size = hp.trg_vocab_size = 1000
+        batch, seq = 4, 32
+        warmup, iters = 1, 3
+
+    main_prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        avg_cost, _ = T.transformer(batch, seq, seq, hp)
+        opt = fluid.optimizer.Adam(learning_rate=1e-4)
+        opt.minimize(avg_cost)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = T.fake_batch(batch, seq, seq, hp)
+        for _ in range(warmup):
+            loss = exe.run(main_prog, feed=feed,
+                           fetch_list=[avg_cost.name])[0]
+        np.asarray(loss)  # sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = exe.run(main_prog, feed=feed,
+                           fetch_list=[avg_cost.name])[0]
+        np.asarray(loss)  # sync
+        dt = time.perf_counter() - t0
+
+    tokens = batch * seq * iters  # target-side tokens, the NMT convention
+    tokens_per_sec = tokens / dt
+
+    # FLOPs/token: 6*params (fwd+bwd matmuls) + self/cross attention terms
+    n_params = T.param_count(hp)
+    attn_flops = 12 * hp.n_layer * 2 * seq * hp.d_model  # QK^T + AV, f+b
+    flops_per_token = 6 * n_params + attn_flops
+    mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": "transformer_base_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+    print(f"# loss={float(np.asarray(loss).reshape(()))}"
+          f" mfu={mfu:.3f} params={n_params / 1e6:.1f}M"
+          f" step_ms={dt / iters * 1e3:.1f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -130,6 +130,11 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                     actual = []
                     for i, n in enumerate(names):
                         base = base_names[i] if i < len(base_names) else None
+                        if base is None and n.endswith(GRAD_SUFFIX):
+                            # maker omitted the forward-output slot (e.g.
+                            # dropout_grad takes Out@GRAD but not Out);
+                            # canonical grad names encode the base var
+                            base = n[:-len(GRAD_SUFFIX)]
                         if base is not None and base in grads_of:
                             actual.append(grads_of[base][0])
                         else:

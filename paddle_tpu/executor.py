@@ -106,7 +106,7 @@ class Executor:
                        for f in fetch_list]
 
         feed_arrays = {}
-        device = self.place.jax_device()
+        device = self._feed_device()
         for name, value in feed.items():
             var = block.var(name) if block.has_var(name) else None
             lod = None
@@ -137,6 +137,12 @@ class Executor:
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
+
+    # ------------------------------------------------------------------
+    def _feed_device(self):
+        """Target placement for feed arrays; ParallelExecutor overrides to
+        None so sharded placement happens against the mesh instead."""
+        return self.place.jax_device()
 
     # ------------------------------------------------------------------
     def _state_value(self, scope, name, device):

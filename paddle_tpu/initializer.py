@@ -132,9 +132,26 @@ class BilinearInitializer(Initializer):
                    "fp32_values": weight.flatten().tolist()})
 
 
+class NumpyArrayInitializer(Initializer):
+    """Initialize a variable from a host numpy array (reference
+    ``initializer.py`` NumpyArrayInitializer; used e.g. for sinusoid
+    position encodings)."""
+
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        block.append_op(
+            type="assign_value", outputs={"Out": [var.name]},
+            attrs={"shape": list(self.value.shape), "dtype": var.dtype,
+                   "fp32_values": self.value.astype(np.float32)
+                   .flatten().tolist()})
+
+
 Constant = ConstantInitializer
 Uniform = UniformInitializer
 Normal = NormalInitializer
 Xavier = XavierInitializer
 MSRA = MSRAInitializer
 Bilinear = BilinearInitializer
+NumpyArray = NumpyArrayInitializer

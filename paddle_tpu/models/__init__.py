@@ -1,0 +1,8 @@
+"""Model zoo: program-builder functions for the benchmark workloads the
+reference ships under ``benchmark/fluid/`` (mnist, resnet, vgg,
+machine_translation/transformer, stacked_dynamic_lstm) — re-built on the
+TPU-native layers API."""
+
+from paddle_tpu.models import resnet, transformer, vgg, mnist
+
+__all__ = ["resnet", "transformer", "vgg", "mnist"]

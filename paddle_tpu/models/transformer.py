@@ -1,0 +1,261 @@
+"""Transformer (Vaswani et al.) built on the Program IR layers.
+
+The reference carries a full Transformer in its multi-device test
+(``python/paddle/fluid/tests/unittests/test_parallel_executor.py:308``
+``ModelHyperParams``/``transformer``) and benchmarks NMT under
+``benchmark/fluid/machine_translation.py``.  This is the TPU-native
+re-design: dense padded batches with explicit attention masks instead of
+LoD ragged tensors, bfloat16-friendly matmuls that XLA tiles onto the MXU,
+and one fused softmax(QK^T)V per head group.
+
+Used as the flagship model for ``__graft_entry__.py`` / ``bench.py``
+(north star: Transformer-base tokens/sec/chip, BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.layers as layers
+from paddle_tpu.initializer import NumpyArrayInitializer
+from paddle_tpu.param_attr import ParamAttr
+
+
+class ModelHyperParams:
+    """Transformer-base (mirrors test_parallel_executor.py:308 defaults)."""
+    src_vocab_size = 10000
+    trg_vocab_size = 10000
+    pos_pad_idx = 0
+    src_pad_idx = 0
+    trg_pad_idx = 0
+    max_length = 256
+    d_model = 512
+    d_inner_hid = 2048
+    d_key = 64
+    d_value = 64
+    n_head = 8
+    n_layer = 6
+    dropout = 0.1
+
+
+def position_encoding_init(n_position, d_model):
+    """Sinusoid position encoding table."""
+    position = np.arange(n_position)[:, None].astype("float64")
+    div = np.exp(np.arange(0, d_model, 2).astype("float64")
+                 * -(np.log(10000.0) / d_model))
+    table = np.zeros((n_position, d_model))
+    table[:, 0::2] = np.sin(position * div)
+    table[:, 1::2] = np.cos(position * div[: (d_model + 1) // 2])
+    return table.astype("float32")
+
+
+def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
+                         d_model, n_head=1, dropout_rate=0.0,
+                         use_flash=True):
+    """Multi-head scaled-dot-product attention over dense [B,S,D] tensors.
+
+    ``attn_bias`` is a [B, n_head, Sq, Sk] additive mask (0 / -1e9).
+    """
+    keys = queries if keys is None else keys
+    values = keys if values is None else values
+
+    q = layers.fc(queries, d_key * n_head, num_flatten_dims=2,
+                  bias_attr=False)
+    k = layers.fc(keys, d_key * n_head, num_flatten_dims=2, bias_attr=False)
+    v = layers.fc(values, d_value * n_head, num_flatten_dims=2,
+                  bias_attr=False)
+
+    def split_heads(x, d_per_head):
+        # [B, S, H*D] -> [B, H, S, D]
+        b, s = x.shape[0], x.shape[1]
+        x = layers.reshape(x, shape=[b, s, n_head, d_per_head])
+        return layers.transpose(x, perm=[0, 2, 1, 3])
+
+    q = split_heads(q, d_key)
+    k = split_heads(k, d_key)
+    v = split_heads(v, d_value)
+
+    product = layers.matmul(q, k, transpose_y=True,
+                            alpha=float(d_key) ** -0.5)
+    if attn_bias is not None:
+        product = product + attn_bias
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
+
+    # [B, H, S, D] -> [B, S, H*D]
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    b, s = ctx.shape[0], ctx.shape[1]
+    ctx = layers.reshape(ctx, shape=[b, s, n_head * d_value])
+    return layers.fc(ctx, d_model, num_flatten_dims=2, bias_attr=False)
+
+
+def positionwise_feed_forward(x, d_inner_hid, d_hid):
+    hidden = layers.fc(x, d_inner_hid, num_flatten_dims=2, act="relu")
+    return layers.fc(hidden, d_hid, num_flatten_dims=2)
+
+
+def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0):
+    for cmd in process_cmd:
+        if cmd == "a":
+            out = out + prev_out if prev_out is not None else out
+        elif cmd == "n":
+            out = layers.layer_norm(
+                out, begin_norm_axis=len(out.shape) - 1,
+                param_attr=ParamAttr(initializer=None),
+                bias_attr=ParamAttr(initializer=None))
+        elif cmd == "d" and dropout_rate:
+            out = layers.dropout(out, dropout_prob=dropout_rate)
+    return out
+
+
+def encoder_layer(enc_input, attn_bias, hp: ModelHyperParams):
+    attn = multi_head_attention(enc_input, None, None, attn_bias,
+                                hp.d_key, hp.d_value, hp.d_model,
+                                hp.n_head, hp.dropout)
+    attn = pre_post_process_layer(enc_input, attn, "dan", hp.dropout)
+    ffd = positionwise_feed_forward(attn, hp.d_inner_hid, hp.d_model)
+    return pre_post_process_layer(attn, ffd, "dan", hp.dropout)
+
+
+def decoder_layer(dec_input, enc_output, self_attn_bias, cross_attn_bias,
+                  hp: ModelHyperParams):
+    self_attn = multi_head_attention(dec_input, None, None, self_attn_bias,
+                                     hp.d_key, hp.d_value, hp.d_model,
+                                     hp.n_head, hp.dropout)
+    self_attn = pre_post_process_layer(dec_input, self_attn, "dan",
+                                       hp.dropout)
+    cross = multi_head_attention(self_attn, enc_output, enc_output,
+                                 cross_attn_bias, hp.d_key, hp.d_value,
+                                 hp.d_model, hp.n_head, hp.dropout)
+    cross = pre_post_process_layer(self_attn, cross, "dan", hp.dropout)
+    ffd = positionwise_feed_forward(cross, hp.d_inner_hid, hp.d_model)
+    return pre_post_process_layer(cross, ffd, "dan", hp.dropout)
+
+
+def prepare_embedding(ids, pos_ids, vocab_size, hp: ModelHyperParams,
+                      name_prefix):
+    word_emb = layers.embedding(
+        ids, size=[vocab_size, hp.d_model],
+        param_attr=ParamAttr(name=name_prefix + "_word_emb"))
+    word_emb = layers.scale(word_emb, scale=float(hp.d_model) ** 0.5)
+    pos_table = position_encoding_init(hp.max_length, hp.d_model)
+    pos_emb = layers.embedding(
+        pos_ids, size=[hp.max_length, hp.d_model],
+        param_attr=ParamAttr(
+            name=name_prefix + "_pos_emb", trainable=False,
+            initializer=NumpyArrayInitializer(pos_table)))
+    out = word_emb + pos_emb
+    if hp.dropout:
+        out = layers.dropout(out, dropout_prob=hp.dropout)
+    return out
+
+
+def encoder(src_ids, src_pos, src_attn_bias, hp: ModelHyperParams):
+    x = prepare_embedding(src_ids, src_pos, hp.src_vocab_size, hp, "src")
+    for _ in range(hp.n_layer):
+        x = encoder_layer(x, src_attn_bias, hp)
+    return x
+
+
+def decoder(trg_ids, trg_pos, enc_output, self_attn_bias, cross_attn_bias,
+            hp: ModelHyperParams):
+    x = prepare_embedding(trg_ids, trg_pos, hp.trg_vocab_size, hp, "trg")
+    for _ in range(hp.n_layer):
+        x = decoder_layer(x, enc_output, self_attn_bias, cross_attn_bias, hp)
+    return x
+
+
+def build_inputs(batch_size, src_len, trg_len, hp: ModelHyperParams):
+    """Declare the dense feed variables (ids/pos int32, biases float)."""
+    def data(name, shape, dtype):
+        return layers.data(name=name, shape=shape, dtype=dtype,
+                           append_batch_size=False)
+
+    src_ids = data("src_word", [batch_size, src_len], "int32")
+    src_pos = data("src_pos", [batch_size, src_len], "int32")
+    trg_ids = data("trg_word", [batch_size, trg_len], "int32")
+    trg_pos = data("trg_pos", [batch_size, trg_len], "int32")
+    src_attn_bias = data("src_slf_attn_bias",
+                         [batch_size, hp.n_head, src_len, src_len],
+                         "float32")
+    trg_self_bias = data("trg_slf_attn_bias",
+                         [batch_size, hp.n_head, trg_len, trg_len],
+                         "float32")
+    trg_cross_bias = data("trg_src_attn_bias",
+                          [batch_size, hp.n_head, trg_len, src_len],
+                          "float32")
+    labels = data("lbl_word", [batch_size, trg_len], "int32")
+    weights = data("lbl_weight", [batch_size, trg_len], "float32")
+    return (src_ids, src_pos, trg_ids, trg_pos, src_attn_bias,
+            trg_self_bias, trg_cross_bias, labels, weights)
+
+
+def transformer(batch_size, src_len, trg_len, hp: ModelHyperParams = None):
+    """Build the full training graph; returns (avg_cost, feed_vars)."""
+    hp = hp or ModelHyperParams()
+    (src_ids, src_pos, trg_ids, trg_pos, src_attn_bias, trg_self_bias,
+     trg_cross_bias, labels, weights) = build_inputs(
+        batch_size, src_len, trg_len, hp)
+
+    enc_out = encoder(src_ids, src_pos, src_attn_bias, hp)
+    dec_out = decoder(trg_ids, trg_pos, enc_out, trg_self_bias,
+                      trg_cross_bias, hp)
+
+    logits = layers.fc(dec_out, hp.trg_vocab_size, num_flatten_dims=2,
+                       bias_attr=False)
+    logits2d = layers.reshape(
+        logits, shape=[batch_size * trg_len, hp.trg_vocab_size])
+    labels2d = layers.reshape(labels, shape=[batch_size * trg_len, 1])
+    cost = layers.softmax_with_cross_entropy(logits2d, labels2d)
+    weights2d = layers.reshape(weights, shape=[batch_size * trg_len, 1])
+    weighted = cost * weights2d
+    sum_cost = layers.reduce_sum(weighted)
+    token_count = layers.reduce_sum(weights2d)
+    avg_cost = sum_cost / token_count
+    feeds = ["src_word", "src_pos", "trg_word", "trg_pos",
+             "src_slf_attn_bias", "trg_slf_attn_bias", "trg_src_attn_bias",
+             "lbl_word", "lbl_weight"]
+    return avg_cost, feeds
+
+
+def fake_batch(batch_size, src_len, trg_len, hp: ModelHyperParams = None,
+               seed=0):
+    """Synthetic dense batch for benchmarking/compile checks."""
+    hp = hp or ModelHyperParams()
+    rng = np.random.RandomState(seed)
+    src_word = rng.randint(1, hp.src_vocab_size,
+                           size=(batch_size, src_len)).astype("int32")
+    trg_word = rng.randint(1, hp.trg_vocab_size,
+                           size=(batch_size, trg_len)).astype("int32")
+    src_pos = np.tile(np.arange(src_len, dtype="int32"), (batch_size, 1))
+    trg_pos = np.tile(np.arange(trg_len, dtype="int32"), (batch_size, 1))
+    zeros_self = np.zeros((batch_size, hp.n_head, src_len, src_len),
+                          dtype="float32")
+    causal = np.triu(np.full((trg_len, trg_len), -1e9, dtype="float32"), 1)
+    trg_self = np.tile(causal, (batch_size, hp.n_head, 1, 1))
+    cross = np.zeros((batch_size, hp.n_head, trg_len, src_len),
+                     dtype="float32")
+    lbl_word = rng.randint(1, hp.trg_vocab_size,
+                           size=(batch_size, trg_len)).astype("int32")
+    lbl_weight = np.ones((batch_size, trg_len), dtype="float32")
+    return {
+        "src_word": src_word, "src_pos": src_pos,
+        "trg_word": trg_word, "trg_pos": trg_pos,
+        "src_slf_attn_bias": zeros_self,
+        "trg_slf_attn_bias": trg_self,
+        "trg_src_attn_bias": cross,
+        "lbl_word": lbl_word, "lbl_weight": lbl_weight,
+    }
+
+
+def param_count(hp: ModelHyperParams = None):
+    """Approximate dense parameter count (for MFU estimates)."""
+    hp = hp or ModelHyperParams()
+    d, dff = hp.d_model, hp.d_inner_hid
+    per_enc = 4 * d * d + 2 * d * dff + 4 * d
+    per_dec = 8 * d * d + 2 * d * dff + 6 * d
+    emb = (hp.src_vocab_size + hp.trg_vocab_size) * d
+    proj = d * hp.trg_vocab_size
+    return hp.n_layer * (per_enc + per_dec) + emb + proj

@@ -106,14 +106,29 @@ class ParallelExecutor(Executor):
                 new_state[n] = env[n]
             return fetches, new_state
 
-        sample_state = {}
-        fn = jax.jit(step, in_shardings=in_shardings,
-                     out_shardings=(None, _replicated_tree(repl)),
-                     donate_argnums=(2,))
+        jitted = jax.jit(step, in_shardings=in_shardings,
+                         out_shardings=(None, _replicated_tree(repl)),
+                         donate_argnums=(2,))
+        feed_shardings = in_shardings[0]
+
+        def fn(feeds, ro_state, inout_state, rng_key):
+            # place args against the mesh (no-op once state is sharded)
+            feeds = {n: jax.device_put(a, feed_shardings[n])
+                     for n, a in feeds.items()}
+            ro_state = {n: jax.device_put(a, repl)
+                        for n, a in ro_state.items()}
+            inout_state = {n: jax.device_put(a, repl)
+                           for n, a in inout_state.items()}
+            rng_key = jax.device_put(rng_key, repl)
+            return jitted(feeds, ro_state, inout_state, rng_key)
+
         compiled = _CompiledBlock(fn, base.feed_names, base.ro_names,
                                   base.inout_names, tuple(fetch_names), True)
         self._cache[sig] = compiled
         return compiled
+
+    def _feed_device(self):
+        return None
 
 
 def _replicated_tree(repl):

@@ -62,6 +62,9 @@ class Scope:
     def local_var_names(self):
         return list(self._vars)
 
+    def items(self):
+        return list(self._vars.items())
+
     # -- LoD metadata ------------------------------------------------------
     def set_lod(self, name, lod):
         self._lod[name] = lod
