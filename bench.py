@@ -36,7 +36,13 @@ def peak_flops_per_chip():
 
 
 def main():
+    import os
     import jax
+    # optional precision override (measured per-chip; f32 already uses the
+    # MXU via bf16 passes on TPU)
+    prec = os.environ.get("PADDLE_TPU_MATMUL_PRECISION")
+    if prec:
+        jax.config.update("jax_default_matmul_precision", prec)
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer as T
 

@@ -77,7 +77,31 @@ def lower_block(block, env, rng_key, training, aux):
                                     training=training, aux=aux)
         opdef.lower(ctx)
         env.update(ctx.outputs)
+        _share_lod(op, ctx, env, aux)
     return env
+
+
+def _share_lod(op, ctx, env, aux):
+    """Default LoD propagation (reference: OpKernels call ShareLoD(X, Out)
+    unless they change the row structure): outputs whose leading dim equals
+    a LoD-carrying input's row count inherit that input's lod, unless the
+    lowering set an explicit output lod."""
+    lod_map = aux.get("lod")
+    if not lod_map or not ctx.outputs:
+        return
+    src = None
+    rows = None
+    for n in op.input_arg_names:
+        if n in lod_map and n in env and hasattr(env[n], "shape") \
+                and env[n].ndim > 0:
+            src, rows = lod_map[n], env[n].shape[0]
+            break
+    if src is None:
+        return
+    for n, v in ctx.outputs.items():
+        if n not in lod_map and hasattr(v, "shape") and \
+                getattr(v, "ndim", 0) > 0 and v.shape[0] == rows:
+            lod_map[n] = src
 
 
 class Executor:
@@ -115,8 +139,9 @@ class Executor:
                 value, lod = value
             dtype = var.dtype if var is not None else None
             feed_arrays[name] = _as_device_array(value, dtype, device)
-            if lod is not None:
-                scope.set_lod(name, lod)
+            # a dense feed must also CLEAR any stale lod from a previous
+            # ragged feed of the same variable
+            scope.set_lod(name, lod)
 
         compiled = self._get_compiled(program, block, feed_arrays,
                                       tuple(fetch_names), scope)
@@ -158,9 +183,17 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _get_compiled(self, program, block, feed_arrays, fetch_names, scope):
+        # LoD (ragged row-splits) is static trace-time metadata on TPU: a
+        # distinct lod means a distinct compiled executable (bucket batches
+        # upstream to bound recompiles; reference carries LoD on the tensor,
+        # lod_tensor.h:110).
+        feed_lods = tuple(sorted(
+            (n, _freeze_lod(scope.find_lod(n))) for n in feed_arrays
+            if scope.find_lod(n) is not None))
         sig = (id(program), program._version, block.idx,
                tuple(sorted((n, str(a.dtype), a.shape)
                             for n, a in feed_arrays.items())),
+               feed_lods,
                fetch_names)
         if sig in self._cache:
             self._cache[sig] = self._cache.pop(sig)  # LRU bump
@@ -221,13 +254,16 @@ class Executor:
 
         training = not program._is_inference
 
+        lod_map = {n: [list(level) for level in lod]
+                   for n, lod in feed_lods}
+
         def step(feeds, ro_state, inout_state, rng_key):
             env = {}
             env.update(feeds)
             env.update(ro_state)
             env.update(inout_state)
             aux = {"rng_counter": 0, "scope": scope,
-                   "lower_block": lower_block}
+                   "lower_block": lower_block, "lod": dict(lod_map)}
             lower_block(block, env, rng_key, training, aux)
             fetches = [env[n] for n in self.fetch_missing_check(fetch_names, env)]
             new_state = {n: env[n] for n in inout_names + create_state
@@ -252,6 +288,13 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+def _freeze_lod(lod):
+    """Nested row-splits list -> hashable tuple (jit cache key component)."""
+    if lod is None:
+        return None
+    return tuple(tuple(int(x) for x in level) for level in lod)
 
 
 def _external_reads(block, produced_outer):

@@ -5,6 +5,10 @@ from paddle_tpu.layers.nn import *  # noqa: F401,F403
 from paddle_tpu.layers.tensor import *  # noqa: F401,F403
 from paddle_tpu.layers.ops import *  # noqa: F401,F403
 from paddle_tpu.layers.io import *  # noqa: F401,F403
+from paddle_tpu.layers.control_flow import *  # noqa: F401,F403
+from paddle_tpu.layers import control_flow  # noqa: F401
+from paddle_tpu.layers.sequence import *  # noqa: F401,F403
+from paddle_tpu.layers import sequence  # noqa: F401
 from paddle_tpu.layers import learning_rate_scheduler  # noqa: F401
 from paddle_tpu.layers.learning_rate_scheduler import *  # noqa: F401,F403
 

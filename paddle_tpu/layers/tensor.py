@@ -88,8 +88,13 @@ def zeros_like(x, out=None):
 def assign(input, output=None):
     helper = LayerHelper("assign")
     if output is None:
-        output = helper.create_tmp_variable(
-            dtype=input.dtype if isinstance(input, Variable) else "float32")
+        if isinstance(input, Variable):
+            out_dtype = input.dtype
+        elif isinstance(input, np.ndarray):
+            out_dtype = convert_np_dtype(input.dtype)
+        else:
+            out_dtype = "float32"
+        output = helper.create_tmp_variable(dtype=out_dtype)
     if isinstance(input, Variable):
         helper.append_op(type="assign", inputs={"X": [input]},
                          outputs={"Out": [output]})

@@ -168,36 +168,55 @@ def decoder(trg_ids, trg_pos, enc_output, self_attn_bias, cross_attn_bias,
 
 
 def build_inputs(batch_size, src_len, trg_len, hp: ModelHyperParams):
-    """Declare the dense feed variables (ids/pos int32, biases float)."""
+    """Declare the dense feed variables.
+
+    Host→device traffic is the TPU bottleneck (feeds may cross DCN), so
+    only ids and [B, S] masks are fed; position ids and the [B,1,S,S]
+    additive attention biases are built IN-GRAPH as constants/cheap
+    broadcasts (unlike the reference benchmark which feeds dense
+    [B, n_head, S, S] bias tensors).
+    """
     def data(name, shape, dtype):
         return layers.data(name=name, shape=shape, dtype=dtype,
                            append_batch_size=False)
 
     src_ids = data("src_word", [batch_size, src_len], "int32")
-    src_pos = data("src_pos", [batch_size, src_len], "int32")
     trg_ids = data("trg_word", [batch_size, trg_len], "int32")
-    trg_pos = data("trg_pos", [batch_size, trg_len], "int32")
-    src_attn_bias = data("src_slf_attn_bias",
-                         [batch_size, hp.n_head, src_len, src_len],
-                         "float32")
-    trg_self_bias = data("trg_slf_attn_bias",
-                         [batch_size, hp.n_head, trg_len, trg_len],
-                         "float32")
-    trg_cross_bias = data("trg_src_attn_bias",
-                          [batch_size, hp.n_head, trg_len, src_len],
-                          "float32")
+    src_mask = data("src_mask", [batch_size, src_len], "float32")
     labels = data("lbl_word", [batch_size, trg_len], "int32")
     weights = data("lbl_weight", [batch_size, trg_len], "float32")
-    return (src_ids, src_pos, trg_ids, trg_pos, src_attn_bias,
-            trg_self_bias, trg_cross_bias, labels, weights)
+    return src_ids, trg_ids, src_mask, labels, weights
+
+
+def _position_ids(batch_size, seq_len):
+    """Constant [B, S] int32 position-id tensor (in-graph)."""
+    pos = np.tile(np.arange(seq_len, dtype="int32"), (batch_size, 1))
+    return layers.assign(pos)
+
+
+def _padding_bias(mask, batch_size, seq_len):
+    """[B,S] 1/0 mask -> [B,1,1,S] additive bias (0 keep, -1e9 drop)."""
+    neg = layers.scale(mask, scale=1e9, bias=-1e9)
+    return layers.reshape(neg, shape=[batch_size, 1, 1, seq_len])
+
+
+def _causal_bias(seq_len):
+    """[1,1,S,S] additive causal bias built from a constant table."""
+    tri = np.triu(np.full((seq_len, seq_len), -1e9, dtype="float32"), 1)
+    return layers.assign(tri.reshape(1, 1, seq_len, seq_len))
 
 
 def transformer(batch_size, src_len, trg_len, hp: ModelHyperParams = None):
     """Build the full training graph; returns (avg_cost, feed_vars)."""
     hp = hp or ModelHyperParams()
-    (src_ids, src_pos, trg_ids, trg_pos, src_attn_bias, trg_self_bias,
-     trg_cross_bias, labels, weights) = build_inputs(
+    src_ids, trg_ids, src_mask, labels, weights = build_inputs(
         batch_size, src_len, trg_len, hp)
+
+    src_pos = _position_ids(batch_size, src_len)
+    trg_pos = _position_ids(batch_size, trg_len)
+    src_attn_bias = _padding_bias(src_mask, batch_size, src_len)
+    trg_self_bias = _causal_bias(trg_len)
+    trg_cross_bias = src_attn_bias  # decoder attends to source padding
 
     enc_out = encoder(src_ids, src_pos, src_attn_bias, hp)
     dec_out = decoder(trg_ids, trg_pos, enc_out, trg_self_bias,
@@ -214,9 +233,7 @@ def transformer(batch_size, src_len, trg_len, hp: ModelHyperParams = None):
     sum_cost = layers.reduce_sum(weighted)
     token_count = layers.reduce_sum(weights2d)
     avg_cost = sum_cost / token_count
-    feeds = ["src_word", "src_pos", "trg_word", "trg_pos",
-             "src_slf_attn_bias", "trg_slf_attn_bias", "trg_src_attn_bias",
-             "lbl_word", "lbl_weight"]
+    feeds = ["src_word", "trg_word", "src_mask", "lbl_word", "lbl_weight"]
     return avg_cost, feeds
 
 
@@ -229,23 +246,12 @@ def fake_batch(batch_size, src_len, trg_len, hp: ModelHyperParams = None,
                            size=(batch_size, src_len)).astype("int32")
     trg_word = rng.randint(1, hp.trg_vocab_size,
                            size=(batch_size, trg_len)).astype("int32")
-    src_pos = np.tile(np.arange(src_len, dtype="int32"), (batch_size, 1))
-    trg_pos = np.tile(np.arange(trg_len, dtype="int32"), (batch_size, 1))
-    zeros_self = np.zeros((batch_size, hp.n_head, src_len, src_len),
-                          dtype="float32")
-    causal = np.triu(np.full((trg_len, trg_len), -1e9, dtype="float32"), 1)
-    trg_self = np.tile(causal, (batch_size, hp.n_head, 1, 1))
-    cross = np.zeros((batch_size, hp.n_head, trg_len, src_len),
-                     dtype="float32")
+    src_mask = np.ones((batch_size, src_len), dtype="float32")
     lbl_word = rng.randint(1, hp.trg_vocab_size,
                            size=(batch_size, trg_len)).astype("int32")
     lbl_weight = np.ones((batch_size, trg_len), dtype="float32")
     return {
-        "src_word": src_word, "src_pos": src_pos,
-        "trg_word": trg_word, "trg_pos": trg_pos,
-        "src_slf_attn_bias": zeros_self,
-        "trg_slf_attn_bias": trg_self,
-        "trg_src_attn_bias": cross,
+        "src_word": src_word, "trg_word": trg_word, "src_mask": src_mask,
         "lbl_word": lbl_word, "lbl_weight": lbl_weight,
     }
 

@@ -1,0 +1,504 @@
+"""Control-flow meta-ops: while, conditional_block, tensor arrays, and the
+LoD/rank-table plumbing of the reference's dynamic-RNN machinery.
+
+Reference: ``paddle/fluid/operators/while_op.cc``, ``conditional_block_op.cc``,
+``tensor_array_read_write_op.cc``, ``lod_rank_table_op.cc``,
+``lod_tensor_to_array_op.cc``, ``shrink_rnn_memory_op.cc``.
+
+TPU re-design (static shapes, functional control flow):
+  * ``while`` lowers its sub-block into ONE ``lax.while_loop`` whose carry is
+    the set of loop-written variables (including tensor arrays) — the
+    reference instead re-interprets the sub-block per iteration against a
+    StepScope (``while_op.cc`` Run loop).
+  * Tensor arrays are fixed-capacity dense buffers + a dynamic length
+    (``TensorArray`` pytree) — writes are ``lax.dynamic_update_slice`` so
+    they trace into scan/while bodies.
+  * The batch-shrinking dynamic-RNN machinery (LoDRankTable /
+    lod_tensor_to_array / shrink_rnn_memory) keeps the FULL padded batch on
+    every step and masks finished sequences instead of shrinking — dynamic
+    shapes don't exist under XLA; masking trades FLOPs for compilability
+    (the MXU is idle-tolerant, reshapes are not).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.registry import (
+    register_op, LowerContext, ShapeInferenceSkip, infer_shape_unary)
+
+DEFAULT_ARRAY_CAPACITY = 128
+
+
+# ---------------------------------------------------------------------------
+# TensorArray runtime value
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+class TensorArray:
+    """Fixed-capacity stacked buffer with a dynamic logical length.
+
+    Replaces the reference's ``LoDTensorArray`` (a growable
+    ``vector<LoDTensor>``): growth is not traceable, so capacity is fixed at
+    creation and ``length`` tracks the high-water mark.
+    """
+
+    def __init__(self, data, length):
+        self.data = data          # [capacity, *elem_shape]
+        self.length = length      # int32 scalar (possibly traced)
+
+    @property
+    def capacity(self):
+        return self.data.shape[0]
+
+    def write(self, index, value):
+        index = jnp.asarray(index, jnp.int32).reshape(())
+        value = jnp.asarray(value)
+        start = (index,) + (0,) * value.ndim
+        data = jax.lax.dynamic_update_slice(self.data, value[None], start)
+        length = jnp.maximum(self.length, index + 1)
+        return TensorArray(data, length)
+
+    def read(self, index):
+        index = jnp.asarray(index, jnp.int32).reshape(())
+        return jax.lax.dynamic_index_in_dim(self.data, index, axis=0,
+                                            keepdims=False)
+
+    @staticmethod
+    def empty(elem_shape, dtype, capacity=DEFAULT_ARRAY_CAPACITY):
+        data = jnp.zeros((capacity,) + tuple(elem_shape), dtype=dtype)
+        return TensorArray(data, jnp.asarray(0, jnp.int32))
+
+    def tree_flatten(self):
+        return (self.data, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+
+# ---------------------------------------------------------------------------
+# array read/write/length  (tensor_array_read_write_op.cc)
+# ---------------------------------------------------------------------------
+
+def _infer_skip(op, block):
+    raise ShapeInferenceSkip()
+
+
+@register_op("write_to_array", infer_shape=infer_shape_unary("X"),
+             no_grad_inputs=("I",))
+def write_to_array_lower(ctx: LowerContext):
+    x = ctx.input("X")
+    i = ctx.input("I")
+    out_name = ctx.op.output("Out")[0]
+    arr = ctx.env.get(out_name)
+    if not isinstance(arr, TensorArray):
+        cap = ctx.attr("capacity", DEFAULT_ARRAY_CAPACITY)
+        arr = TensorArray.empty(x.shape, x.dtype, cap)
+    ctx.outputs[out_name] = arr.write(i, x)
+
+
+@register_op("read_from_array", infer_shape=infer_shape_unary("X"),
+             no_grad_inputs=("I",))
+def read_from_array_lower(ctx: LowerContext):
+    arr = ctx.input("X")
+    if not isinstance(arr, TensorArray):
+        raise TypeError("read_from_array input is not a TensorArray")
+    ctx.set_output("Out", arr.read(ctx.input("I")))
+
+
+@register_op("lod_array_length", infer_shape=_infer_skip, no_gradient=True)
+def lod_array_length_lower(ctx: LowerContext):
+    arr = ctx.input("X")
+    ctx.set_output("Out", arr.length.reshape(1))
+
+
+# ---------------------------------------------------------------------------
+# while  (while_op.cc)
+# ---------------------------------------------------------------------------
+
+def _collect_written(block):
+    names = []
+    for op in block.ops:
+        for n in op.output_arg_names:
+            if n and n not in names:
+                names.append(n)
+        for a in op.attrs.values():
+            if hasattr(a, "ops"):  # nested sub-block
+                for n in _collect_written(a):
+                    if n not in names:
+                        names.append(n)
+    return names
+
+
+@register_op("while", infer_shape=_infer_skip,
+             no_grad_inputs=("Condition",))
+def while_lower(ctx: LowerContext):
+    """One functional loop over the sub-block.
+
+    Carry = condition + every sub-block-written var already present in the
+    outer env (loop state must be initialized before the loop, as in the
+    reference).  Pure temporaries recompute inside the body each iteration.
+
+    Differentiability: when a static trip bound is known (``max_iters``
+    attr, or the capacity of a TensorArray read in the body — exact for
+    DynamicRNN, whose arrays come from lod_tensor_to_array), the loop
+    lowers to a **bounded lax.scan with a done-mask**, which jax.vjp can
+    differentiate (the reference instead emits a while_grad op,
+    while_op.cc).  Otherwise it lowers to lax.while_loop (forward-only).
+    """
+    sub_block = ctx.attr("sub_block")
+    cond_name = ctx.op.input("Condition")[0]
+    written = _collect_written(sub_block)
+
+    outer_env = dict(ctx.env)
+    # snapshot for the grad op: loop carries overwrite their own names in
+    # the env, so while_grad must re-run the forward from PRE-loop values
+    ctx.aux.setdefault("env_snapshots", {}).setdefault(
+        id(sub_block), dict(ctx.env))
+    rng_key = ctx._rng_key
+    training = ctx.training
+    aux = ctx.aux
+    lower_block = aux["lower_block"]
+
+    # Tensor arrays first written INSIDE the loop (e.g. DynamicRNN output
+    # arrays) must be loop-carried: discover their shapes with one abstract
+    # body evaluation and seed empty arrays.
+    missing_arrays = [n for n in _array_outs(sub_block)
+                      if n not in outer_env]
+    if missing_arrays:
+        def probe(_):
+            env = dict(outer_env)
+            lower_block(sub_block, env, rng_key, training, dict(aux))
+            return tuple(env[n] for n in missing_arrays)
+
+        shapes = jax.eval_shape(probe, 0)
+        for n, s in zip(missing_arrays, shapes):
+            outer_env[n] = TensorArray(
+                jnp.zeros(s.data.shape, s.data.dtype),
+                jnp.asarray(0, jnp.int32))
+
+    carry_names = [cond_name] + [n for n in written
+                                 if n in outer_env and n != cond_name]
+
+    def cond_fun(carry):
+        return jnp.asarray(carry[0]).reshape(()).astype(bool)
+
+    def body_fun(carry):
+        env = dict(outer_env)
+        env.update({n: v for n, v in zip(carry_names, carry)})
+        body_aux = dict(aux)
+        lower_block(sub_block, env, rng_key, training, body_aux)
+        return tuple(env[n] for n in carry_names)
+
+    init = tuple(outer_env[n] for n in carry_names)
+
+    bound = ctx.attr("max_iters", None)
+    if bound is None:
+        bound = _static_trip_bound(sub_block, outer_env)
+
+    if bound is not None:
+        def scan_body(carry, _):
+            keep = cond_fun(carry)
+            new_carry = body_fun(carry)
+            merged = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(keep, new, old),
+                new_carry, carry)
+            return merged, None
+
+        final, _ = jax.lax.scan(scan_body, init, None, length=int(bound))
+    else:
+        final = jax.lax.while_loop(cond_fun, body_fun, init)
+    for n, v in zip(carry_names, final):
+        ctx.outputs[n] = v
+
+
+def _static_trip_bound(block, env):
+    """Max capacity over TensorArrays read in the loop body, if any."""
+    bound = None
+    for op in block.ops:
+        if op.type == "read_from_array":
+            arr = env.get(op.input("X")[0])
+            if isinstance(arr, TensorArray):
+                cap = int(arr.capacity)
+                bound = cap if bound is None else max(bound, cap)
+        for a in op.attrs.values():
+            if hasattr(a, "ops"):
+                sub = _static_trip_bound(a, env)
+                if sub is not None:
+                    bound = sub if bound is None else max(bound, sub)
+    return bound
+
+
+def _array_outs(block):
+    """Out names of write_to_array ops in ``block`` (recursively)."""
+    names = []
+    for op in block.ops:
+        if op.type == "write_to_array":
+            for n in op.output("Out"):
+                if n and n not in names:
+                    names.append(n)
+        for a in op.attrs.values():
+            if hasattr(a, "ops"):
+                for n in _array_outs(a):
+                    if n not in names:
+                        names.append(n)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# conditional_block  (conditional_block_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("conditional_block", infer_shape=_infer_skip, no_gradient=True)
+def conditional_block_lower(ctx: LowerContext):
+    """Scalar-condition branch via lax.cond.
+
+    Output vars must pre-exist in the env (their value is kept when the
+    condition is false) or they default to zeros of the true-branch shape.
+    """
+    sub_block = ctx.attr("sub_block")
+    conds = ctx.inputs("Cond") if ctx.op.input("Cond") else ctx.inputs("X")
+    pred = jnp.all(jnp.stack([jnp.asarray(c).reshape(-1).all()
+                              for c in conds]))
+    out_names = [n for n in ctx.op.output("Out") if n]
+    outer_env = dict(ctx.env)
+    aux = ctx.aux
+    lower_block = aux["lower_block"]
+    rng_key, training = ctx._rng_key, ctx.training
+
+    def run_branch(_):
+        env = dict(outer_env)
+        lower_block(sub_block, env, rng_key, training, dict(aux))
+        return tuple(env[n] for n in out_names)
+
+    def skip_branch(_):
+        outs = []
+        true_shapes = jax.eval_shape(run_branch, 0)
+        for n, sd in zip(out_names, true_shapes):
+            if n in outer_env:
+                outs.append(outer_env[n])
+            else:
+                outs.append(jnp.zeros(sd.shape, sd.dtype))
+        return tuple(outs)
+
+    results = jax.lax.cond(pred.astype(bool), run_branch, skip_branch, 0)
+    for n, v in zip(out_names, results):
+        ctx.outputs[n] = v
+
+
+# ---------------------------------------------------------------------------
+# split/merge_lod_tensor  (IfElse batch routing, split_lod_tensor_op.cc)
+# ---------------------------------------------------------------------------
+# TPU re-design: both "branches" see the FULL batch; merge selects per row
+# by the mask.  No dynamic shapes, work is masked not skipped.
+
+@register_op("split_lod_tensor", infer_shape=_infer_skip, no_gradient=True)
+def split_lod_tensor_lower(ctx: LowerContext):
+    x = ctx.input("X")
+    ctx.set_output("OutTrue", x)
+    ctx.set_output("OutFalse", x)
+
+
+@register_op("merge_lod_tensor", infer_shape=infer_shape_unary("InTrue"),
+             no_grad_inputs=("Mask",))
+def merge_lod_tensor_lower(ctx: LowerContext):
+    mask = ctx.input("Mask")
+    in_true = ctx.input("InTrue")
+    in_false = ctx.input("InFalse")
+    m = jnp.asarray(mask).reshape((-1,) + (1,) * (in_true.ndim - 1))
+    ctx.set_output("Out", jnp.where(m.astype(bool), in_true, in_false))
+
+
+# ---------------------------------------------------------------------------
+# LoD rank table machinery (lod_rank_table_op.cc, lod_tensor_to_array_op.cc)
+# ---------------------------------------------------------------------------
+
+class RankTable:
+    """Sequence (index, length) pairs sorted by decreasing length — static
+    metadata (reference ``LoDRankTable``, lod_rank_table.h)."""
+
+    def __init__(self, items):
+        self.items = list(items)  # [(orig_index, length)] sorted desc
+
+    @property
+    def lengths(self):
+        return [l for _, l in self.items]
+
+    @property
+    def indices(self):
+        return [i for i, _ in self.items]
+
+
+def _lod_to_lengths(lod, level=0):
+    splits = lod[level]
+    return [splits[i + 1] - splits[i] for i in range(len(splits) - 1)]
+
+
+@register_op("lod_rank_table", infer_shape=_infer_skip, no_gradient=True)
+def lod_rank_table_lower(ctx: LowerContext):
+    lod = ctx.input_lod("X")
+    x = ctx.input("X")
+    level = ctx.attr("level", 0)
+    if lod is None:
+        # dense [B, T, ...] input: every row has length T
+        lengths = [x.shape[1] if x.ndim > 1 else 1] * x.shape[0]
+    else:
+        lengths = _lod_to_lengths(lod, level)
+    items = sorted(enumerate(lengths), key=lambda p: -p[1])
+    table = RankTable(items)
+    out_name = ctx.op.output("Out")[0]
+    ctx.outputs[out_name] = table
+
+
+@register_op("max_sequence_len", infer_shape=_infer_skip, no_gradient=True)
+def max_sequence_len_lower(ctx: LowerContext):
+    table = ctx.input("RankTable")
+    ctx.set_output("Out", jnp.asarray([max(table.lengths)], jnp.int32))
+
+
+@register_op("lod_tensor_to_array", infer_shape=infer_shape_unary("X"),
+             no_grad_inputs=("RankTable",))
+def lod_tensor_to_array_lower(ctx: LowerContext):
+    """Ragged [sum(T_i), D] + rank table -> TensorArray of time-major
+    padded steps [t] -> [B, D] (full batch, zero-padded for finished rows).
+
+    The reference shrinks the batch at each step (sequence2batch);
+    here every step keeps the full sorted batch and finished rows are
+    zero rows — downstream ``shrink_rnn_memory`` turns into a mask.
+    """
+    x = ctx.input("X")
+    table = ctx.input("RankTable")
+    lod = ctx.input_lod("X")
+    lengths = table.lengths
+    indices = table.indices
+    max_len = max(lengths) if lengths else 0
+    batch = len(lengths)
+    feat_shape = x.shape[1:]
+
+    if lod is None:
+        # dense [B, T, ...]: reorder rows by rank table
+        steps = [x[jnp.asarray(indices), t] for t in range(max_len)]
+    else:
+        splits = lod[0]
+        rows = []
+        for t in range(max_len):
+            idxs = []
+            valid = []
+            for b, orig in enumerate(indices):
+                if t < lengths[b]:
+                    idxs.append(splits[orig] + t)
+                    valid.append(True)
+                else:
+                    idxs.append(0)
+                    valid.append(False)
+            step = x[jnp.asarray(idxs)]
+            mask = jnp.asarray(valid, x.dtype).reshape(
+                (batch,) + (1,) * (len(feat_shape)))
+            rows.append(step * mask)
+        steps = rows
+
+    data = jnp.stack(steps) if steps else jnp.zeros((0, batch) + feat_shape,
+                                                    x.dtype)
+    out_name = ctx.op.output("Out")[0]
+    ctx.outputs[out_name] = TensorArray(
+        data, jnp.asarray(max_len, jnp.int32))
+
+
+@register_op("array_to_lod_tensor", infer_shape=infer_shape_unary("X"),
+             no_grad_inputs=("RankTable",))
+def array_to_lod_tensor_lower(ctx: LowerContext):
+    """Inverse of lod_tensor_to_array: stacked [T, B, D] steps -> ragged
+    [sum(T_i), D] rows in original order (emitted LoD is the sorted-restored
+    one)."""
+    arr = ctx.input("X")
+    table = ctx.input("RankTable")
+    lengths = table.lengths
+    indices = table.indices
+    data = arr.data  # [cap, B, ...]
+    rows = []
+    for b, orig in sorted(zip(range(len(indices)), indices),
+                          key=lambda p: p[1]):
+        rows.append(data[:lengths[b], b])
+    out = jnp.concatenate(rows, axis=0) if rows else data[:0, 0]
+    ctx.set_output("Out", out)
+    restored = [0] * len(indices)
+    for b, orig in enumerate(indices):
+        restored[orig] = lengths[b]
+    splits = [0]
+    for L in restored:
+        splits.append(splits[-1] + L)
+    ctx.set_output_lod("Out", [splits])
+
+
+@register_op("shrink_rnn_memory", infer_shape=infer_shape_unary("X"),
+             no_grad_inputs=("RankTable", "I"))
+def shrink_rnn_memory_lower(ctx: LowerContext):
+    """Reference shrinks memory to the still-active prefix of the sorted
+    batch; TPU version keeps the full batch and zero-masks finished rows
+    (rank table is sorted by decreasing length, so active rows are a
+    prefix)."""
+    x = ctx.input("X")
+    table = ctx.input("RankTable")
+    i = ctx.input("I")
+    lengths = jnp.asarray(table.lengths, jnp.int32)
+    step = jnp.asarray(i).reshape(()).astype(jnp.int32)
+    active = (lengths > step).astype(x.dtype)
+    mask = active.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+    ctx.set_output("Out", x * mask)
+
+
+@register_op("reorder_lod_tensor_by_rank", infer_shape=infer_shape_unary("X"),
+             no_grad_inputs=("RankTable",))
+def reorder_lod_tensor_by_rank_lower(ctx: LowerContext):
+    x = ctx.input("X")
+    table = ctx.input("RankTable")
+    ctx.set_output("Out", x[jnp.asarray(table.indices)])
+
+
+# ---------------------------------------------------------------------------
+# recurrent (StaticRNN) — lax.scan over the sub-block
+# ---------------------------------------------------------------------------
+
+@register_op("recurrent", infer_shape=_infer_skip)
+def recurrent_lower(ctx: LowerContext):
+    """StaticRNN (reference ``recurrent_op.cc:222``): scan the sub-block
+    over the time axis.
+
+    attrs: sub_block, step_inputs (outer [B,T,D] var -> step var name),
+    memories [{pre, mem, init}], step_outputs (step var -> stacked outer
+    var).  Time axis is 1 (batch-major outer, scan internally time-major).
+    """
+    sub_block = ctx.attr("sub_block")
+    step_inputs = ctx.attr("step_inputs")      # {outer_name: step_name}
+    memories = ctx.attr("memories")            # [{pre, mem, init}]
+    step_outputs = ctx.attr("step_outputs")    # {step_name: outer_name}
+
+    xs = {sn: jnp.moveaxis(ctx.env[on], 1, 0)
+          for on, sn in step_inputs.items()}   # [T, B, D]
+    init_carry = tuple(ctx.env[m["init"]] for m in memories)
+
+    outer_env = dict(ctx.env)
+    aux = ctx.aux
+    lower_block = aux["lower_block"]
+    rng_key, training = ctx._rng_key, ctx.training
+    out_step_names = list(step_outputs)
+
+    def body(carry, x_t):
+        env = dict(outer_env)
+        for m, c in zip(memories, carry):
+            env[m["pre"]] = c
+        env.update(x_t)
+        lower_block(sub_block, env, rng_key, training, dict(aux))
+        new_carry = tuple(env[m["mem"]] for m in memories)
+        outs = tuple(env[n] for n in out_step_names)
+        return new_carry, outs
+
+    final_carry, stacked = jax.lax.scan(body, init_carry, xs)
+    for sn, outer in step_outputs.items():
+        idx = out_step_names.index(sn)
+        ctx.outputs[outer] = jnp.moveaxis(stacked[idx], 0, 1)  # [B,T,D]
+    for m, c in zip(memories, final_carry):
+        ctx.outputs[m["mem"] + "@FINAL"] = c

@@ -212,6 +212,21 @@ class LowerContext:
         names = self.op.output(slot)
         return self.block.var(names[0]) if names else None
 
+    # -- LoD (static trace-time ragged metadata) ---------------------------
+    def input_lod(self, slot):
+        names = self.op.input(slot)
+        if not names:
+            return None
+        return self.aux.get("lod", {}).get(names[0])
+
+    def var_lod(self, name):
+        return self.aux.get("lod", {}).get(name)
+
+    def set_output_lod(self, slot, lod):
+        names = self.op.output(slot)
+        if names:
+            self.aux.setdefault("lod", {})[names[0]] = lod
+
     # -- rng ---------------------------------------------------------------
     def rng_key(self):
         if self._rng_key is None:
@@ -288,11 +303,32 @@ def default_grad_maker(op, block, no_grad_set):
 # auto-vjp lowering for <type>_grad ops
 # ---------------------------------------------------------------------------
 
+def zeros_cotangent(value):
+    """Zero cotangent matching jax.vjp's convention: float0 for integer /
+    bool leaves (e.g. a TensorArray's length), zeros_like for inexact."""
+    import numpy as np
+
+    def z(x):
+        dt = jax.numpy.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype
+        if jax.numpy.issubdtype(dt, jax.numpy.inexact):
+            return jax.numpy.zeros_like(x)
+        return np.zeros(jax.numpy.shape(x), jax.dtypes.float0)
+
+    return jax.tree_util.tree_map(z, value)
+
+
 def auto_vjp_grad_lower(fwd_type):
     """Generic lowering for a grad op: jax.vjp of the forward lowering.
 
-    Works for any forward op whose lowering is a pure function of its
-    inputs+attrs (no RNG).  Integer/missing input grads are skipped.
+    The forward lowering is re-run as a function of the differentiable
+    inputs with the REAL variable names in a copy of the (backward-time)
+    env, so lowerings that consult the env / LoD metadata by name keep
+    working; XLA CSE folds the duplicated forward away.  Ops whose
+    lowering consumes env state that is overwritten in place between
+    forward and backward (e.g. ``while`` loop carries) set
+    ``save_env_snapshot`` so the forward-time env is used instead.
+    Integer/missing input grads are skipped; integer pytree leaves get
+    float0 cotangents per jax convention.
     """
     fwd_def = _REGISTRY[fwd_type]
 
@@ -317,65 +353,63 @@ def auto_vjp_grad_lower(fwd_type):
                         if not s.endswith(GRAD_SUFFIX) and s not in fwd_out_slots]
         wanted_set = {(s, i) for s, i, _ in wanted}
 
-        diff_args = []      # (slot, idx) of differentiable args
+        # forward-time env snapshot, if the forward op saved one (keyed by
+        # its sub_block identity — the only ops that need snapshots carry
+        # sub-blocks)
+        base_env = ctx.env
+        sub = op.attrs.get("sub_block")
+        if sub is not None:
+            snap = ctx.aux.get("env_snapshots", {}).get(id(sub))
+            if snap is not None:
+                base_env = snap
+
+        diff_args = []      # (slot, idx, name) of differentiable args
         primal_vals = []
-        const_env = {}      # (slot, idx) -> value for non-diff args
         for slot in fwd_in_slots:
             for i, n in enumerate(op.input(slot)):
-                val = ctx.env[n]
                 if (slot, i) in wanted_set:
-                    diff_args.append((slot, i))
-                    primal_vals.append(val)
-                else:
-                    const_env[(slot, i)] = val
-        diff_set = set(diff_args)
+                    diff_args.append((slot, i, n))
+                    primal_vals.append(base_env[n])
+
+        from paddle_tpu.framework import Operator
+        fop = Operator(ctx.block, fwd_type, {}, {}, dict(op.attrs))
+        fop.inputs = {s: list(op.inputs[s]) for s in fwd_in_slots}
+        fop.outputs = {s: list(op.inputs.get(s, [])) for s in fwd_out_slots}
+        # only outputs the forward actually produced participate in the vjp
+        # (e.g. sequence_pool's MaxIndex is absent unless pooltype==MAX)
+        out_names = [n for slot in fwd_out_slots
+                     for n in fop.outputs[slot] if n in ctx.env]
 
         def fwd_fn(*primals):
-            env = {}
-            fake_op_inputs = {}
-            k = 0
-            for slot in fwd_in_slots:
-                fake_names = []
-                for i in range(len(op.input(slot))):
-                    fname = f"__in_{slot}_{i}"
-                    fake_names.append(fname)
-                    if (slot, i) in diff_set:
-                        env[fname] = primals[k]
-                        k += 1
-                    else:
-                        env[fname] = const_env[(slot, i)]
-                fake_op_inputs[slot] = fake_names
-            # forward output arity = len of the S slot among grad-op inputs
-            fake_op_outputs = {
-                slot: [f"__out_{slot}_{i}"
-                       for i in range(len(op.inputs.get(slot, [])))]
-                for slot in fwd_out_slots}
-            from paddle_tpu.framework import Operator
-            fop = Operator(ctx.block, fwd_type, {}, {}, dict(op.attrs))
-            fop.inputs = fake_op_inputs
-            fop.outputs = fake_op_outputs
+            env = dict(base_env)
+            for (slot, i, n), v in zip(diff_args, primals):
+                env[n] = v
             fctx = LowerContext(fop, env, ctx.block, rng_key=None,
                                 training=ctx.training, aux=ctx.aux)
             fwd_def.lower(fctx)
-            return tuple(fctx.outputs[n]
-                         for slot in fwd_out_slots
-                         for n in fake_op_outputs[slot])
+            return tuple(fctx.outputs.get(n, env.get(n))
+                         for n in out_names)
 
         _, vjp_fn = jax.vjp(fwd_fn, *primal_vals)
 
-        # cotangents: Out@GRAD inputs, in fwd_out_slots order
-        cots = []
+        # cotangents: Out@GRAD inputs, in out_names order
+        grad_of_out = {}
         for slot in fwd_out_slots:
             onames = op.inputs.get(slot, [])
             gnames = op.inputs.get(slot + GRAD_SUFFIX, [])
             for i, n in enumerate(onames):
-                if i < len(gnames) and gnames[i] in ctx.env:
-                    cots.append(ctx.env[gnames[i]])
-                else:
-                    cots.append(jax.numpy.zeros_like(ctx.env[n]))
+                if i < len(gnames) and gnames[i]:
+                    grad_of_out[n] = gnames[i]
+        cots = []
+        for n in out_names:
+            g = grad_of_out.get(n)
+            if g and g in ctx.env:
+                cots.append(ctx.env[g])
+            else:
+                cots.append(zeros_cotangent(ctx.env[n]))
         grads = vjp_fn(tuple(cots))
 
-        for (slot, i), g in zip(diff_args, grads):
+        for (slot, i, n), g in zip(diff_args, grads):
             for ws, wi, gname in wanted:
                 if ws == slot and wi == i:
                     ctx.outputs[gname] = g

@@ -1,0 +1,260 @@
+"""Recurrent compute ops: lstm / gru (ragged "dynamic" form) + unit cells.
+
+Reference: ``paddle/fluid/operators/lstm_op.cc``, ``gru_op.cc``,
+``lstm_unit_op.cc``, ``gru_unit_op.cc``, fused cell kernels under
+``operators/math/detail/``.
+
+TPU re-design: the reference reorders ragged batches into
+length-descending "batch" form and launches one fused CUDA kernel per time
+step (``math/sequence2batch.h``).  Here the ragged input is padded to
+[B, T, G] with a STATIC gather table (LoD is trace-time metadata), one
+``lax.scan`` runs the whole sequence inside the compiled block, and
+finished rows are masked.  Gradients come from jax.vjp through the scan.
+
+Gate layouts follow the reference:
+  lstm Weight [H, 4H] with gate order (c, i, f, o)  — lstm_op.cc docs
+  gru  Weight [H, 3H] = [W_u | W_r | W_c]           — gru_op.cc docs
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.registry import (
+    register_op, LowerContext, ShapeInferenceSkip)
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+}
+
+
+def _infer_skip(op, block):
+    raise ShapeInferenceSkip()
+
+
+def _infer_rnn(op, block):
+    w = block.var(op.input("Weight")[0])
+    H = w.shape[0]
+    for slot in ("Hidden", "Cell"):
+        names = op.output(slot)
+        if names:
+            v = block.var(names[0])
+            v.shape = (-1, H)
+            v.dtype = w.dtype
+            x = block.var(op.input("Input")[0])
+            v.lod_level = x.lod_level
+
+
+def _infer_unit(op, block):
+    prev = block.var(op.input("C_prev" if op.input("C_prev")
+                              else "HiddenPrev")[0])
+    for slot in ("C", "H", "Hidden"):
+        names = op.output(slot)
+        if names:
+            v = block.var(names[0])
+            v.shape = prev.shape
+            v.dtype = prev.dtype
+
+
+def _lod_pad_tables(lod, is_reverse=False):
+    """Static (gather [B,T], scatter [N], lengths [B]) index tables between
+    flat ragged [N, ...] and padded [B, T, ...] layouts."""
+    splits = np.asarray(lod[-1])
+    lengths = (splits[1:] - splits[:-1]).astype(np.int64)
+    B, T = len(lengths), int(lengths.max()) if len(lengths) else 0
+    N = int(splits[-1])
+    gather = np.full((B, max(T, 1)), N, dtype=np.int32)  # N = zero-pad row
+    scatter = np.zeros(N, dtype=np.int32)
+    for b in range(B):
+        for t in range(lengths[b]):
+            src = splits[b] + t
+            slot = (lengths[b] - 1 - t) if is_reverse else t
+            gather[b, slot] = src
+            scatter[src] = b * max(T, 1) + slot
+    return gather, scatter, lengths, B, max(T, 1)
+
+
+def _to_padded(x, gather):
+    padded_src = jnp.concatenate(
+        [x, jnp.zeros((1,) + x.shape[1:], x.dtype)], axis=0)
+    return padded_src[jnp.asarray(gather)]          # [B, T, ...]
+
+
+def _to_flat(padded, scatter, B, T):
+    flat = padded.reshape((B * T,) + padded.shape[2:])
+    return flat[jnp.asarray(scatter)]
+
+
+# ---------------------------------------------------------------------------
+# lstm (layer: dynamic_lstm)
+# ---------------------------------------------------------------------------
+
+@register_op("lstm", infer_shape=_infer_rnn)
+def lstm_lower(ctx: LowerContext):
+    x = ctx.input("Input")          # [N, 4H] pre-projected
+    weight = ctx.input("Weight")    # [H, 4H]
+    bias = ctx.input("Bias")        # [1, 4H] (+3H peephole)
+    lod = ctx.input_lod("Input")
+    if lod is None:
+        raise ValueError("lstm op requires LoD on Input")
+    H = weight.shape[0]
+    use_peepholes = ctx.attr("use_peepholes", False)
+    is_reverse = ctx.attr("is_reverse", False)
+    act_gate = _ACTS[ctx.attr("gate_activation", "sigmoid")]
+    act_cell = _ACTS[ctx.attr("cell_activation", "tanh")]
+    act_cand = _ACTS[ctx.attr("candidate_activation", "tanh")]
+
+    gather, scatter, lengths, B, T = _lod_pad_tables(lod, is_reverse)
+    xp = _to_padded(x, gather)                      # [B, T, 4H]
+    xp = jnp.moveaxis(xp, 1, 0)                     # [T, B, 4H]
+    len_arr = jnp.asarray(lengths)
+
+    gate_bias = bias[:, :4 * H] if bias is not None else 0.0
+    if use_peepholes:
+        w_ic = bias[:, 4 * H:5 * H]
+        w_fc = bias[:, 5 * H:6 * H]
+        w_oc = bias[:, 6 * H:7 * H]
+
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    h_init = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev, t = carry
+        x_t = inp
+        gates = x_t + h_prev @ weight + gate_bias
+        g_c, g_i, g_f, g_o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            g_i = g_i + c_prev * w_ic
+            g_f = g_f + c_prev * w_fc
+        i = act_gate(g_i)
+        f = act_gate(g_f)
+        cand = act_cand(g_c)
+        c = f * c_prev + i * cand
+        if use_peepholes:
+            g_o = g_o + c * w_oc
+        o = act_gate(g_o)
+        h = o * act_cell(c)
+        mask = (t < len_arr).astype(x.dtype)[:, None]
+        h = mask * h + (1 - mask) * h_prev
+        c = mask * c + (1 - mask) * c_prev
+        return (h, c, t + 1), (h, c)
+
+    (_, _, _), (hs, cs) = jax.lax.scan(
+        step, (h_init, c_init, jnp.asarray(0, jnp.int32)), xp)
+    hs = jnp.moveaxis(hs, 0, 1)                     # [B, T, H]
+    cs = jnp.moveaxis(cs, 0, 1)
+    ctx.set_output("Hidden", _to_flat(hs, scatter, B, T))
+    ctx.set_output("Cell", _to_flat(cs, scatter, B, T))
+    ctx.set_output_lod("Hidden", [list(l) for l in lod])
+    ctx.set_output_lod("Cell", [list(l) for l in lod])
+
+
+# ---------------------------------------------------------------------------
+# gru (layer: dynamic_gru)
+# ---------------------------------------------------------------------------
+
+@register_op("gru", infer_shape=_infer_rnn)
+def gru_lower(ctx: LowerContext):
+    x = ctx.input("Input")          # [N, 3H]
+    weight = ctx.input("Weight")    # [H, 3H] = [W_u | W_r | W_c]
+    bias = ctx.input("Bias")        # [1, 3H]
+    lod = ctx.input_lod("Input")
+    if lod is None:
+        raise ValueError("gru op requires LoD on Input")
+    H = weight.shape[0]
+    is_reverse = ctx.attr("is_reverse", False)
+    act_gate = _ACTS[ctx.attr("gate_activation", "sigmoid")]
+    act_cand = _ACTS[ctx.attr("activation", "tanh")]
+
+    w_ur = weight[:, :2 * H]
+    w_c = weight[:, 2 * H:]
+    gather, scatter, lengths, B, T = _lod_pad_tables(lod, is_reverse)
+    xp = jnp.moveaxis(_to_padded(x, gather), 1, 0)  # [T, B, 3H]
+    len_arr = jnp.asarray(lengths)
+
+    if bias is not None:
+        xp = xp + bias
+
+    h0 = ctx.input("H0")
+    h_init = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+
+    def step(carry, inp):
+        h_prev, t = carry
+        x_t = inp
+        g_ur = x_t[:, :2 * H] + h_prev @ w_ur
+        u = act_gate(g_ur[:, :H])
+        r = act_gate(g_ur[:, H:])
+        cand = act_cand(x_t[:, 2 * H:] + (r * h_prev) @ w_c)
+        h = u * h_prev + (1 - u) * cand
+        mask = (t < len_arr).astype(x.dtype)[:, None]
+        h = mask * h + (1 - mask) * h_prev
+        return (h, t + 1), h
+
+    (_, _), hs = jax.lax.scan(step, (h_init, jnp.asarray(0, jnp.int32)), xp)
+    hs = jnp.moveaxis(hs, 0, 1)
+    ctx.set_output("Hidden", _to_flat(hs, scatter, B, T))
+    ctx.set_output_lod("Hidden", [list(l) for l in lod])
+
+
+# ---------------------------------------------------------------------------
+# single-step cells
+# ---------------------------------------------------------------------------
+
+@register_op("lstm_unit", infer_shape=_infer_unit)
+def lstm_unit_lower(ctx: LowerContext):
+    """One LSTM step (reference lstm_unit_op.cc): X [B,4H] pre-projected,
+    C_prev [B,H] -> C, H.  Gate order (i, g, f, o) per the reference CUDA
+    kernel."""
+    x = ctx.input("X")
+    c_prev = ctx.input("C_prev")
+    forget_bias = ctx.attr("forget_bias", 0.0)
+    H = c_prev.shape[-1]
+    i, g, f, o = (x[:, :H], x[:, H:2 * H], x[:, 2 * H:3 * H], x[:, 3 * H:])
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    o = jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    ctx.set_output("C", c)
+    ctx.set_output("H", h)
+
+
+@register_op("gru_unit", infer_shape=_infer_unit)
+def gru_unit_lower(ctx: LowerContext):
+    """One GRU step (reference gru_unit_op.cc)."""
+    x = ctx.input("Input")          # [B, 3H]
+    h_prev = ctx.input("HiddenPrev")
+    weight = ctx.input("Weight")    # [H, 3H]
+    bias = ctx.input("Bias")
+    act_gate = _ACTS[{1: "sigmoid", 2: "tanh", 0: "identity",
+                      3: "relu"}.get(ctx.attr("gate_activation", 1),
+                                     "sigmoid")] \
+        if isinstance(ctx.attr("gate_activation", 1), int) \
+        else _ACTS[ctx.attr("gate_activation", "sigmoid")]
+    act_cand = _ACTS[{1: "sigmoid", 2: "tanh", 0: "identity",
+                      3: "relu"}.get(ctx.attr("activation", 2), "tanh")] \
+        if isinstance(ctx.attr("activation", 2), int) \
+        else _ACTS[ctx.attr("activation", "tanh")]
+    H = h_prev.shape[-1]
+    if bias is not None:
+        x = x + bias
+    w_ur = weight[:, :2 * H]
+    w_c = weight[:, 2 * H:]
+    g_ur = x[:, :2 * H] + h_prev @ w_ur
+    u = act_gate(g_ur[:, :H])
+    r = act_gate(g_ur[:, H:])
+    reset_h = r * h_prev
+    cand = act_cand(x[:, 2 * H:] + reset_h @ w_c)
+    h = u * h_prev + (1 - u) * cand
+    ctx.set_output("Gate", jnp.concatenate([u, r, cand], axis=-1))
+    ctx.set_output("ResetHiddenPrev", reset_h)
+    ctx.set_output("Hidden", h)

@@ -58,9 +58,14 @@ class ParallelExecutor(Executor):
 
     # -- sharding-aware compile ----------------------------------------
     def _get_compiled(self, program, block, feed_arrays, fetch_names, scope):
+        from paddle_tpu.executor import _freeze_lod
+        feed_lods = tuple(sorted(
+            (n, _freeze_lod(scope.find_lod(n))) for n in feed_arrays
+            if scope.find_lod(n) is not None))
         sig = ("pexe", id(program), program._version, block.idx,
                tuple(sorted((n, str(a.dtype), a.shape)
                             for n, a in feed_arrays.items())),
+               feed_lods,
                fetch_names)
         if sig in self._cache:
             return self._cache[sig]
@@ -86,6 +91,8 @@ class ParallelExecutor(Executor):
             repl,  # rng key
         )
         training = not program._is_inference
+        lod_map = {n: [list(level) for level in lod]
+                   for n, lod in feed_lods}
 
         def step(feeds, ro_state, inout_state, rng_key):
             env = {}
@@ -93,7 +100,8 @@ class ParallelExecutor(Executor):
             env.update(ro_state)
             env.update(inout_state)
             aux = {"rng_counter": 0, "scope": scope,
-                   "lower_block": lower_block, "mesh": mesh}
+                   "lower_block": lower_block, "mesh": mesh,
+                   "lod": dict(lod_map)}
             lower_block(block, env, rng_key, training, aux)
             fetches = [env[n] for n in fetch_names]
             new_state = {}
@@ -111,13 +119,18 @@ class ParallelExecutor(Executor):
                          donate_argnums=(2,))
         feed_shardings = in_shardings[0]
 
+        def place(a, sharding):
+            # skip the device_put dispatch when already placed (state is
+            # sharded after the first step; only feeds arrive fresh)
+            if getattr(a, "sharding", None) == sharding:
+                return a
+            return jax.device_put(a, sharding)
+
         def fn(feeds, ro_state, inout_state, rng_key):
-            # place args against the mesh (no-op once state is sharded)
-            feeds = {n: jax.device_put(a, feed_shardings[n])
+            feeds = {n: place(a, feed_shardings[n])
                      for n, a in feeds.items()}
-            ro_state = {n: jax.device_put(a, repl)
-                        for n, a in ro_state.items()}
-            inout_state = {n: jax.device_put(a, repl)
+            ro_state = {n: place(a, repl) for n, a in ro_state.items()}
+            inout_state = {n: place(a, repl)
                            for n, a in inout_state.items()}
             rng_key = jax.device_put(rng_key, repl)
             return jitted(feeds, ro_state, inout_state, rng_key)
@@ -132,11 +145,8 @@ class ParallelExecutor(Executor):
 
 
 def _replicated_tree(repl):
-    class _AllRepl:
-        def __getitem__(self, k):
-            return repl
-    # out_shardings for a dict pytree: jax accepts a matching dict or a
-    # single sharding broadcast to all leaves
+    # out_shardings for a dict pytree: a single sharding broadcasts to all
+    # leaves
     return repl
 
 

@@ -67,7 +67,13 @@ class Scope:
 
     # -- LoD metadata ------------------------------------------------------
     def set_lod(self, name, lod):
-        self._lod[name] = lod
+        if lod is None:
+            s = self
+            while s is not None:
+                s._lod.pop(name, None)
+                s = s.parent
+        else:
+            self._lod[name] = lod
 
     def find_lod(self, name):
         s = self

@@ -1,0 +1,220 @@
+"""Control-flow tests (mirror reference tests/unittests/test_while_op.py,
+test_recurrent_op.py, test_dyn_rnn.py, test_array_read_write.py,
+test_switch.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+
+
+def _run(feed, fetch, main=None, startup=True):
+    exe = fluid.Executor()
+    if startup:
+        exe.run(fluid.default_startup_program())
+    return exe.run(main or fluid.default_main_program(), feed=feed,
+                   fetch_list=fetch)
+
+
+class TestArrayReadWrite:
+    def test_read_write(self):
+        x = layers.data(name="x", shape=[3, 4], append_batch_size=False)
+        i = layers.zeros(shape=[1], dtype="int32")
+        arr = layers.array_write(x, i)
+        i2 = layers.fill_constant(shape=[1], dtype="int32", value=1)
+        arr = layers.array_write(layers.scale(x, scale=2.0), i2, array=arr)
+        a0 = layers.array_read(arr, i)
+        a1 = layers.array_read(arr, i2)
+        total = layers.sums(input=[a0, a1])
+        n = layers.array_length(arr)
+
+        xv = np.random.rand(3, 4).astype("float32")
+        t, ln = _run({"x": xv}, [total, n], startup=False)
+        np.testing.assert_allclose(t, xv * 3.0, rtol=1e-5)
+        assert int(ln[0]) == 2
+
+
+class TestWhile:
+    def test_while_sum(self):
+        # sum three data tensors accumulated through a while loop
+        d0 = layers.data(name="d0", shape=[10], append_batch_size=False)
+        d1 = layers.data(name="d1", shape=[10], append_batch_size=False)
+        d2 = layers.data(name="d2", shape=[10], append_batch_size=False)
+        i = layers.zeros(shape=[1], dtype="int32")
+        i.stop_gradient = True
+        init = layers.zeros(shape=[10], dtype="float32")
+        mem_array = layers.array_write(x=init, i=i)
+        data_array = layers.array_write(x=d0, i=i)
+        i = layers.increment(i)
+        layers.array_write(d1, i, array=data_array)
+        i = layers.increment(i)
+        layers.array_write(d2, i, array=data_array)
+        i = layers.zeros(shape=[1], dtype="int32")
+        i.stop_gradient = True
+        array_len = layers.fill_constant(shape=[1], dtype="int32", value=3)
+        array_len.stop_gradient = True
+        cond = layers.less_than(x=i, y=array_len)
+
+        w = layers.While(cond=cond)
+        with w.block():
+            d = layers.array_read(array=data_array, i=i)
+            prev = layers.array_read(array=mem_array, i=i)
+            result = layers.sums(input=[d, prev])
+            i = layers.increment(x=i, in_place=True)
+            layers.array_write(result, i=i, array=mem_array)
+            layers.less_than(x=i, y=array_len, cond=cond)
+
+        sum_result = layers.array_read(array=mem_array, i=array_len)
+
+        d0v = np.random.rand(10).astype("float32")
+        d1v = np.random.rand(10).astype("float32")
+        d2v = np.random.rand(10).astype("float32")
+        (out,) = _run({"d0": d0v, "d1": d1v, "d2": d2v}, [sum_result],
+                      startup=False)
+        np.testing.assert_allclose(out, d0v + d1v + d2v, rtol=1e-5)
+
+
+class TestStaticRNN:
+    def test_simple_accumulate(self):
+        B, T, D = 4, 5, 3
+        x = layers.data(name="x", shape=[B, T, D], append_batch_size=False)
+        x.stop_gradient = False
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(shape=[D], batch_ref=x, init_value=0.0)
+            s = layers.sums(input=[mem, xt])
+            rnn.update_memory(mem, s)
+            rnn.step_output(s)
+        out = rnn()
+        loss = layers.reduce_sum(out)
+
+        xv = np.random.rand(B, T, D).astype("float32")
+        outv, lossv = _run({"x": xv}, [out, loss], startup=False)
+        expect = np.cumsum(xv, axis=1)
+        np.testing.assert_allclose(outv, expect, rtol=1e-4)
+
+    def test_static_rnn_grad(self):
+        B, T, D, H = 2, 3, 4, 4
+        x = layers.data(name="x", shape=[B, T, D], append_batch_size=False)
+        x.stop_gradient = False
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(shape=[H], batch_ref=x, init_value=0.0)
+            h = layers.fc(input=[xt, mem], size=H, act="tanh")
+            rnn.update_memory(mem, h)
+            rnn.step_output(h)
+        out = rnn()
+        loss = layers.reduce_mean(out)
+        fluid.append_backward(loss)
+
+        xv = np.random.rand(B, T, D).astype("float32")
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        lossv, gx = exe.run(
+            fluid.default_main_program(), feed={"x": xv},
+            fetch_list=[loss, "x@GRAD"])
+        assert np.isfinite(lossv).all()
+        assert gx.shape == (B, T, D)
+        assert np.abs(gx).sum() > 0
+
+
+class TestIfElse:
+    def test_ifelse_merge(self):
+        x = layers.data(name="x", shape=[6, 1], append_batch_size=False)
+        zero = layers.fill_constant(shape=[6, 1], dtype="float32", value=0.0)
+        cond = layers.less_than(x=x, y=zero)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            neg = ie.input(x)
+            ie.output(layers.scale(neg, scale=-1.0))
+        with ie.false_block():
+            pos = ie.input(x)
+            ie.output(pos)
+        out = ie()
+
+        xv = np.random.randn(6, 1).astype("float32")
+        (res,) = _run({"x": xv}, [out], startup=False)
+        np.testing.assert_allclose(res, np.abs(xv), rtol=1e-5)
+
+
+class TestSwitch:
+    def test_switch_scalar(self):
+        lr = layers.create_global_var(shape=[1], value=0.0, dtype="float32",
+                                      persistable=True, name="lr")
+        one = layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+        two = layers.fill_constant(shape=[1], dtype="float32", value=2.0)
+        step = layers.data(name="step", shape=[1],
+                           append_batch_size=False)
+        sw = layers.Switch()
+        with sw.block():
+            with sw.case(layers.less_than(step, one)):
+                layers.assign(input=one, output=lr)
+            with sw.default():
+                layers.assign(input=two, output=lr)
+
+        (v,) = _run({"step": np.asarray([0.5], "float32")}, [lr])
+        assert float(v.reshape(())) == 1.0
+        (v,) = _run({"step": np.asarray([5.0], "float32")}, [lr],
+                    startup=False)
+        assert float(v.reshape(())) == 2.0
+
+
+class TestDynamicRNN:
+    def _sent_feed(self):
+        # 3 sequences of lengths 3, 2, 4; embedding dim 2
+        lod = [[0, 3, 5, 9]]
+        data = np.arange(18).reshape(9, 2).astype("float32") / 10.0
+        return data, lod
+
+    def test_drnn_accumulate(self):
+        data, lod = self._sent_feed()
+        sent = layers.data(name="sent", shape=[9, 2],
+                           append_batch_size=False, lod_level=1)
+        sent.stop_gradient = False
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(sent)
+            prev = drnn.memory(shape=[2], value=0.0)
+            s = layers.sums(input=[word, prev])
+            drnn.update_memory(prev, s)
+            drnn.output(s)
+        out = drnn()
+        last = layers.sequence_last_step(out)
+
+        exe = fluid.Executor()
+        (lastv,) = exe.run(fluid.default_main_program(),
+                           feed={"sent": (data, lod)}, fetch_list=[last])
+        # expected: per-sequence sum of word vectors
+        expect = np.stack([data[0:3].sum(0), data[3:5].sum(0),
+                           data[5:9].sum(0)])
+        np.testing.assert_allclose(lastv, expect, rtol=1e-4)
+
+    def test_drnn_train_grad(self):
+        data, lod = self._sent_feed()
+        sent = layers.data(name="sent", shape=[9, 2],
+                           append_batch_size=False, lod_level=1)
+        sent.stop_gradient = False
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(sent)
+            prev = drnn.memory(shape=[4], value=0.0)
+            h = layers.fc(input=[word, prev], size=4, act="tanh")
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        out = drnn()
+        last = layers.sequence_last_step(out)
+        loss = layers.reduce_mean(last)
+        params = fluid.append_backward(loss)
+        assert params, "no param grads generated through DynamicRNN"
+
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        fetches = [loss] + [g.name for _, g in params]
+        res = exe.run(fluid.default_main_program(),
+                      feed={"sent": (data, lod)}, fetch_list=fetches)
+        assert np.isfinite(res[0]).all()
+        grad_mag = sum(float(np.abs(g).sum()) for g in res[1:])
+        assert grad_mag > 0

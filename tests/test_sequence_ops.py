@@ -1,0 +1,243 @@
+"""Sequence (LoD) + recurrent op tests (mirror reference
+test_seq_pool.py, test_sequence_softmax_op.py, test_seq_expand.py,
+test_seq_conv.py, test_lstm_op.py, test_gru_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+
+
+LOD = [[0, 3, 5, 9]]
+N, D = 9, 4
+
+
+def _feed_x(seed=7):
+    rng = np.random.RandomState(seed)
+    return rng.rand(N, D).astype("float32")
+
+
+def _run_seq(builder, data, lod=LOD, extra_fetch=()):
+    x = layers.data(name="x", shape=[N, D], append_batch_size=False,
+                    lod_level=1)
+    x.stop_gradient = False
+    out = builder(x)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe.run(fluid.default_main_program(),
+                   feed={"x": (data, lod)},
+                   fetch_list=[out, *extra_fetch])
+
+
+class TestSequencePool:
+    def test_sum(self):
+        data = _feed_x()
+        (out,) = _run_seq(lambda x: layers.sequence_pool(x, "sum"), data)
+        expect = np.stack([data[0:3].sum(0), data[3:5].sum(0),
+                           data[5:9].sum(0)])
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_average(self):
+        data = _feed_x()
+        (out,) = _run_seq(lambda x: layers.sequence_pool(x, "average"), data)
+        expect = np.stack([data[0:3].mean(0), data[3:5].mean(0),
+                           data[5:9].mean(0)])
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_max(self):
+        data = _feed_x()
+        (out,) = _run_seq(lambda x: layers.sequence_pool(x, "max"), data)
+        expect = np.stack([data[0:3].max(0), data[3:5].max(0),
+                           data[5:9].max(0)])
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_first_last(self):
+        data = _feed_x()
+        (first,) = _run_seq(layers.sequence_first_step, data)
+        np.testing.assert_allclose(first, data[[0, 3, 5]], rtol=1e-5)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = layers.data(name="x", shape=[N, D],
+                            append_batch_size=False, lod_level=1)
+            out = layers.sequence_last_step(x)
+            exe = fluid.Executor()
+            (last,) = exe.run(main, feed={"x": (data, LOD)},
+                              fetch_list=[out])
+        np.testing.assert_allclose(last, data[[2, 4, 8]], rtol=1e-5)
+
+    def test_pool_grad(self):
+        data = _feed_x()
+        x = layers.data(name="x", shape=[N, D], append_batch_size=False,
+                        lod_level=1)
+        x.stop_gradient = False
+        out = layers.sequence_pool(x, "sum")
+        loss = layers.reduce_sum(out)
+        fluid.append_backward(loss)
+        exe = fluid.Executor()
+        (g,) = exe.run(fluid.default_main_program(),
+                       feed={"x": (data, LOD)}, fetch_list=["x@GRAD"])
+        np.testing.assert_allclose(g, np.ones_like(data), rtol=1e-5)
+
+
+class TestSequenceSoftmax:
+    def test_softmax(self):
+        rng = np.random.RandomState(0)
+        data = rng.rand(N, 1).astype("float32")
+        x = layers.data(name="x", shape=[N, 1], append_batch_size=False,
+                        lod_level=1)
+        out = layers.sequence_softmax(x)
+        exe = fluid.Executor()
+        (res,) = exe.run(fluid.default_main_program(),
+                         feed={"x": (data, LOD)}, fetch_list=[out])
+        expect = np.zeros_like(data)
+        for s, e in zip(LOD[0][:-1], LOD[0][1:]):
+            seg = np.exp(data[s:e] - data[s:e].max())
+            expect[s:e] = seg / seg.sum()
+        np.testing.assert_allclose(res, expect, rtol=1e-5)
+
+
+class TestSequenceExpand:
+    def test_expand_rows(self):
+        xd = np.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]],
+                        dtype="float32")
+        yd = _feed_x()
+        x = layers.data(name="xs", shape=[3, 2], append_batch_size=False)
+        y = layers.data(name="y", shape=[N, D], append_batch_size=False,
+                        lod_level=1)
+        out = layers.sequence_expand(x, y)
+        exe = fluid.Executor()
+        (res,) = exe.run(fluid.default_main_program(),
+                         feed={"xs": xd, "y": (yd, LOD)},
+                         fetch_list=[out])
+        expect = np.repeat(xd, [3, 2, 4], axis=0)
+        np.testing.assert_allclose(res, expect, rtol=1e-5)
+
+
+class TestSequenceConv:
+    def test_conv_shapes_and_grad(self):
+        data = _feed_x()
+        x = layers.data(name="x", shape=[N, D], append_batch_size=False,
+                        lod_level=1)
+        x.stop_gradient = False
+        out = layers.sequence_conv(x, num_filters=6, filter_size=3)
+        loss = layers.reduce_mean(out)
+        fluid.append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        res, g = exe.run(fluid.default_main_program(),
+                         feed={"x": (data, LOD)},
+                         fetch_list=[out, "x@GRAD"])
+        assert res.shape == (N, 6)
+        assert g.shape == (N, D)
+        assert np.isfinite(res).all()
+
+
+class TestDynamicLSTM:
+    def _numpy_lstm(self, x, w, b, H):
+        # gate order (c, i, f, o), no peepholes
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+        outs = np.zeros((x.shape[0], H), "float32")
+        cells = np.zeros((x.shape[0], H), "float32")
+        for s, e in zip(LOD[0][:-1], LOD[0][1:]):
+            h = np.zeros(H, "float32")
+            c = np.zeros(H, "float32")
+            for t in range(s, e):
+                g = x[t] + h @ w + b[0]
+                gc, gi, gf, go = np.split(g, 4)
+                cand = np.tanh(gc)
+                i, f, o = sig(gi), sig(gf), sig(go)
+                c = f * c + i * cand
+                h = o * np.tanh(c)
+                outs[t] = h
+                cells[t] = c
+        return outs, cells
+
+    def test_forward_matches_numpy(self):
+        H = 5
+        rng = np.random.RandomState(3)
+        data = rng.randn(N, 4 * H).astype("float32") * 0.2
+        x = layers.data(name="x", shape=[N, 4 * H],
+                        append_batch_size=False, lod_level=1)
+        hidden, cell = layers.dynamic_lstm(
+            input=x, size=4 * H, use_peepholes=False)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        scope = fluid.global_scope()
+        w = np.asarray(scope.find_var(
+            fluid.default_main_program().global_block().all_parameters()[0]
+            .name))
+        b = np.asarray(scope.find_var(
+            fluid.default_main_program().global_block().all_parameters()[1]
+            .name))
+        hv, cv = exe.run(fluid.default_main_program(),
+                         feed={"x": (data, LOD)},
+                         fetch_list=[hidden, cell])
+        eh, ec = self._numpy_lstm(data, w, b, H)
+        np.testing.assert_allclose(hv, eh, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(cv, ec, rtol=1e-4, atol=1e-5)
+
+    def test_lstm_trains(self):
+        H = 4
+        rng = np.random.RandomState(5)
+        data = rng.randn(N, D).astype("float32")
+        labels = rng.randint(0, 2, size=(3, 1)).astype("int64")
+        x = layers.data(name="x", shape=[N, D], append_batch_size=False,
+                        lod_level=1)
+        y = layers.data(name="y", shape=[3, 1], dtype="int64",
+                        append_batch_size=False)
+        proj = layers.fc(input=x, size=4 * H)
+        hidden, _ = layers.dynamic_lstm(input=proj, size=4 * H,
+                                        use_peepholes=False)
+        last = layers.sequence_last_step(hidden)
+        logits = layers.fc(input=last, size=2, act="softmax")
+        loss = layers.mean(layers.cross_entropy(input=logits, label=y))
+        opt = fluid.optimizer.Adam(learning_rate=0.05)
+        opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        losses = []
+        for _ in range(15):
+            (lv,) = exe.run(fluid.default_main_program(),
+                            feed={"x": (data, LOD), "y": labels},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+        assert losses[-1] < losses[0], losses
+
+
+class TestDynamicGRU:
+    def test_gru_runs_and_trains(self):
+        H = 4
+        rng = np.random.RandomState(11)
+        data = rng.randn(N, 3 * H).astype("float32") * 0.3
+        x = layers.data(name="x", shape=[N, 3 * H],
+                        append_batch_size=False, lod_level=1)
+        x.stop_gradient = False
+        hidden = layers.dynamic_gru(input=x, size=H)
+        loss = layers.reduce_mean(hidden)
+        fluid.append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        hv, g = exe.run(fluid.default_main_program(),
+                        feed={"x": (data, LOD)},
+                        fetch_list=[hidden, "x@GRAD"])
+        assert hv.shape == (N, H)
+        assert np.isfinite(hv).all() and np.isfinite(g).all()
+        assert np.abs(g).sum() > 0
+
+
+class TestLodLifecycle:
+    def test_dense_refeed_clears_stale_lod(self):
+        """A dense feed after a ragged feed of the same var must not reuse
+        the stale row-splits (code-review regression)."""
+        x = layers.data(name="x", shape=[4, 2], append_batch_size=False,
+                        lod_level=1)
+        out = layers.sequence_pool(x, "sum")
+        exe = fluid.Executor()
+        arr = np.arange(8).reshape(4, 2).astype("float32")
+        (r1,) = exe.run(feed={"x": (arr, [[0, 1, 4]])}, fetch_list=[out])
+        (r2,) = exe.run(feed={"x": arr}, fetch_list=[out])
+        assert r1.shape == (2, 2)
+        assert r2.shape == (4, 2)
+        np.testing.assert_allclose(r2, arr)
