@@ -25,7 +25,7 @@ __all__ = [
     "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod",
     "concat", "cast", "split", "reshape", "transpose", "expand", "pad",
     "squeeze", "unsqueeze", "gather", "scatter", "slice", "shape",
-    "prelu", "maxout", "nce", "im2sequence", "multiplex", "row_conv",
+    "prelu", "maxout", "nce", "im2sequence", "multiplex", "row_conv", "fused_attention",
     "autoincreased_step_counter", "cos_sim", "dot_product_attention",
 ]
 
@@ -755,4 +755,21 @@ def shape(input, name=None):
     out = helper.create_tmp_variable("int64")
     helper.append_op(type="shape", inputs={"Input": [input]},
                      outputs={"Out": [out]})
+    return out
+
+
+def fused_attention(q, k, v, k_mask=None, causal=False, scale=1.0,
+                    use_flash=True, name=None):
+    """Fused scaled-dot-product attention over [B, H, S, D] tensors
+    (Pallas flash kernel on TPU; see ops/attention_ops.py).  ``k_mask`` is
+    [B, S_k] with 1 = attend."""
+    helper = LayerHelper("scaled_dot_product_attention", name=name)
+    out = helper.create_tmp_variable(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if k_mask is not None:
+        inputs["KMask"] = [k_mask]
+    helper.append_op(type="scaled_dot_product_attention", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"causal": causal, "scale": float(scale),
+                            "use_flash": use_flash})
     return out

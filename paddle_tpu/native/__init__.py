@@ -1,0 +1,74 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference implements its IO/runtime layer in C++ (recordio at
+``paddle/fluid/recordio/``, threaded readers under
+``paddle/fluid/operators/reader/``); this package keeps that split: the
+compute path is XLA, the data path is native code.  The shared library is
+built on first use with g++ (no pybind11 in the image — flat C ABI +
+ctypes) and cached next to the sources.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "recordio.cpp")
+_LIB = os.path.join(_DIR, "libpaddletpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_error = None
+
+
+def _build():
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+           "-o", _LIB, "-lz", "-lpthread"]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load():
+    """Build (if needed) and load the native library; returns None when a
+    toolchain is unavailable (callers fall back to pure Python)."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            if (not os.path.exists(_LIB) or
+                    os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+        except Exception as e:  # pragma: no cover - toolchain missing
+            _build_error = e
+            return None
+        lib.recio_writer_open.restype = ctypes.c_void_p
+        lib.recio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                          ctypes.c_uint32]
+        lib.recio_writer_write.restype = ctypes.c_int
+        lib.recio_writer_write.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_char_p,
+                                           ctypes.c_uint32]
+        lib.recio_writer_close.restype = ctypes.c_int
+        lib.recio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.recio_scanner_open.restype = ctypes.c_void_p
+        lib.recio_scanner_open.argtypes = [ctypes.c_char_p]
+        lib.recio_scanner_next.restype = ctypes.c_int
+        lib.recio_scanner_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint32)]
+        lib.recio_scanner_close.argtypes = [ctypes.c_void_p]
+        lib.recio_loader_open.restype = ctypes.c_void_p
+        lib.recio_loader_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint32]
+        lib.recio_loader_next.restype = ctypes.c_int
+        lib.recio_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint32)]
+        lib.recio_loader_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib

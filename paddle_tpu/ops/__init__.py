@@ -19,4 +19,5 @@ from paddle_tpu.ops import (  # noqa: F401
     control_flow_ops,
     sequence_ops,
     rnn_ops,
+    attention_ops,
 )

@@ -8,14 +8,24 @@ replicated.
 TPU-native realization: the SAME lowered step function as ``Executor``,
 jit-compiled with explicit shardings over a ``Mesh`` —
   feeds            -> PartitionSpec('data', ...)   (batch split over ICI)
-  params/state     -> PartitionSpec()              (replicated)
-  written state    -> PartitionSpec()              (forces XLA to insert the
-                                                    gradient all-reduce)
+  params/state     -> PartitionSpec()              (replicated), or a
+                      tensor-parallel spec from ``param_shardings``
+  written state    -> same as its input sharding (forces XLA to insert the
+                      gradient all-reduce / reduce-scatter)
 No SSA graph, no op handles, no per-device scopes: GSPMD partitions the one
 XLA computation and the collectives ride the ICI mesh.
+
+Tensor parallelism (the reference has only layer-device placement,
+``ParallelNeuralNetwork.h``): pass ``param_shardings`` as a list of
+``(regex, PartitionSpec)`` rules; the first rule matching a state var's
+name gives its spec, and GSPMD propagates through the computation
+(Megatron-style column/row splits come from the specs alone — see
+``paddle_tpu.models.transformer.tp_shardings``).
 """
 
 from __future__ import annotations
+
+import re
 
 import numpy as np
 
@@ -35,14 +45,23 @@ __all__ = ["ParallelExecutor"]
 class ParallelExecutor(Executor):
     def __init__(self, use_cuda=True, loss_name=None, main_program=None,
                  share_vars_from=None, num_threads=None, mesh=None,
-                 batch_axis=0):
+                 batch_axis=0, param_shardings=None):
         super().__init__()
         self.mesh = mesh if mesh is not None else default_mesh()
         self.loss_name = loss_name
         self.batch_axis = batch_axis
         self._main_program = main_program
+        # [(compiled regex, PartitionSpec)] — first match wins
+        self.param_shardings = [(re.compile(pat), spec)
+                                for pat, spec in (param_shardings or [])]
         if share_vars_from is not None:
             pass  # scope is global; parity no-op
+
+    def _state_sharding(self, name):
+        for pat, spec in self.param_shardings:
+            if pat.search(name):
+                return NamedSharding(self.mesh, spec)
+        return NamedSharding(self.mesh, P())
 
     @property
     def device_count(self):

@@ -1,0 +1,54 @@
+"""paddle.v2-compatible API shim (reference ``python/paddle/v2/``).
+
+The legacy v2 stack (layer DSL -> config_parser -> protobuf ModelConfig ->
+SWIG GradientMachine, W1-W4 + V1-V14 in SURVEY.md) is SUBSUMED here by a
+thin adapter: every v2 layer call builds the same Program IR the fluid
+path uses, and ``trainer.SGD`` drives the XLA Executor.  The >130k LoC of
+legacy C++ (gserver layers, math::Matrix, hl_* CUDA, trainer, pserver)
+has no separate TPU equivalent — one IR, one compiler.
+
+Usage (mirrors reference ``python/paddle/v2/__init__.py`` + README)::
+
+    import paddle_tpu.v2 as paddle
+    paddle.init(use_gpu=False, trainer_count=1)
+    images = paddle.layer.data(name='pixel',
+                               type=paddle.data_type.dense_vector(784))
+    label = paddle.layer.data(name='label',
+                              type=paddle.data_type.integer_value(10))
+    ...
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(momentum=0.9)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+    trainer.train(reader=paddle.batch(paddle.dataset.mnist.train(), 128),
+                  num_passes=5, event_handler=handler)
+"""
+
+from paddle_tpu.v2 import data_type
+from paddle_tpu.v2 import activation
+from paddle_tpu.v2 import attr
+from paddle_tpu.v2 import layer
+from paddle_tpu.v2 import networks
+from paddle_tpu.v2 import optimizer
+from paddle_tpu.v2 import parameters
+from paddle_tpu.v2 import trainer
+from paddle_tpu.v2 import event
+from paddle_tpu.v2.minibatch import batch
+from paddle_tpu.v2.inference import infer
+from paddle_tpu import dataset
+from paddle_tpu import reader
+
+__all__ = ["init", "layer", "networks", "optimizer", "parameters",
+           "trainer", "event", "batch", "infer", "dataset", "reader",
+           "data_type", "activation", "attr"]
+
+_initialized = False
+
+
+def init(**kwargs):
+    """Process init (reference ``v2/__init__.py`` init -> swig init;
+    device selection is implicit on TPU)."""
+    global _initialized
+    _initialized = True
+    return None

@@ -1,0 +1,26 @@
+"""v2 activation objects (reference ``python/paddle/v2/activation.py`` ->
+``trainer_config_helpers/activations.py``)."""
+
+
+class BaseActivation:
+    name = None
+
+
+def _mk(name_, act):
+    cls = type(name_, (BaseActivation,), {"name": act})
+    return cls
+
+
+Tanh = _mk("Tanh", "tanh")
+Sigmoid = _mk("Sigmoid", "sigmoid")
+Softmax = _mk("Softmax", "softmax")
+Relu = _mk("Relu", "relu")
+BRelu = _mk("BRelu", "brelu")
+SoftRelu = _mk("SoftRelu", "soft_relu")
+STanh = _mk("STanh", "stanh")
+Linear = _mk("Linear", None)
+Identity = Linear
+Exp = _mk("Exp", "exp")
+Log = _mk("Log", "log")
+Square = _mk("Square", "square")
+SequenceSoftmax = _mk("SequenceSoftmax", "sequence_softmax")

@@ -1,0 +1,35 @@
+"""v2 parameter/extra attributes (reference ``python/paddle/v2/attr.py``)."""
+
+from paddle_tpu.param_attr import ParamAttr as _ParamAttr
+
+
+class ParamAttr(_ParamAttr):
+    def __init__(self, name=None, initial_std=None, initial_mean=None,
+                 learning_rate=1.0, l2_rate=None, sparse_update=False,
+                 initial_max=None, initial_min=None, **kwargs):
+        from paddle_tpu import initializer, regularizer
+        init = None
+        if initial_std is not None or initial_mean is not None:
+            init = initializer.Normal(loc=initial_mean or 0.0,
+                                      scale=initial_std or 1.0)
+        elif initial_max is not None or initial_min is not None:
+            init = initializer.Uniform(low=initial_min or -1.0,
+                                       high=initial_max or 1.0)
+        reg = regularizer.L2Decay(l2_rate) if l2_rate else None
+        super().__init__(name=name, initializer=init,
+                         learning_rate=learning_rate, regularizer=reg)
+
+
+Param = ParamAttr
+
+
+class ExtraAttr:
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None):
+        self.error_clipping_threshold = error_clipping_threshold
+        self.drop_rate = drop_rate
+        self.device = device
+
+
+ExtraLayerAttribute = ExtraAttr
+Extra = ExtraAttr

@@ -1,0 +1,40 @@
+"""v2 training events (reference ``python/paddle/v2/event.py:31-101``)."""
+
+__all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration",
+           "TestResult"]
+
+
+class WithMetric:
+    def __init__(self, evaluator_metrics=None):
+        self.metrics = dict(evaluator_metrics or {})
+
+
+class BeginPass:
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id, evaluator_metrics=None):
+        super().__init__(evaluator_metrics)
+        self.pass_id = pass_id
+
+
+class BeginIteration:
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id, batch_id, cost, evaluator_metrics=None):
+        super().__init__(evaluator_metrics)
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+
+
+class TestResult(WithMetric):
+    def __init__(self, cost, evaluator_metrics=None):
+        super().__init__(evaluator_metrics)
+        self.cost = cost

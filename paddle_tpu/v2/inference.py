@@ -1,0 +1,46 @@
+"""v2 inference (reference ``python/paddle/v2/inference.py`` infer())."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.v2.trainer import _feed_converter
+
+__all__ = ["infer", "Inference"]
+
+
+class Inference:
+    def __init__(self, output_layer, parameters):
+        outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+            else [output_layer]
+        self.outputs = outputs
+        self.parameters = parameters
+        program = outputs[0].block.program
+        self.program = program.clone(for_test=True).prune(
+            [o.name for o in outputs])
+
+    def infer(self, input, feeding=None, field="value"):
+        exe = fluid.Executor()
+        self.parameters._init_once(exe)
+        block = self.program.global_block()
+        if feeding is None:
+            names = [v.name for v in block.vars.values()
+                     if getattr(v, "is_data", False)]
+            feeding = {n: i for i, n in enumerate(names)}
+        feed = {}
+        for name, col in feeding.items():
+            if not block.has_var(name):
+                continue
+            var = block.var(name)
+            column = [row[col] for row in input]
+            feed[name] = _feed_converter(var, column)
+        with fluid.scope_guard(self.parameters._scope):
+            res = exe.run(self.program, feed=feed,
+                          fetch_list=[o.name for o in self.outputs])
+        return res[0] if len(res) == 1 else res
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    return Inference(output_layer, parameters).infer(input, feeding=feeding,
+                                                     field=field)

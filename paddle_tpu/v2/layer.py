@@ -1,0 +1,181 @@
+"""v2 layer DSL -> Program IR (reference ``python/paddle/v2/layer.py`` +
+``trainer_config_helpers/layers.py``; here each call appends ops to the
+default fluid-style programs instead of emitting ModelConfig protobuf)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.layers as F
+from paddle_tpu import nets
+from paddle_tpu.v2 import data_type as dt
+from paddle_tpu.v2.activation import BaseActivation
+
+__all__ = [
+    "data", "fc", "embedding", "img_conv", "img_pool", "batch_norm",
+    "dropout", "concat", "lstmemory", "gru", "pooling", "last_seq",
+    "first_seq", "classification_cost", "cross_entropy_cost",
+    "square_error_cost", "mse_cost", "regression_cost",
+    "pooling_types",
+]
+
+
+class pooling_types:  # namespace parity (v2.pooling.Max etc. below)
+    pass
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    if isinstance(act, type) and issubclass(act, BaseActivation):
+        return act.name
+    if isinstance(act, BaseActivation):
+        return act.name
+    return act
+
+
+def data(name, type, height=None, width=None):
+    """Declare an input (reference ``v2/layer.py`` data_layer)."""
+    if type.type == dt.DataType.Index:
+        v = F.data(name=name, shape=[1], dtype="int64",
+                   lod_level=1 if type.seq_type else 0)
+    else:
+        lod = 1 if type.seq_type else 0
+        v = F.data(name=name, shape=[type.dim], dtype="float32",
+                   lod_level=lod)
+    v.v2_input_type = type
+    return v
+
+
+def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None):
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    return F.fc(input=list(ins), size=size, act=_act_name(act),
+                param_attr=param_attr, bias_attr=bias_attr, name=name)
+
+
+def embedding(input, size, param_attr=None):
+    return F.embedding(input=input, size=[_vocab_of(input), size],
+                       param_attr=param_attr)
+
+
+def _vocab_of(var):
+    t = getattr(var, "v2_input_type", None)
+    if t is None:
+        raise ValueError("embedding input must be a v2 data layer of "
+                         "integer_value type")
+    return t.dim
+
+
+def img_conv(input, filter_size, num_filters, num_channel=None, act=None,
+             padding=0, stride=1, bias_attr=None, param_attr=None,
+             name=None):
+    return F.conv2d(input=input, num_filters=num_filters,
+                    filter_size=filter_size, stride=stride,
+                    padding=padding, act=_act_name(act),
+                    bias_attr=bias_attr, param_attr=param_attr, name=name)
+
+
+def img_pool(input, pool_size, pool_type=None, stride=None, padding=0,
+             name=None):
+    ptype = getattr(pool_type, "name", pool_type) or "max"
+    return F.pool2d(input=input, pool_size=pool_size, pool_type=ptype,
+                    pool_stride=stride or pool_size,
+                    pool_padding=padding, name=name)
+
+
+def batch_norm(input, act=None, **kwargs):
+    return F.batch_norm(input=input, act=_act_name(act))
+
+
+def dropout(input, dropout_rate):
+    return F.dropout(input, dropout_prob=dropout_rate)
+
+
+def concat(input, name=None):
+    return F.concat(input=list(input), axis=1)
+
+
+def lstmemory(input, size=None, reverse=False, act=None,
+              gate_act=None, state_act=None, param_attr=None,
+              bias_attr=None, name=None):
+    """v2 lstmemory: input must be 4*size wide (pre-projected), like the
+    reference (``trainer_config_helpers/layers.py`` lstmemory)."""
+    size = size or input.shape[-1] // 4
+    hidden, _ = F.dynamic_lstm(
+        input=input, size=4 * size, is_reverse=reverse,
+        use_peepholes=True,
+        gate_activation=_act_name(gate_act) or "sigmoid",
+        cell_activation=_act_name(state_act) or "tanh",
+        candidate_activation=_act_name(act) or "tanh",
+        param_attr=param_attr, bias_attr=bias_attr)
+    return hidden
+
+
+def gru(input, size, reverse=False, act=None, gate_act=None, **kwargs):
+    return F.dynamic_gru(
+        input=input, size=size, is_reverse=reverse,
+        gate_activation=_act_name(gate_act) or "sigmoid",
+        candidate_activation=_act_name(act) or "tanh")
+
+
+grumemory = gru
+
+
+class _PoolType:
+    def __init__(self, name):
+        self.name = name
+
+
+class Max(_PoolType):
+    def __init__(self):
+        super().__init__("max")
+
+
+class Avg(_PoolType):
+    def __init__(self):
+        super().__init__("average")
+
+
+class Sum(_PoolType):
+    def __init__(self):
+        super().__init__("sum")
+
+
+def pooling(input, pooling_type=None, name=None):
+    ptype = pooling_type.name if pooling_type else "max"
+    return F.sequence_pool(input=input, pool_type=ptype)
+
+
+def last_seq(input, name=None):
+    return F.sequence_last_step(input)
+
+
+def first_seq(input, name=None):
+    return F.sequence_first_step(input)
+
+
+def classification_cost(input, label, name=None):
+    """input carries softmax output (v2 convention); adds cross-entropy +
+    tracks accuracy for the trainer's event metrics."""
+    cost = F.cross_entropy(input=input, label=label)
+    avg = F.mean(cost)
+    avg.v2_metrics = {
+        "classification_error_evaluator": _one_minus_accuracy(input, label)}
+    return avg
+
+
+def _one_minus_accuracy(input, label):
+    acc = F.accuracy(input=input, label=label)
+    return F.scale(acc, scale=-1.0, bias=1.0)
+
+
+def cross_entropy_cost(input, label, name=None):
+    return F.mean(F.cross_entropy(input=input, label=label))
+
+
+def square_error_cost(input, label, name=None):
+    return F.mean(F.square_error_cost(input=input, label=label))
+
+
+mse_cost = square_error_cost
+regression_cost = square_error_cost

@@ -1,0 +1,59 @@
+"""v2 composite networks (reference ``python/paddle/v2/networks.py`` ->
+``trainer_config_helpers/networks.py``)."""
+
+from __future__ import annotations
+
+import paddle_tpu.nets as nets
+import paddle_tpu.layers as F
+from paddle_tpu.v2 import layer as v2_layer
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "simple_lstm", "simple_gru", "bidirectional_lstm"]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, **kwargs):
+    return nets.simple_img_conv_pool(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        pool_size=pool_size, pool_stride=pool_stride,
+        act=v2_layer._act_name(act))
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0,
+                   pool_stride=1, pool_type="max", **kwargs):
+    return nets.img_conv_group(
+        input=input, conv_num_filter=conv_num_filter, pool_size=pool_size,
+        conv_padding=conv_padding, conv_filter_size=conv_filter_size,
+        conv_act=v2_layer._act_name(conv_act),
+        conv_with_batchnorm=conv_with_batchnorm,
+        conv_batchnorm_drop_rate=conv_batchnorm_drop_rate,
+        pool_stride=pool_stride, pool_type=pool_type)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, pool_type="max",
+                       act=None, **kwargs):
+    return nets.sequence_conv_pool(
+        input=input, num_filters=hidden_size, filter_size=context_len,
+        act=v2_layer._act_name(act) or "tanh", pool_type=pool_type)
+
+
+def simple_lstm(input, size, **kwargs):
+    proj = F.fc(input=input, size=size * 4)
+    hidden, _ = F.dynamic_lstm(input=proj, size=size * 4)
+    return hidden
+
+
+def simple_gru(input, size, **kwargs):
+    proj = F.fc(input=input, size=size * 3)
+    return F.dynamic_gru(input=proj, size=size)
+
+
+def bidirectional_lstm(input, size, return_concat=True, **kwargs):
+    fwd = simple_lstm(input, size)
+    proj = F.fc(input=input, size=size * 4)
+    bwd, _ = F.dynamic_lstm(input=proj, size=size * 4, is_reverse=True)
+    if return_concat:
+        return F.concat(input=[fwd, bwd], axis=1)
+    return fwd, bwd

@@ -1,0 +1,93 @@
+"""v2 optimizers -> fluid graph-op optimizers (reference
+``python/paddle/v2/optimizer.py`` wrapped SWIG ParameterUpdater; here a
+thin factory)."""
+
+from __future__ import annotations
+
+import paddle_tpu.optimizer as fopt
+
+__all__ = ["Momentum", "Adam", "Adamax", "AdaGrad", "DecayedAdaGrad",
+           "AdaDelta", "RMSProp", "Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def to_fluid(self):
+        raise NotImplementedError
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=0.9, learning_rate=1e-3, sparse=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.learning_rate = learning_rate
+
+    def to_fluid(self):
+        return fopt.Momentum(learning_rate=self.learning_rate,
+                             momentum=self.momentum)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.args = dict(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon)
+
+    def to_fluid(self):
+        return fopt.Adam(**self.args)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.args = dict(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2)
+
+    def to_fluid(self):
+        return fopt.Adamax(**self.args)
+
+
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=1e-3, epsilon=1e-6, **kwargs):
+        super().__init__(**kwargs)
+        self.args = dict(learning_rate=learning_rate, epsilon=epsilon)
+
+    def to_fluid(self):
+        return fopt.Adagrad(**self.args)
+
+
+class DecayedAdaGrad(Optimizer):
+    def __init__(self, learning_rate=1e-3, rho=0.95, epsilon=1e-6,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.args = dict(learning_rate=learning_rate, decay=rho,
+                         epsilon=epsilon)
+
+    def to_fluid(self):
+        return fopt.DecayedAdagrad(**self.args)
+
+
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.args = dict(learning_rate=learning_rate, rho=rho,
+                         epsilon=epsilon)
+
+    def to_fluid(self):
+        return fopt.Adadelta(**self.args)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=1e-3, rho=0.95, epsilon=1e-6,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.args = dict(learning_rate=learning_rate, rho=rho,
+                         epsilon=epsilon)
+
+    def to_fluid(self):
+        return fopt.RMSProp(**self.args)

@@ -1,0 +1,98 @@
+"""v2 Parameters: name->numpy view over the scope (reference
+``python/paddle/v2/parameters.py`` — there a dict over the SWIG
+GradientMachine's parameter blobs; here over the Executor scope)."""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+__all__ = ["create", "Parameters"]
+
+
+class Parameters:
+    def __init__(self, program, startup):
+        self._program = program
+        self._startup = startup
+        self._scope = fluid.Scope()
+        self._initialized = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def _init_once(self, exe=None):
+        if self._initialized:
+            return
+        exe = exe or fluid.Executor()
+        with fluid.scope_guard(self._scope):
+            exe.run(self._startup)
+        self._initialized = True
+
+    # -- dict-like ---------------------------------------------------------
+    def names(self):
+        return [p.name for p in
+                self._program.global_block().all_parameters()]
+
+    def keys(self):
+        return self.names()
+
+    def has_key(self, name):
+        return name in self.names()
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def get(self, name):
+        self._init_once()
+        v = self._scope.find_var(name)
+        if v is None:
+            raise KeyError(name)
+        return np.asarray(v)
+
+    __getitem__ = get
+
+    def set(self, name, value):
+        self._init_once()
+        self._scope.set_var(name, np.asarray(value))
+
+    __setitem__ = set
+
+    def get_shape(self, name):
+        return tuple(self._program.global_block().var(name).shape)
+
+    # -- serialization (reference to_tar/from_tar) -------------------------
+    def to_tar(self, f):
+        self._init_once()
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self.names():
+                buf = io.BytesIO()
+                np.save(buf, self.get(name))
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+
+    @staticmethod
+    def from_tar(f):
+        """Returns a plain dict name->ndarray; pass to ``init_from``."""
+        out = {}
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for m in tar.getmembers():
+                out[m.name] = np.load(
+                    io.BytesIO(tar.extractfile(m).read()))
+        return out
+
+    def init_from_tar(self, f):
+        for name, arr in Parameters.from_tar(f).items():
+            if self.has_key(name):
+                self.set(name, arr)
+
+
+def create(cost):
+    """Build Parameters for the model that produces ``cost``
+    (reference ``parameters.py`` create -> from proto)."""
+    program = cost.block.program
+    startup = fluid.default_startup_program()
+    return Parameters(program, startup)

@@ -1,0 +1,106 @@
+"""v2 SGD trainer loop (reference ``python/paddle/v2/trainer.py:37``:
+SGD.train drives GradientMachine.forwardBackward; here it appends the
+optimizer to the cost's program once and drives the XLA Executor)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.v2 import event as v2_event
+from paddle_tpu.v2 import data_type as dt
+
+__all__ = ["SGD"]
+
+
+def _feed_converter(var, column):
+    """Convert a v2 minibatch column per the data layer's input type."""
+    t = getattr(var, "v2_input_type", None)
+    if t is not None and t.type == dt.DataType.Index:
+        if t.seq_type:
+            flat, splits = [], [0]
+            for seq in column:
+                flat.extend(int(v) for v in seq)
+                splits.append(len(flat))
+            return (np.asarray(flat, "int64").reshape(-1, 1), [splits])
+        return np.asarray([[int(v)] for v in column], "int64")
+    if t is not None and t.seq_type:
+        flat, splits = [], [0]
+        for seq in column:
+            flat.extend(seq)
+            splits.append(len(flat))
+        return (np.asarray(flat, "float32"), [splits])
+    return np.asarray(column, "float32")
+
+
+class SGD:
+    """reference ``v2/trainer.py`` SGD: cost + parameters + update rule."""
+
+    def __init__(self, cost, parameters, update_equation,
+                 extra_layers=None, is_local=True):
+        self.__metrics = dict(getattr(cost, "v2_metrics", {}))
+        self.cost = cost
+        self.parameters = parameters
+        self.program = cost.block.program
+        self.test_program = self.program.clone(for_test=True)
+        with fluid.program_guard(self.program,
+                                 parameters._startup):
+            self.optimizer = update_equation.to_fluid()
+            self.optimizer.minimize(cost)
+        self.exe = fluid.Executor()
+
+    def _feed(self, data_batch, feeding):
+        block = self.program.global_block()
+        if feeding is None:
+            # column order = declaration order of data vars
+            names = [v.name for v in block.vars.values()
+                     if getattr(v, "is_data", False)]
+            feeding = {n: i for i, n in enumerate(names)}
+        feed = {}
+        for name, col in feeding.items():
+            var = block.var(name)
+            column = [row[col] for row in data_batch]
+            feed[name] = _feed_converter(var, column)
+        return feed
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        if event_handler is None:
+            event_handler = lambda e: None
+        self.parameters._init_once(self.exe)
+        fetches = [self.cost.name] + list(self.__metrics.values())
+        metric_names = list(self.__metrics)
+        with fluid.scope_guard(self.parameters._scope):
+            for pass_id in range(num_passes):
+                event_handler(v2_event.BeginPass(pass_id))
+                metrics = {}
+                for batch_id, data_batch in enumerate(reader()):
+                    event_handler(
+                        v2_event.BeginIteration(pass_id, batch_id))
+                    res = self.exe.run(
+                        self.program,
+                        feed=self._feed(data_batch, feeding),
+                        fetch_list=fetches)
+                    cost = float(np.asarray(res[0]).reshape(()))
+                    metrics = {n: float(np.asarray(v).reshape(()))
+                               for n, v in zip(metric_names, res[1:])}
+                    event_handler(v2_event.EndIteration(
+                        pass_id, batch_id, cost, metrics))
+                event_handler(v2_event.EndPass(pass_id, metrics))
+
+    def test(self, reader, feeding=None):
+        self.parameters._init_once(self.exe)
+        fetches = [self.cost.name] + list(self.__metrics.values())
+        metric_names = list(self.__metrics)
+        costs, counts = [], 0
+        metrics_sum = {n: 0.0 for n in metric_names}
+        with fluid.scope_guard(self.parameters._scope):
+            for data_batch in reader():
+                res = self.exe.run(self.test_program,
+                                   feed=self._feed(data_batch, feeding),
+                                   fetch_list=fetches)
+                costs.append(float(np.asarray(res[0]).reshape(())))
+                for n, v in zip(metric_names, res[1:]):
+                    metrics_sum[n] += float(np.asarray(v).reshape(()))
+                counts += 1
+        metrics = {n: s / max(counts, 1) for n, s in metrics_sum.items()}
+        return v2_event.TestResult(float(np.mean(costs)), metrics)

@@ -1,0 +1,59 @@
+"""Book test: CIFAR-10 image classification with resnet_cifar10 and a
+small VGG (reference
+``python/paddle/fluid/tests/book/test_image_classification.py``)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from paddle_tpu.models.resnet import resnet_cifar10
+
+
+def _vgg_tiny(input):
+    conv = fluid.nets.img_conv_group(
+        input=input, pool_size=2, pool_stride=2, conv_num_filter=[16, 16],
+        conv_filter_size=3, conv_act="relu", conv_with_batchnorm=True,
+        conv_batchnorm_drop_rate=[0.0, 0.0], pool_type="max")
+    fc1 = layers.fc(input=conv, size=64, act=None)
+    bn = layers.batch_norm(input=fc1, act="relu")
+    return layers.fc(input=bn, size=64, act=None)
+
+
+@pytest.mark.parametrize("net", ["resnet", "vgg"])
+def test_image_classification(net):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        images = layers.data(name="pixel", shape=[3, 32, 32],
+                             dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        if net == "resnet":
+            predict = resnet_cifar10(images, 10, depth=8)
+        else:
+            body = _vgg_tiny(images)
+            predict = layers.fc(input=body, size=10, act="softmax")
+        cost = layers.cross_entropy(input=predict, label=label)
+        avg_cost = layers.mean(cost)
+        acc = layers.accuracy(input=predict, label=label)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    reader = fluid.dataset.cifar.train10()
+    batch, accs, steps = [], [], 0
+    for sample in reader():
+        batch.append(sample)
+        if len(batch) < 32:
+            continue
+        imgs = np.stack([b[0].reshape(3, 32, 32) for b in batch]) \
+            .astype("float32")
+        labels = np.asarray([[b[1]] for b in batch], dtype="int64")
+        batch = []
+        _, a = exe.run(main, feed={"pixel": imgs, "label": labels},
+                       fetch_list=[avg_cost, acc])
+        accs.append(float(np.asarray(a).reshape(())))
+        steps += 1
+        if steps >= 40:
+            break
+    assert np.mean(accs[-8:]) > 0.5, np.mean(accs[-8:])

@@ -1,0 +1,61 @@
+"""Book test: MovieLens rating regression converges
+(reference ``python/paddle/fluid/tests/book/test_recommender_system.py``)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+
+ml = fluid.dataset.movielens
+
+
+def test_recommender_system():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        uid = layers.data(name="user_id", shape=[1], dtype="int64")
+        gender = layers.data(name="gender_id", shape=[1], dtype="int64")
+        age = layers.data(name="age_id", shape=[1], dtype="int64")
+        job = layers.data(name="job_id", shape=[1], dtype="int64")
+        mid = layers.data(name="movie_id", shape=[1], dtype="int64")
+        label = layers.data(name="score", shape=[1], dtype="float32")
+
+        usr_emb = layers.embedding(input=uid, size=[ml.max_user_id() + 1, 32])
+        usr_gender = layers.embedding(input=gender, size=[2, 8])
+        usr_age = layers.embedding(input=age, size=[len(ml.age_table), 8])
+        usr_job = layers.embedding(input=job, size=[ml.max_job_id() + 1, 8])
+        usr_combined = layers.fc(
+            input=[usr_emb, usr_gender, usr_age, usr_job], size=64,
+            act="tanh")
+
+        mov_emb = layers.embedding(input=mid,
+                                   size=[ml.max_movie_id() + 1, 32])
+        mov_combined = layers.fc(input=mov_emb, size=64, act="tanh")
+
+        inference = layers.cos_sim(X=usr_combined, Y=mov_combined)
+        scale_infer = layers.scale(x=inference, scale=5.0)
+        cost = layers.square_error_cost(input=scale_infer, label=label)
+        avg_cost = layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    batch, losses = [], []
+    for sample in ml.train()():
+        batch.append(sample)
+        if len(batch) < 64:
+            continue
+        feed = {
+            "user_id": np.asarray([[b[0]] for b in batch], "int64"),
+            "gender_id": np.asarray([[b[1]] for b in batch], "int64"),
+            "age_id": np.asarray([[b[2]] for b in batch], "int64"),
+            "job_id": np.asarray([[b[3]] for b in batch], "int64"),
+            "movie_id": np.asarray([[b[4]] for b in batch], "int64"),
+            "score": np.asarray([[b[7]] for b in batch], "float32"),
+        }
+        batch = []
+        (lv,) = exe.run(main, feed=feed, fetch_list=[avg_cost])
+        losses.append(float(np.asarray(lv).reshape(())))
+    # must beat predicting the global mean (variance of scores ~ 0.5)
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.6, (
+        np.mean(losses[:5]), np.mean(losses[-5:]))

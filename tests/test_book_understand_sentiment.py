@@ -1,0 +1,91 @@
+"""Book test: IMDB sentiment via conv-pool and stacked-LSTM nets
+(reference ``python/paddle/fluid/tests/book/test_understand_sentiment.py``,
+``benchmark/fluid/stacked_dynamic_lstm.py``)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+
+
+CLIP_LEN = 24  # fixed length => one compiled executable (bucketing)
+BATCH = 16
+EMB = 32
+HID = 32
+
+
+def _batches(n_batches):
+    dict_dim = fluid.dataset.imdb._VOCAB
+    reader = fluid.dataset.imdb.train()
+    ids, labels = [], []
+    for sample, label in reader():
+        if len(sample) < CLIP_LEN:
+            continue
+        ids.append(sample[:CLIP_LEN])
+        labels.append(label)
+        if len(ids) == BATCH:
+            flat = np.asarray(ids, "int64").reshape(-1, 1)
+            lod = [list(range(0, BATCH * CLIP_LEN + 1, CLIP_LEN))]
+            yield flat, lod, np.asarray(labels, "int64").reshape(-1, 1)
+            ids, labels = [], []
+            n_batches -= 1
+            if n_batches == 0:
+                return
+
+
+def _convolution_net(data, label, dict_dim):
+    emb = layers.embedding(input=data, size=[dict_dim, EMB])
+    conv3 = fluid.nets.sequence_conv_pool(
+        input=emb, num_filters=HID, filter_size=3, act="tanh",
+        pool_type="sqrt")
+    conv4 = fluid.nets.sequence_conv_pool(
+        input=emb, num_filters=HID, filter_size=4, act="tanh",
+        pool_type="sqrt")
+    prediction = layers.fc(input=[conv3, conv4], size=2, act="softmax")
+    cost = layers.cross_entropy(input=prediction, label=label)
+    return layers.mean(cost), layers.accuracy(input=prediction, label=label)
+
+
+def _stacked_lstm_net(data, label, dict_dim, stacked_num=3):
+    emb = layers.embedding(input=data, size=[dict_dim, EMB])
+    fc1 = layers.fc(input=emb, size=HID * 4)
+    lstm1, cell1 = layers.dynamic_lstm(input=fc1, size=HID * 4,
+                                       use_peepholes=False)
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = layers.fc(input=inputs, size=HID * 4)
+        lstm, cell = layers.dynamic_lstm(
+            input=fc, size=HID * 4, is_reverse=(i % 2) == 0,
+            use_peepholes=False)
+        inputs = [fc, lstm]
+    fc_last = layers.sequence_pool(input=inputs[0], pool_type="max")
+    lstm_last = layers.sequence_pool(input=inputs[1], pool_type="max")
+    prediction = layers.fc(input=[fc_last, lstm_last], size=2,
+                           act="softmax")
+    cost = layers.cross_entropy(input=prediction, label=label)
+    return layers.mean(cost), layers.accuracy(input=prediction, label=label)
+
+
+@pytest.mark.parametrize("net", ["conv", "stacked_lstm"])
+def test_understand_sentiment(net):
+    dict_dim = fluid.dataset.imdb._VOCAB
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = layers.data(name="words", shape=[-1, 1], dtype="int64",
+                           append_batch_size=False, lod_level=1)
+        label = layers.data(name="label", shape=[-1, 1], dtype="int64",
+                            append_batch_size=False)
+        builder = _convolution_net if net == "conv" else _stacked_lstm_net
+        avg_cost, acc = builder(data, label, dict_dim)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    accs = []
+    n = 60 if net == "conv" else 40
+    for flat, lod, lab in _batches(n):
+        _, a = exe.run(main, feed={"words": (flat, lod), "label": lab},
+                       fetch_list=[avg_cost, acc])
+        accs.append(float(np.asarray(a).reshape(())))
+    assert np.mean(accs[-8:]) > 0.8, np.mean(accs[-8:])

@@ -1,0 +1,56 @@
+"""Book test: word2vec n-gram model converges
+(reference ``python/paddle/fluid/tests/book/test_word2vec.py``)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+EMB = 32
+N = 5  # context words
+
+
+def test_word2vec():
+    dict_size = fluid.dataset.imikolov.N_WORDS
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+                 for i in range(N - 1)]
+        target = fluid.layers.data(name="target", shape=[1], dtype="int64")
+        embs = [fluid.layers.embedding(
+            input=w, size=[dict_size, EMB],
+            param_attr=fluid.ParamAttr(name="shared_emb"))
+            for w in words]
+        concat = fluid.layers.concat(input=embs, axis=1)
+        hidden = fluid.layers.fc(input=concat, size=128, act="sigmoid")
+        predict = fluid.layers.fc(input=hidden, size=dict_size,
+                                  act="softmax")
+        cost = fluid.layers.cross_entropy(input=predict, label=target)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=2e-2).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    def ngrams():
+        for sent in fluid.dataset.imikolov._synthetic_sentences("train",
+                                                                1500):
+            for i in range(len(sent) - N + 1):
+                yield sent[i:i + N]
+
+    batch, losses, steps = [], [], 0
+    for gram in ngrams():
+        batch.append(gram)
+        if len(batch) < 64:
+            continue
+        arr = np.asarray(batch, dtype="int64")
+        batch = []
+        feed = {f"w{i}": arr[:, i:i + 1] for i in range(N - 1)}
+        feed["target"] = arr[:, N - 1:N]
+        (lv,) = exe.run(main, feed=feed, fetch_list=[avg_cost])
+        losses.append(float(np.asarray(lv).reshape(())))
+        steps += 1
+        if steps >= 500:
+            break
+    # markov-chain data is predictable: loss must fall well below uniform
+    assert losses[-1] < losses[0] - 1.0, (losses[0], losses[-1])

@@ -1,0 +1,109 @@
+"""v2 API shim: the reference README's MNIST flow end-to-end
+(reference ``python/paddle/v2/tests/`` + book examples)."""
+
+import io
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+
+
+def test_v2_mnist_train_and_infer():
+    paddle.init(use_gpu=False, trainer_count=1)
+
+    images = paddle.layer.data(
+        name="pixel", type=paddle.data_type.dense_vector(784))
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(10))
+    hidden = paddle.layer.fc(input=images, size=64,
+                             act=paddle.activation.Relu())
+    predict = paddle.layer.fc(input=hidden, size=10,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Adam(learning_rate=2e-3)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+
+    events = {"iters": 0, "last_err": 1.0, "passes": 0}
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            events["iters"] += 1
+            events["last_err"] = e.metrics.get(
+                "classification_error_evaluator", 1.0)
+        elif isinstance(e, paddle.event.EndPass):
+            events["passes"] += 1
+
+    def limited_train():
+        src = paddle.dataset.mnist.train()()
+        for i, s in enumerate(src):
+            if i >= 64 * 40:
+                return
+            yield s
+
+    trainer.train(
+        reader=paddle.batch(lambda: limited_train(), 64),
+        num_passes=2, event_handler=handler,
+        feeding={"pixel": 0, "label": 1})
+
+    assert events["passes"] == 2
+    assert events["iters"] > 0
+    assert events["last_err"] < 0.25, events["last_err"]
+
+    # parameters round-trip through tar
+    buf = io.BytesIO()
+    parameters.to_tar(buf)
+    buf.seek(0)
+    parameters.init_from_tar(buf)
+
+    # inference
+    samples = [s for i, s in enumerate(paddle.dataset.mnist.test()())
+               if i < 32]
+    probs = paddle.infer(output_layer=predict, parameters=parameters,
+                         input=samples, feeding={"pixel": 0})
+    assert probs.shape == (32, 10)
+    acc = (probs.argmax(1) == np.asarray([s[1] for s in samples])).mean()
+    assert acc > 0.7, acc
+
+
+def test_v2_sequence_model():
+    paddle.init()
+    dict_dim = 200
+    words = paddle.layer.data(
+        name="words",
+        type=paddle.data_type.integer_value_sequence(dict_dim))
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=16)
+    lstm = paddle.networks.simple_lstm(input=emb, size=16)
+    pooled = paddle.layer.pooling(input=lstm,
+                                  pooling_type=paddle.layer.Max())
+    predict = paddle.layer.fc(input=pooled, size=2,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(6 * 16):
+            lab = int(rng.randint(0, 2))
+            lo, hi = (0, 100) if lab == 0 else (100, 200)
+            seq = list(rng.randint(lo, hi, size=12))
+            yield seq, lab
+
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    trainer.train(reader=paddle.batch(reader, 16), num_passes=3,
+                  event_handler=handler,
+                  feeding={"words": 0, "label": 1})
+    assert costs[-1] < costs[0], (costs[0], costs[-1])
