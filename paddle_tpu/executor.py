@@ -253,6 +253,7 @@ class Executor:
         uses_rng = True  # cheap: always thread a key; XLA drops it if unused
 
         training = not program._is_inference
+        interpret = _has_host_ops(block)
 
         lod_map = {n: [list(level) for level in lod]
                    for n, lod in feed_lods}
@@ -270,7 +271,13 @@ class Executor:
                          if n in env}
             return fetches, new_state
 
-        fn = jax.jit(step, donate_argnums=(2,))
+        if interpret:
+            # op-by-op eager execution — needed when a host op (data-
+            # dependent shapes, numpy DP) is in the block; the reference's
+            # analogous path is its per-op CPU-kernel interpreter
+            fn = step
+        else:
+            fn = jax.jit(step, donate_argnums=(2,))
         compiled = _CompiledBlock(fn, feed_names, ro_names, inout_names,
                                   tuple(fetch_names), uses_rng)
         if len(self._cache) >= 64:  # LRU-evict the coldest executable
@@ -288,6 +295,17 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+def _has_host_ops(block):
+    for op in block.ops:
+        opdef = registry.lookup(op.type)
+        if opdef is not None and opdef.host:
+            return True
+        for a in op.attrs.values():
+            if isinstance(a, framework.Block) and _has_host_ops(a):
+                return True
+    return False
 
 
 def _freeze_lod(lod):

@@ -88,7 +88,7 @@ def _shared_causal_bias(block, sq):
 
 def multi_head_attention(queries, keys, values, d_key, d_value, d_model,
                          n_head=1, dropout_rate=0.0, k_mask=None,
-                         causal=False, use_flash=True):
+                         causal=False, use_flash=True, prefix=None):
     """Multi-head scaled-dot-product attention over dense [B,S,D] tensors.
 
     ``k_mask`` [B, S_k] (1=attend) covers padding; ``causal`` covers the
@@ -100,11 +100,17 @@ def multi_head_attention(queries, keys, values, d_key, d_value, d_model,
     keys = queries if keys is None else keys
     values = keys if values is None else values
 
+    def pa(role):
+        # structured names let tensor-parallel sharding rules (tp_shardings)
+        # address parameters by role
+        return ParamAttr(name=f"{prefix}_{role}.w") if prefix else None
+
     q = layers.fc(queries, d_key * n_head, num_flatten_dims=2,
-                  bias_attr=False)
-    k = layers.fc(keys, d_key * n_head, num_flatten_dims=2, bias_attr=False)
+                  bias_attr=False, param_attr=pa("q"))
+    k = layers.fc(keys, d_key * n_head, num_flatten_dims=2, bias_attr=False,
+                  param_attr=pa("k"))
     v = layers.fc(values, d_value * n_head, num_flatten_dims=2,
-                  bias_attr=False)
+                  bias_attr=False, param_attr=pa("v"))
 
     def split_heads(x, d_per_head):
         # [B, S, H*D] -> [B, H, S, D]
@@ -140,12 +146,20 @@ def multi_head_attention(queries, keys, values, d_key, d_value, d_model,
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     b, s = ctx.shape[0], ctx.shape[1]
     ctx = layers.reshape(ctx, shape=[b, s, n_head * d_value])
-    return layers.fc(ctx, d_model, num_flatten_dims=2, bias_attr=False)
+    return layers.fc(ctx, d_model, num_flatten_dims=2, bias_attr=False,
+                     param_attr=pa("attnout"))
 
 
-def positionwise_feed_forward(x, d_inner_hid, d_hid):
-    hidden = layers.fc(x, d_inner_hid, num_flatten_dims=2, act="relu")
-    return layers.fc(hidden, d_hid, num_flatten_dims=2)
+def positionwise_feed_forward(x, d_inner_hid, d_hid, prefix=None):
+    def pa(role, suffix="w"):
+        return ParamAttr(name=f"{prefix}_{role}.{suffix}") if prefix \
+            else None
+    hidden = layers.fc(x, d_inner_hid, num_flatten_dims=2, act="relu",
+                       param_attr=pa("ffn1"),
+                       bias_attr=pa("ffn1", "b"))
+    return layers.fc(hidden, d_hid, num_flatten_dims=2,
+                     param_attr=pa("ffn2"),
+                     bias_attr=pa("ffn2", "b"))
 
 
 def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0):
@@ -162,29 +176,35 @@ def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0):
     return out
 
 
-def encoder_layer(enc_input, src_mask, hp: ModelHyperParams):
+def encoder_layer(enc_input, src_mask, hp: ModelHyperParams, idx=0):
     attn = multi_head_attention(enc_input, None, None,
                                 hp.d_key, hp.d_value, hp.d_model,
                                 hp.n_head, hp.attention_dropout,
-                                k_mask=src_mask, use_flash=hp.use_flash)
+                                k_mask=src_mask, use_flash=hp.use_flash,
+                                prefix=f"enc{idx}_attn")
     attn = pre_post_process_layer(enc_input, attn, "dan", hp.dropout)
-    ffd = positionwise_feed_forward(attn, hp.d_inner_hid, hp.d_model)
+    ffd = positionwise_feed_forward(attn, hp.d_inner_hid, hp.d_model,
+                                    prefix=f"enc{idx}")
     return pre_post_process_layer(attn, ffd, "dan", hp.dropout)
 
 
-def decoder_layer(dec_input, enc_output, src_mask, hp: ModelHyperParams):
+def decoder_layer(dec_input, enc_output, src_mask, hp: ModelHyperParams,
+                  idx=0):
     self_attn = multi_head_attention(dec_input, None, None,
                                      hp.d_key, hp.d_value, hp.d_model,
                                      hp.n_head, hp.attention_dropout,
-                                     causal=True, use_flash=hp.use_flash)
+                                     causal=True, use_flash=hp.use_flash,
+                                     prefix=f"dec{idx}_self")
     self_attn = pre_post_process_layer(dec_input, self_attn, "dan",
                                        hp.dropout)
     cross = multi_head_attention(self_attn, enc_output, enc_output,
                                  hp.d_key, hp.d_value, hp.d_model,
                                  hp.n_head, hp.attention_dropout,
-                                 k_mask=src_mask, use_flash=hp.use_flash)
+                                 k_mask=src_mask, use_flash=hp.use_flash,
+                                 prefix=f"dec{idx}_cross")
     cross = pre_post_process_layer(self_attn, cross, "dan", hp.dropout)
-    ffd = positionwise_feed_forward(cross, hp.d_inner_hid, hp.d_model)
+    ffd = positionwise_feed_forward(cross, hp.d_inner_hid, hp.d_model,
+                                    prefix=f"dec{idx}")
     return pre_post_process_layer(cross, ffd, "dan", hp.dropout)
 
 
@@ -208,15 +228,15 @@ def prepare_embedding(ids, pos_ids, vocab_size, hp: ModelHyperParams,
 
 def encoder(src_ids, src_pos, src_mask, hp: ModelHyperParams):
     x = prepare_embedding(src_ids, src_pos, hp.src_vocab_size, hp, "src")
-    for _ in range(hp.n_layer):
-        x = encoder_layer(x, src_mask, hp)
+    for i in range(hp.n_layer):
+        x = encoder_layer(x, src_mask, hp, idx=i)
     return x
 
 
 def decoder(trg_ids, trg_pos, enc_output, src_mask, hp: ModelHyperParams):
     x = prepare_embedding(trg_ids, trg_pos, hp.trg_vocab_size, hp, "trg")
-    for _ in range(hp.n_layer):
-        x = decoder_layer(x, enc_output, src_mask, hp)
+    for i in range(hp.n_layer):
+        x = decoder_layer(x, enc_output, src_mask, hp, idx=i)
     return x
 
 
@@ -260,7 +280,8 @@ def transformer(batch_size, src_len, trg_len, hp: ModelHyperParams = None):
     dec_out = decoder(trg_ids, trg_pos, enc_out, src_mask, hp)
 
     logits = layers.fc(dec_out, hp.trg_vocab_size, num_flatten_dims=2,
-                       bias_attr=False)
+                       bias_attr=False,
+                       param_attr=ParamAttr(name="proj_logits.w"))
     logits2d = layers.reshape(
         logits, shape=[batch_size * trg_len, hp.trg_vocab_size])
     labels2d = layers.reshape(labels, shape=[batch_size * trg_len, 1])
@@ -302,3 +323,22 @@ def param_count(hp: ModelHyperParams = None):
     emb = (hp.src_vocab_size + hp.trg_vocab_size) * d
     proj = d * hp.trg_vocab_size
     return hp.n_layer * (per_enc + per_dec) + emb + proj
+
+
+def tp_shardings():
+    """Megatron-style tensor-parallel PartitionSpec rules for the model's
+    parameters (and, by substring match, their Adam moments) over a mesh
+    with a ``model`` axis.  Pass to
+    ``ParallelExecutor(param_shardings=...)``; GSPMD inserts the
+    collectives (replacing the reference's explicit pserver/NCCL plumbing,
+    SURVEY.md §2.8)."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r"_(q|k|v)\.w", P(None, "model")),         # column parallel
+        (r"_attnout\.w", P("model", None)),         # row parallel
+        (r"_ffn1\.w", P(None, "model")),
+        (r"_ffn1\.b", P("model")),                  # bias [FF]
+        (r"_ffn2\.w", P("model", None)),
+        (r"(src|trg)_word_emb", P(None, "model")),  # shard d_model
+        (r"proj_logits\.w", P(None, "model")),      # shard vocab
+    ]

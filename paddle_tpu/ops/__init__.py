@@ -20,4 +20,6 @@ from paddle_tpu.ops import (  # noqa: F401
     sequence_ops,
     rnn_ops,
     attention_ops,
+    crf_ops,
+    ctc_ops,
 )

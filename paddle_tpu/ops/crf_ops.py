@@ -1,0 +1,144 @@
+"""Linear-chain CRF ops (reference ``operators/linear_chain_crf_op.cc``,
+``crf_decoding_op.cc`` — the label_semantic_roles workload).
+
+TPU re-design: the forward-backward recursion runs as a ``lax.scan`` over
+the padded time axis per sequence (the reference loops per sequence on
+CPU only — these ops never had a CUDA kernel).  Transition layout matches
+the reference: ``Transition`` is [n_tags + 2, n_tags]; row 0 = start
+weights, row 1 = stop weights, rows 2.. = transition[from, to].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.registry import (
+    register_op, LowerContext, ShapeInferenceSkip)
+from paddle_tpu.ops.sequence_ops import _require_lod, _lengths
+
+
+def _infer_skip(op, block):
+    raise ShapeInferenceSkip()
+
+
+def _pad_batch(x, lod):
+    """[N, D] ragged -> [B, T, D] padded + [B] lengths (static tables)."""
+    from paddle_tpu.ops.rnn_ops import _lod_pad_tables, _to_padded
+    gather, scatter, lengths, B, T = _lod_pad_tables(lod)
+    return _to_padded(x, gather), jnp.asarray(lengths), B, T, scatter
+
+
+def _crf_log_alpha(emission, transition, lengths):
+    """Forward recursion log-normalizer per sequence.
+
+    emission [B, T, K] padded; returns log_Z [B]."""
+    start = transition[0]        # [K]
+    stop = transition[1]         # [K]
+    trans = transition[2:]       # [K, K] trans[from, to]
+    B, T, K = emission.shape
+
+    alpha0 = start[None, :] + emission[:, 0]     # [B, K]
+
+    def step(carry, t):
+        alpha = carry
+        # logsumexp over 'from' axis
+        scores = alpha[:, :, None] + trans[None]  # [B, K_from, K_to]
+        new = jax.scipy.special.logsumexp(scores, axis=1) + emission[:, t]
+        keep = (t < lengths)[:, None]
+        alpha = jnp.where(keep, new, alpha)
+        return alpha, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, max(T, 1)))
+    return jax.scipy.special.logsumexp(alpha + stop[None], axis=1)
+
+
+def _crf_gold_score(emission, transition, labels, lengths):
+    """Score of the gold path per sequence; labels [B, T] int."""
+    start = transition[0]
+    stop = transition[1]
+    trans = transition[2:]
+    B, T = labels.shape
+    t_idx = jnp.arange(T)[None, :]
+    valid = (t_idx < lengths[:, None])
+
+    emit = jnp.take_along_axis(emission, labels[..., None],
+                               axis=2)[..., 0]          # [B, T]
+    emit_score = (emit * valid).sum(1)
+    first = labels[:, 0]
+    start_score = start[first]
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last = jnp.take_along_axis(labels, last_idx[:, None], axis=1)[:, 0]
+    stop_score = stop[last]
+    prev, nxt = labels[:, :-1], labels[:, 1:]
+    pair_valid = valid[:, 1:]
+    trans_score = (trans[prev, nxt] * pair_valid).sum(1)
+    return start_score + emit_score + trans_score + stop_score
+
+
+@register_op("linear_chain_crf", infer_shape=_infer_skip,
+             no_grad_inputs=("Label",))
+def linear_chain_crf_lower(ctx: LowerContext):
+    """Outputs LogLikelihood [B, 1] (negative log-likelihood, matching the
+    reference's sign convention: it emits -log p, minimized directly)."""
+    emission_flat = ctx.input("Emission")     # [N, K]
+    transition = ctx.input("Transition")      # [K+2, K]
+    label_flat = ctx.input("Label")           # [N, 1]
+    lod = _require_lod(ctx, "Emission")
+    emission, lengths, B, T, _ = _pad_batch(emission_flat, lod)
+    labels_p, _, _, _, _ = _pad_batch(
+        label_flat.reshape(-1, 1).astype(jnp.int32), lod)
+    labels = labels_p[..., 0]
+
+    log_z = _crf_log_alpha(emission, transition, lengths)
+    gold = _crf_gold_score(emission, transition, labels, lengths)
+    nll = (log_z - gold).reshape(B, 1)
+    ctx.set_output("LogLikelihood", nll)
+    # parity outputs (reference caches these for its manual grad)
+    ctx.set_output("Alpha", emission)
+    ctx.set_output("EmissionExps", emission)
+    ctx.set_output("TransitionExps", transition)
+
+
+@register_op("crf_decoding", infer_shape=_infer_skip, no_gradient=True)
+def crf_decoding_lower(ctx: LowerContext):
+    """Viterbi decode -> best tag per token [N, 1] (int64)."""
+    emission_flat = ctx.input("Emission")
+    transition = ctx.input("Transition")
+    lod = _require_lod(ctx, "Emission")
+    emission, lengths, B, T, scatter = _pad_batch(emission_flat, lod)
+    start, stop, trans = (transition[0], transition[1], transition[2:])
+    K = emission.shape[2]
+
+    v0 = start[None] + emission[:, 0]                    # [B, K]
+
+    def step(carry, t):
+        v = carry
+        scores = v[:, :, None] + trans[None]             # [B, from, to]
+        best_prev = jnp.argmax(scores, axis=1)           # [B, K]
+        new = jnp.max(scores, axis=1) + emission[:, t]
+        keep = (t < lengths)[:, None]
+        v = jnp.where(keep, new, v)
+        bp = jnp.where(keep, best_prev,
+                       jnp.arange(K)[None, :].astype(best_prev.dtype))
+        return v, bp
+
+    v, bps = jax.lax.scan(step, v0, jnp.arange(1, max(T, 1)))
+    # bps: [T-1, B, K]
+    last_tag = jnp.argmax(v + stop[None], axis=1)        # [B]
+
+    def back(carry, bp):
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    tag0, tags_rest = jax.lax.scan(back, last_tag, bps, reverse=True)
+    # tags_rest[i] = tag at time i+1 (stacked in input order); tag0 = t=0
+    tags = jnp.concatenate([tag0[None], tags_rest], axis=0)  # [T, B]
+    tags_bt = jnp.moveaxis(tags, 0, 1)                   # [B, T]
+    flat = tags_bt.reshape(-1)[jnp.asarray(scatter)]
+    # label path correction: positions past each length hold stale tags
+    # but scatter only addresses valid rows, so flat is exact
+    ctx.set_output("ViterbiPath", flat.reshape(-1, 1).astype(jnp.int32))
+    ctx.set_output_lod("ViterbiPath", [list(l) for l in lod])

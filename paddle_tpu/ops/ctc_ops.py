@@ -1,0 +1,140 @@
+"""CTC + edit-distance ops (reference ``operators/warpctc_op.cc`` — which
+wraps the external warp-ctc CUDA library — ``ctc_align_op.cc``,
+``edit_distance_op.cc``).
+
+TPU re-design: CTC loss is the standard alpha recursion over the padded
+label lattice as a ``lax.scan`` (no external library); grads come from
+jax.vjp of the same recursion.  Edit distance runs the DP at trace time on
+static-lod int sequences (it is an eval metric on host data in every
+reference use).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.registry import (
+    register_op, LowerContext, ShapeInferenceSkip)
+from paddle_tpu.ops.sequence_ops import _require_lod, _lengths
+
+NEG = -1e30
+
+
+def _infer_skip(op, block):
+    raise ShapeInferenceSkip()
+
+
+def ctc_loss_single(logits, labels, blank=0):
+    """Negative log-likelihood of ``labels`` under CTC for one sequence.
+
+    logits [T, C] (unnormalized), labels [L] (no blanks)."""
+    log_probs = jax.nn.log_softmax(logits)
+    L = labels.shape[0]
+    # extended label sequence with blanks: [blank, l1, blank, l2, ...]
+    ext = jnp.full((2 * L + 1,), blank, labels.dtype)
+    ext = ext.at[1::2].set(labels)
+    S = ext.shape[0]
+
+    a0 = jnp.full((S,), NEG)
+    a0 = a0.at[0].set(log_probs[0, blank])
+    if L > 0:
+        a0 = a0.at[1].set(log_probs[0, ext[1]])
+
+    same_as_two_back = jnp.concatenate(
+        [jnp.array([True, True]), ext[2:] == ext[:-2]])
+
+    def step(alpha, lp):
+        shift1 = jnp.concatenate([jnp.full((1,), NEG), alpha[:-1]])
+        shift2 = jnp.concatenate([jnp.full((2,), NEG), alpha[:-2]])
+        shift2 = jnp.where(same_as_two_back, NEG, shift2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+        return merged + lp[ext], None
+
+    alpha, _ = jax.lax.scan(step, a0, log_probs[1:])
+    return -jnp.logaddexp(alpha[S - 1], alpha[S - 2] if S > 1
+                          else jnp.asarray(NEG))
+
+
+@register_op("warpctc", infer_shape=_infer_skip, no_grad_inputs=("Label",))
+def warpctc_lower(ctx: LowerContext):
+    """Logits [N_t, C] ragged over time (lod), Label [N_l, 1] ragged;
+    Loss [B, 1].  Per-sequence lattices run at their static lengths."""
+    logits_flat = ctx.input("Logits")
+    label_flat = ctx.input("Label")
+    blank = ctx.attr("blank", 0)
+    norm = ctx.attr("norm_by_times", False)
+    logit_lod = _require_lod(ctx, "Logits")
+    label_lod = _require_lod(ctx, "Label")
+    lsp = np.asarray(logit_lod[0])
+    ysp = np.asarray(label_lod[0])
+    losses = []
+    labels_all = label_flat.reshape(-1).astype(jnp.int32)
+    for b in range(len(lsp) - 1):
+        logits = logits_flat[int(lsp[b]):int(lsp[b + 1])]
+        labels = labels_all[int(ysp[b]):int(ysp[b + 1])]
+        loss = ctc_loss_single(logits, labels, blank)
+        if norm:
+            loss = loss / (int(lsp[b + 1]) - int(lsp[b]))
+        losses.append(loss)
+    ctx.set_output("Loss", jnp.stack(losses).reshape(-1, 1))
+
+
+@register_op("ctc_align", infer_shape=_infer_skip, no_gradient=True,
+             host=True)
+def ctc_align_lower(ctx: LowerContext):
+    """Greedy CTC decode: merge repeats then drop blanks.  Output length
+    is data-dependent — runs at trace time on concrete inputs (eval path,
+    like the reference's CPU kernel)."""
+    x = ctx.input("Input")  # [N, 1] int ids (argmax'd upstream)
+    blank = ctx.attr("blank", 0)
+    lod = _require_lod(ctx, "Input")
+    splits = np.asarray(lod[0])
+    vals = np.asarray(x).reshape(-1)
+    out, new_splits = [], [0]
+    for b in range(len(splits) - 1):
+        seq = vals[splits[b]:splits[b + 1]]
+        merged = [int(v) for i, v in enumerate(seq)
+                  if (i == 0 or v != seq[i - 1]) and int(v) != blank]
+        out.extend(merged)
+        new_splits.append(len(out))
+    ctx.set_output("Output", jnp.asarray(np.asarray(out, np.int32))
+                   .reshape(-1, 1))
+    ctx.set_output_lod("Output", [new_splits])
+
+
+def _levenshtein(a, b):
+    m, n = len(a), len(b)
+    dp = np.arange(n + 1, dtype=np.float32)
+    for i in range(1, m + 1):
+        prev = dp.copy()
+        dp[0] = i
+        for j in range(1, n + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            dp[j] = min(prev[j] + 1, dp[j - 1] + 1, prev[j - 1] + cost)
+    return float(dp[n])
+
+
+@register_op("edit_distance", infer_shape=_infer_skip,
+             no_gradient=True, host=True)
+def edit_distance_lower(ctx: LowerContext):
+    hyp = ctx.input("Hyps")
+    ref = ctx.input("Refs")
+    normalized = ctx.attr("normalized", False)
+    h_lod = _require_lod(ctx, "Hyps")
+    r_lod = _require_lod(ctx, "Refs")
+    hs = np.asarray(h_lod[0])
+    rs = np.asarray(r_lod[0])
+    hv = np.asarray(hyp).reshape(-1)
+    rv = np.asarray(ref).reshape(-1)
+    dists = []
+    for b in range(len(hs) - 1):
+        a = list(hv[hs[b]:hs[b + 1]])
+        bseq = list(rv[rs[b]:rs[b + 1]])
+        d = _levenshtein(a, bseq)
+        if normalized and len(bseq):
+            d /= len(bseq)
+        dists.append(d)
+    ctx.set_output("Out", jnp.asarray(dists, jnp.float32).reshape(-1, 1))
+    ctx.set_output("SequenceNum", jnp.asarray([len(dists)], jnp.int32))

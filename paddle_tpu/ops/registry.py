@@ -43,7 +43,7 @@ class ShapeInferenceSkip(Exception):
 class OpDef:
     def __init__(self, type, lower=None, infer_shape=None, grad_maker=None,
                  grad_lower=None, no_grad_inputs=(), stop_gradient_outputs=(),
-                 uses_rng=False, stateful_outputs=()):
+                 uses_rng=False, stateful_outputs=(), host=False):
         self.type = type
         self.lower = lower
         self.infer_shape = infer_shape
@@ -59,12 +59,16 @@ class OpDef:
         # outputs that alias an input buffer across steps (e.g. ParamOut for
         # optimizer ops); informs donation, not semantics.
         self.stateful_outputs = frozenset(stateful_outputs)
+        # host ops need CONCRETE values (data-dependent output shapes /
+        # numpy DP) — a block containing one runs in op-by-op interpret
+        # mode, like the reference's CPU-only kernels
+        self.host = host
         self.has_grad = True  # flipped by register_op(no_gradient=True)
 
 
 def register_op(type, *, infer_shape=None, grad_maker=None, grad_lower=None,
                 no_grad_inputs=(), stop_gradient_outputs=(), uses_rng=False,
-                no_gradient=False, stateful_outputs=()):
+                no_gradient=False, stateful_outputs=(), host=False):
     """Decorator: register ``fn(ctx)`` as the lowering for op ``type``."""
 
     def deco(fn):
@@ -72,7 +76,8 @@ def register_op(type, *, infer_shape=None, grad_maker=None, grad_lower=None,
                       grad_maker=grad_maker, grad_lower=grad_lower,
                       no_grad_inputs=no_grad_inputs,
                       stop_gradient_outputs=stop_gradient_outputs,
-                      uses_rng=uses_rng, stateful_outputs=stateful_outputs)
+                      uses_rng=uses_rng, stateful_outputs=stateful_outputs,
+                      host=host)
         opdef.has_grad = not no_gradient
         _REGISTRY[type] = opdef
         return fn

@@ -214,7 +214,8 @@ def sequence_reshape_lower(ctx: LowerContext):
     ctx.set_output_lod("Out", [splits])
 
 
-@register_op("sequence_slice", infer_shape=_infer_ragged, no_gradient=True)
+@register_op("sequence_slice", infer_shape=_infer_ragged,
+             no_gradient=True, host=True)
 def sequence_slice_lower(ctx: LowerContext):
     x = ctx.input("X")
     lod = _require_lod(ctx)
@@ -230,7 +231,8 @@ def sequence_slice_lower(ctx: LowerContext):
     ctx.set_output_lod("Out", [new_splits])
 
 
-@register_op("sequence_erase", infer_shape=_infer_ragged, no_gradient=True)
+@register_op("sequence_erase", infer_shape=_infer_ragged,
+             no_gradient=True, host=True)
 def sequence_erase_lower(ctx: LowerContext):
     """Remove tokens in ``tokens`` attr.  Changes row count — requires
     concrete (non-traced) input, so it runs at trace time on constants

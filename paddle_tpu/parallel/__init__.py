@@ -10,7 +10,10 @@ Replaces the reference's multi-device stack (§2.8 of SURVEY.md):
   * ``DistributeTranspiler`` pserver rewrite → sharding-spec partitioning
     (``paddle_tpu.parallel.distribute_transpiler``).
   * NCCL collective ops → collective IR ops lowering to
-    ``lax.psum``/``all_gather``/... (``paddle_tpu.ops.collective_ops``).
+    ``lax.psum``/``all_gather``/... (``paddle_tpu.parallel.collective``).
+  * Go master fault-tolerant data dispatch → ``paddle_tpu.parallel.master``.
+  * Sequence/context parallelism (absent in the reference) →
+    ``paddle_tpu.parallel.ring_attention``.
 """
 
 from paddle_tpu.parallel.mesh import (default_mesh, make_mesh,
@@ -18,6 +21,14 @@ from paddle_tpu.parallel.mesh import (default_mesh, make_mesh,
 from paddle_tpu.parallel.parallel_executor import ParallelExecutor
 from paddle_tpu.parallel.distribute_transpiler import (DistributeTranspiler,
                                                        DistributedSpec)
+from paddle_tpu.parallel import collective  # registers c_* IR ops
+from paddle_tpu.parallel.ring_attention import ring_attention
+from paddle_tpu.parallel.master import MasterService, partition_files
+from paddle_tpu.parallel.distributed import (init_parallel_env, get_rank,
+                                             get_world_size, global_mesh)
 
 __all__ = ["ParallelExecutor", "default_mesh", "make_mesh", "device_count",
-           "set_default_mesh", "DistributeTranspiler", "DistributedSpec"]
+           "set_default_mesh", "DistributeTranspiler", "DistributedSpec",
+           "collective", "ring_attention", "MasterService",
+           "partition_files", "init_parallel_env", "get_rank",
+           "get_world_size", "global_mesh"]

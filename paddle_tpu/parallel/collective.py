@@ -1,0 +1,115 @@
+"""Collective communication as IR ops + helpers.
+
+Parity with the reference's NCCL op family (``operators/nccl_op.cc``:
+NCCLAllReduce :94, NCCLReduce :140, NCCLBcast :191) and the collective
+needs of the pserver path — all superseded by XLA collectives that GSPMD
+rides over ICI.  Two layers:
+
+  * **IR ops** ``c_allreduce_{sum,max,min,prod}``, ``c_broadcast``,
+    ``c_allgather``, ``c_reducescatter``, ``c_alltoall`` — usable inside
+    programs.  Outside an spmd axis context they are identity/no-op (one
+    logical device: the whole mesh, GSPMD partitions underneath), matching
+    how the TPU build subsumes explicit per-device communication.  Inside
+    a ``shard_map`` lowering (``ctx.aux['spmd_axis']``) they emit real
+    ``lax.psum``/``all_gather``/... on that axis.
+  * **Python helpers** for direct use in shard_map'd code
+    (ring attention uses ``lax.ppermute`` directly).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import (
+    register_op, LowerContext, infer_shape_unary, ShapeInferenceSkip)
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+           "broadcast"]
+
+
+# -- python helpers (require an active named axis) --------------------------
+
+def all_reduce(x, axis_name, op="sum"):
+    return {"sum": jax.lax.psum, "max": jax.lax.pmax,
+            "min": jax.lax.pmin}[op](x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0):
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis):
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def broadcast(x, axis_name, root=0):
+    # select root's value on every member of the axis
+    idx = jax.lax.axis_index(axis_name)
+    src = jax.lax.all_gather(x, axis_name, axis=0)
+    del idx
+    return src[root]
+
+
+# -- IR ops -----------------------------------------------------------------
+
+def _axis(ctx: LowerContext):
+    return ctx.aux.get("spmd_axis")
+
+
+def _make_allreduce(op_name, reducer):
+    @register_op(op_name, infer_shape=infer_shape_unary())
+    def lower(ctx: LowerContext):
+        x = ctx.input("X")
+        ax = _axis(ctx)
+        ctx.set_output("Out", x if ax is None else reducer(x, ax))
+    return lower
+
+
+_make_allreduce("c_allreduce_sum", jax.lax.psum)
+_make_allreduce("c_allreduce_max", jax.lax.pmax)
+_make_allreduce("c_allreduce_min", jax.lax.pmin)
+_make_allreduce("c_allreduce_prod",
+                lambda x, ax: jnp.exp(jax.lax.psum(jnp.log(x), ax)))
+
+
+@register_op("c_broadcast", infer_shape=infer_shape_unary())
+def c_broadcast_lower(ctx: LowerContext):
+    x = ctx.input("X")
+    ax = _axis(ctx)
+    root = ctx.attr("root", 0)
+    ctx.set_output("Out", x if ax is None else broadcast(x, ax, root))
+
+
+def _infer_skip(op, block):
+    raise ShapeInferenceSkip()
+
+
+@register_op("c_allgather", infer_shape=_infer_skip)
+def c_allgather_lower(ctx: LowerContext):
+    x = ctx.input("X")
+    ax = _axis(ctx)
+    ctx.set_output("Out", x if ax is None
+                   else all_gather(x, ax, axis=0, tiled=True))
+
+
+@register_op("c_reducescatter", infer_shape=_infer_skip)
+def c_reducescatter_lower(ctx: LowerContext):
+    x = ctx.input("X")
+    ax = _axis(ctx)
+    ctx.set_output("Out", x if ax is None else reduce_scatter(x, ax))
+
+
+@register_op("c_alltoall", infer_shape=_infer_skip)
+def c_alltoall_lower(ctx: LowerContext):
+    x = ctx.input("X")
+    ax = _axis(ctx)
+    ctx.set_output("Out", x if ax is None
+                   else all_to_all(x, ax, 0, 0))

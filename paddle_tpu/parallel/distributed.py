@@ -1,0 +1,76 @@
+"""Multi-host bootstrap + cluster env conventions.
+
+Replaces all four of the reference's distributed backends (SURVEY.md §5.8:
+NCCL, gRPC pserver, v2 epoll sockets, Go net/rpc+etcd) with the JAX
+multi-controller model: every host runs the same program,
+``jax.distributed.initialize`` forms the cluster over DCN, and GSPMD/ICI
+carry the tensor traffic.  The reference's env conventions
+(``PADDLE_INIT_PSERVERS``/``TRAINER_ID``/``TRAINERS``,
+benchmark/cluster/vgg16/fluid_trainer.yaml) map onto
+coordinator-address/process-id/num-processes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size",
+           "global_mesh"]
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None, local_device_ids=None):
+    """Form the multi-host cluster (reference analog: trainer startup in
+    ``distribute_transpiler``-mode + NCCL init / pserver discovery).
+
+    Resolution order for each field: explicit arg > PADDLE_* env (reference
+    convention) > JAX defaults (TPU pod metadata)."""
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None:
+        pservers = os.environ.get("PADDLE_INIT_PSERVERS")
+        coordinator_address = os.environ.get(
+            "PADDLE_COORDINATOR", pservers.split(",")[0] + ":8357"
+            if pservers else None)
+    if num_processes is None:
+        t = os.environ.get("PADDLE_INIT_NUM_GRADIENT_SERVERS") or \
+            os.environ.get("PADDLE_TRAINERS") or os.environ.get("TRAINERS")
+        num_processes = int(t) if t else None
+    if process_id is None:
+        t = os.environ.get("PADDLE_INIT_TRAINER_ID") or \
+            os.environ.get("PADDLE_TRAINER_ID") or \
+            os.environ.get("TRAINER_ID")
+        process_id = int(t) if t else None
+
+    if coordinator_address is None and num_processes is None:
+        # single-host (or TPU pod auto-bootstrap)
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            pass  # single-process; jax.devices() is already correct
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id,
+            local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def get_rank():
+    return jax.process_index()
+
+
+def get_world_size():
+    return jax.process_count()
+
+
+def global_mesh(mesh_shape=None, axis_names=None):
+    """Mesh over ALL devices across hosts (ICI within a slice, DCN
+    between); shape defaults to 1-D data parallelism."""
+    from paddle_tpu.parallel.mesh import make_mesh
+    return make_mesh(mesh_shape, axis_names, devices=jax.devices())

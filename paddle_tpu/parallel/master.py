@@ -1,0 +1,168 @@
+"""Fault-tolerant data-task dispatch service.
+
+TPU-native equivalent of the reference's Go master
+(``go/master/service.go``): partition input files/chunks into tasks, lease
+them to trainers with a timeout, recycle failed/timed-out tasks
+(``checkTimeoutFunc`` :341, ``TaskFailed`` :455, ``processFailedTask``
+:313, drop after ``failureMax``), and snapshot the queue state on every
+mutation so a restarted master resumes where it left off (``snapshot``
+:207 / ``recover`` :165 — etcd replaced by a local snapshot file; any
+shared filesystem or object store works the same way).
+
+In-process + thread-safe: multi-host tests drive it the way the Go tests
+drive the in-memory store (``go/master/service_internal_test.go``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["Task", "MasterService", "partition_files"]
+
+DEFAULT_TIMEOUT = 60.0
+DEFAULT_FAILURE_MAX = 3
+
+
+class Task:
+    def __init__(self, task_id, chunks):
+        self.id = task_id
+        self.chunks = list(chunks)   # opaque work units (paths, ranges...)
+        self.failures = 0
+        self.epoch = 0               # lease epoch; stale reports rejected
+
+    def to_dict(self):
+        return {"id": self.id, "chunks": self.chunks,
+                "failures": self.failures, "epoch": self.epoch}
+
+    @staticmethod
+    def from_dict(d):
+        t = Task(d["id"], d["chunks"])
+        t.failures = d["failures"]
+        t.epoch = d["epoch"]
+        return t
+
+
+def partition_files(paths, chunks_per_task=1):
+    """Files -> tasks (reference ``partition`` in service.go)."""
+    tasks = []
+    buf = []
+    for p in sorted(paths):
+        buf.append(p)
+        if len(buf) == chunks_per_task:
+            tasks.append(Task(len(tasks), buf))
+            buf = []
+    if buf:
+        tasks.append(Task(len(tasks), buf))
+    return tasks
+
+
+class MasterService:
+    def __init__(self, tasks=None, timeout=DEFAULT_TIMEOUT,
+                 failure_max=DEFAULT_FAILURE_MAX, snapshot_path=None):
+        self._lock = threading.Lock()
+        self.timeout = timeout
+        self.failure_max = failure_max
+        self.snapshot_path = snapshot_path
+        self.todo = list(tasks or [])
+        self.pending = {}            # task_id -> (Task, deadline)
+        self.done = []
+        self.failed_drop = []        # exceeded failure_max
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover()
+        else:
+            self._snapshot()
+
+    # -- client API (reference GetTask/TaskFinished/TaskFailed) ------------
+    def get_task(self):
+        """Lease a task; returns None when nothing is currently available
+        (caller retries — tasks may return via timeout)."""
+        with self._lock:
+            self._requeue_timeouts()
+            if not self.todo:
+                return None
+            task = self.todo.pop(0)
+            task.epoch += 1
+            self.pending[task.id] = (task, time.time() + self.timeout)
+            self._snapshot()
+            return task
+
+    def task_finished(self, task_id, epoch=None):
+        with self._lock:
+            entry = self.pending.pop(task_id, None)
+            if entry is None:
+                return False
+            task, _ = entry
+            if epoch is not None and epoch != task.epoch:
+                self.pending[task_id] = entry  # stale lease report
+                return False
+            self.done.append(task)
+            self._snapshot()
+            return True
+
+    def task_failed(self, task_id, epoch=None):
+        with self._lock:
+            entry = self.pending.pop(task_id, None)
+            if entry is None:
+                return False
+            task, _ = entry
+            if epoch is not None and epoch != task.epoch:
+                self.pending[task_id] = entry
+                return False
+            self._process_failed(task)
+            self._snapshot()
+            return True
+
+    def all_done(self):
+        with self._lock:
+            self._requeue_timeouts()
+            return not self.todo and not self.pending
+
+    def stats(self):
+        with self._lock:
+            return {"todo": len(self.todo), "pending": len(self.pending),
+                    "done": len(self.done),
+                    "dropped": len(self.failed_drop)}
+
+    # -- internals ---------------------------------------------------------
+    def _process_failed(self, task):
+        task.failures += 1
+        if task.failures >= self.failure_max:
+            self.failed_drop.append(task)
+        else:
+            self.todo.append(task)
+
+    def _requeue_timeouts(self):
+        now = time.time()
+        expired = [tid for tid, (_, dl) in self.pending.items() if dl < now]
+        for tid in expired:
+            task, _ = self.pending.pop(tid)
+            self._process_failed(task)
+        if expired:
+            self._snapshot()
+
+    def _snapshot(self):
+        if not self.snapshot_path:
+            return
+        state = {
+            "todo": [t.to_dict() for t in self.todo],
+            "pending": [t.to_dict() for t, _ in self.pending.values()],
+            "done": [t.to_dict() for t in self.done],
+            "dropped": [t.to_dict() for t in self.failed_drop],
+        }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.snapshot_path)
+
+    def _recover(self):
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        # leases don't survive a master restart: pending -> todo
+        self.todo = [Task.from_dict(d) for d in state["todo"]] + \
+                    [Task.from_dict(d) for d in state["pending"]]
+        self.pending = {}
+        self.done = [Task.from_dict(d) for d in state["done"]]
+        self.failed_drop = [Task.from_dict(d) for d in state["dropped"]]

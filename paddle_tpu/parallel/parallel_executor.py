@@ -57,10 +57,13 @@ class ParallelExecutor(Executor):
         if share_vars_from is not None:
             pass  # scope is global; parity no-op
 
-    def _state_sharding(self, name):
+    def _state_sharding(self, name, shape=None):
         for pat, spec in self.param_shardings:
             if pat.search(name):
-                return NamedSharding(self.mesh, spec)
+                if shape is None or _spec_fits(spec, shape, self.mesh):
+                    return NamedSharding(self.mesh, spec)
+                break  # rule matched but shape can't shard (e.g. the
+                # scalar beta-pow accumulator of a sharded bias)
         return NamedSharding(self.mesh, P())
 
     @property
@@ -93,20 +96,35 @@ class ParallelExecutor(Executor):
                                      fetch_names, scope)
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
+        data_size = dict(zip(mesh.axis_names,
+                             mesh.devices.shape)).get(DATA_AXIS, 1)
 
         def feed_sharding(name, arr):
-            # batch-shard floating/integer data along axis 0 when divisible
-            if arr.ndim > 0 and arr.shape[self.batch_axis] % \
-                    self.device_count == 0:
+            # batch-shard data along the batch axis over the 'data' mesh
+            # axis when divisible
+            if arr.ndim > 0 and data_size > 1 and \
+                    arr.shape[self.batch_axis] % data_size == 0:
                 spec = [None] * arr.ndim
                 spec[self.batch_axis] = DATA_AXIS
                 return NamedSharding(mesh, P(*spec))
             return repl
 
+        def shape_of(n):
+            v = scope.find_var(n)
+            return getattr(v, "shape", None) if v is not None else None
+
+        state_shardings = {n: self._state_sharding(n, shape_of(n))
+                           for n in (*base.ro_names, *base.inout_names)}
+        out_state_names = list(dict.fromkeys(
+            list(base.inout_names) + _written_persistables(block)))
+        for n in out_state_names:
+            state_shardings.setdefault(
+                n, self._state_sharding(n, shape_of(n)))
+
         in_shardings = (
             {n: feed_sharding(n, a) for n, a in feed_arrays.items()},
-            {n: repl for n in base.ro_names},
-            {n: repl for n in base.inout_names},
+            {n: state_shardings[n] for n in base.ro_names},
+            {n: state_shardings[n] for n in base.inout_names},
             repl,  # rng key
         )
         training = not program._is_inference
@@ -123,18 +141,15 @@ class ParallelExecutor(Executor):
                    "lod": dict(lod_map)}
             lower_block(block, env, rng_key, training, aux)
             fetches = [env[n] for n in fetch_names]
-            new_state = {}
-            for n in set(base.inout_names):
-                if n in env:
-                    new_state[n] = env[n]
-            extra = [n for n in _written_persistables(block)
-                     if n not in new_state and n in env]
-            for n in extra:
-                new_state[n] = env[n]
+            new_state = {n: env[n] for n in out_state_names if n in env}
             return fetches, new_state
 
+        # trace once abstractly to learn which state names actually get
+        # produced, so out_shardings matches the returned dict exactly
+        out_shardings = (None, {n: state_shardings[n]
+                                for n in out_state_names})
         jitted = jax.jit(step, in_shardings=in_shardings,
-                         out_shardings=(None, _replicated_tree(repl)),
+                         out_shardings=out_shardings,
                          donate_argnums=(2,))
         feed_shardings = in_shardings[0]
 
@@ -148,8 +163,9 @@ class ParallelExecutor(Executor):
         def fn(feeds, ro_state, inout_state, rng_key):
             feeds = {n: place(a, feed_shardings[n])
                      for n, a in feeds.items()}
-            ro_state = {n: place(a, repl) for n, a in ro_state.items()}
-            inout_state = {n: place(a, repl)
+            ro_state = {n: place(a, state_shardings[n])
+                        for n, a in ro_state.items()}
+            inout_state = {n: place(a, state_shardings[n])
                            for n, a in inout_state.items()}
             rng_key = jax.device_put(rng_key, repl)
             return jitted(feeds, ro_state, inout_state, rng_key)
@@ -163,10 +179,22 @@ class ParallelExecutor(Executor):
         return None
 
 
-def _replicated_tree(repl):
-    # out_shardings for a dict pytree: a single sharding broadcasts to all
-    # leaves
-    return repl
+def _spec_fits(spec, shape, mesh):
+    """True when every sharded dim of ``shape`` divides evenly by the
+    product of its mesh axis sizes."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if len(spec) > len(shape):
+        return False
+    for dim, ax in zip(shape, tuple(spec)):
+        if ax is None:
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        k = 1
+        for a in axes:
+            k *= sizes.get(a, 1)
+        if dim is None or dim < 0 or dim % k:
+            return False
+    return True
 
 
 def _written_persistables(block):

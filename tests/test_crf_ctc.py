@@ -1,0 +1,178 @@
+"""linear_chain_crf / crf_decoding / warpctc / edit_distance tests
+(reference test_linear_chain_crf_op.py, test_warpctc_op.py,
+test_edit_distance_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from paddle_tpu.layer_helper import LayerHelper
+
+
+LOD = [[0, 3, 5, 9]]
+N, K = 9, 4
+
+
+def _crf_brute_force(emission, transition, labels, lod):
+    """Enumerate all paths for tiny sequences."""
+    import itertools
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    nlls = []
+    for s, e in zip(lod[0][:-1], lod[0][1:]):
+        em = emission[s:e]
+        lab = labels[s:e]
+        T = e - s
+
+        def score(path):
+            sc = start[path[0]] + em[0, path[0]]
+            for t in range(1, T):
+                sc += trans[path[t - 1], path[t]] + em[t, path[t]]
+            return sc + stop[path[-1]]
+
+        z = np.logaddexp.reduce(
+            [score(p) for p in itertools.product(range(K), repeat=T)])
+        nlls.append(z - score(list(lab)))
+    return np.asarray(nlls, "float32")
+
+
+class TestLinearChainCRF:
+    def test_nll_matches_brute_force(self):
+        rng = np.random.RandomState(0)
+        em = rng.randn(N, K).astype("float32")
+        trans = rng.randn(K + 2, K).astype("float32") * 0.5
+        lab = rng.randint(0, K, size=(N, 1)).astype("int64")
+
+        x = layers.data(name="em", shape=[N, K], append_batch_size=False,
+                        lod_level=1)
+        t = layers.data(name="trans", shape=[K + 2, K],
+                        append_batch_size=False)
+        y = layers.data(name="lab", shape=[N, 1], dtype="int64",
+                        append_batch_size=False, lod_level=1)
+        helper = LayerHelper("linear_chain_crf")
+        nll = helper.create_tmp_variable("float32")
+        helper.append_op(
+            type="linear_chain_crf",
+            inputs={"Emission": [x], "Transition": [t], "Label": [y]},
+            outputs={"LogLikelihood": [nll]})
+        exe = fluid.Executor()
+        (out,) = exe.run(feed={"em": (em, LOD), "trans": trans,
+                               "lab": (lab, LOD)}, fetch_list=[nll])
+        expect = _crf_brute_force(em, trans, lab.reshape(-1), LOD)
+        np.testing.assert_allclose(out.reshape(-1), expect, rtol=1e-4)
+
+    def test_viterbi_decode(self):
+        rng = np.random.RandomState(1)
+        em = rng.randn(N, K).astype("float32")
+        trans = rng.randn(K + 2, K).astype("float32") * 0.5
+        x = layers.data(name="em", shape=[N, K], append_batch_size=False,
+                        lod_level=1)
+        t = layers.data(name="trans", shape=[K + 2, K],
+                        append_batch_size=False)
+        helper = LayerHelper("crf_decoding")
+        path = helper.create_tmp_variable("int32")
+        helper.append_op(type="crf_decoding",
+                         inputs={"Emission": [x], "Transition": [t]},
+                         outputs={"ViterbiPath": [path]})
+        exe = fluid.Executor()
+        (out,) = exe.run(feed={"em": (em, LOD), "trans": trans},
+                         fetch_list=[path])
+        # brute-force best path per sequence
+        import itertools
+        start, stop, tr = trans[0], trans[1], trans[2:]
+        best = []
+        for s, e in zip(LOD[0][:-1], LOD[0][1:]):
+            T = e - s
+            scores = {}
+            for p in itertools.product(range(K), repeat=T):
+                sc = start[p[0]] + em[s, p[0]]
+                for i in range(1, T):
+                    sc += tr[p[i - 1], p[i]] + em[s + i, p[i]]
+                scores[p] = sc + stop[p[-1]]
+            best.extend(max(scores, key=scores.get))
+        np.testing.assert_array_equal(out.reshape(-1), best)
+
+
+class TestCTC:
+    def test_warpctc_matches_brute_force(self):
+        # T=4 frames, C=3 classes (blank=0), label "1 2"
+        rng = np.random.RandomState(2)
+        T, C = 4, 3
+        logits = rng.randn(T, C).astype("float32")
+        labels = np.asarray([[1], [2]], "int64")
+
+        x = layers.data(name="logits", shape=[T, C],
+                        append_batch_size=False, lod_level=1)
+        y = layers.data(name="lab", shape=[2, 1], dtype="int64",
+                        append_batch_size=False, lod_level=1)
+        helper = LayerHelper("warpctc")
+        loss = helper.create_tmp_variable("float32")
+        helper.append_op(type="warpctc",
+                         inputs={"Logits": [x], "Label": [y]},
+                         outputs={"Loss": [loss]}, attrs={"blank": 0})
+        exe = fluid.Executor()
+        (out,) = exe.run(feed={"logits": (logits, [[0, T]]),
+                               "lab": (labels, [[0, 2]])},
+                         fetch_list=[loss])
+
+        # brute force: sum over all alignments that collapse to [1,2]
+        import itertools
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        total = -np.inf
+        for path in itertools.product(range(C), repeat=T):
+            merged = [v for i, v in enumerate(path)
+                      if (i == 0 or v != path[i - 1]) and v != 0]
+            if merged == [1, 2]:
+                total = np.logaddexp(
+                    total, sum(logp[t, path[t]] for t in range(T)))
+        np.testing.assert_allclose(float(out.reshape(-1)[0]), -total,
+                                   rtol=1e-4)
+
+    def test_ctc_grads(self):
+        T, C = 5, 4
+        rng = np.random.RandomState(3)
+        logits = rng.randn(T, C).astype("float32")
+        x = layers.data(name="logits", shape=[T, C],
+                        append_batch_size=False, lod_level=1)
+        x.stop_gradient = False
+        y = layers.data(name="lab", shape=[2, 1], dtype="int64",
+                        append_batch_size=False, lod_level=1)
+        helper = LayerHelper("warpctc")
+        loss = helper.create_tmp_variable("float32")
+        helper.append_op(type="warpctc",
+                         inputs={"Logits": [x], "Label": [y]},
+                         outputs={"Loss": [loss]}, attrs={"blank": 0})
+        total = layers.reduce_sum(loss)
+        fluid.append_backward(total)
+        exe = fluid.Executor()
+        (g,) = exe.run(feed={"logits": (logits, [[0, T]]),
+                             "lab": (np.asarray([[1], [2]], "int64"),
+                                     [[0, 2]])},
+                       fetch_list=["logits@GRAD"])
+        assert g.shape == (T, C)
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+class TestEditDistance:
+    def test_distance(self):
+        hyp = np.asarray([[1], [2], [3], [4], [5]], "int64")
+        ref = np.asarray([[1], [3], [3], [9]], "int64")
+        h_lod = [[0, 3, 5]]
+        r_lod = [[0, 2, 4]]
+        x = layers.data(name="h", shape=[5, 1], dtype="int64",
+                        append_batch_size=False, lod_level=1)
+        y = layers.data(name="r", shape=[4, 1], dtype="int64",
+                        append_batch_size=False, lod_level=1)
+        helper = LayerHelper("edit_distance")
+        out = helper.create_tmp_variable("float32")
+        seq_num = helper.create_tmp_variable("int32")
+        helper.append_op(type="edit_distance",
+                         inputs={"Hyps": [x], "Refs": [y]},
+                         outputs={"Out": [out],
+                                  "SequenceNum": [seq_num]})
+        exe = fluid.Executor()
+        (d,) = exe.run(feed={"h": (hyp, h_lod), "r": (ref, r_lod)},
+                       fetch_list=[out])
+        # [1,2,3] vs [1,3]: distance 2 (sub 2->3? actually del 2 -> [1,3]) = 1
+        # [4,5] vs [3,9]: 2 substitutions = 2
+        np.testing.assert_allclose(d.reshape(-1), [1.0, 2.0])
