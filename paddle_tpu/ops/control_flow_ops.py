@@ -209,10 +209,64 @@ def while_lower(ctx: LowerContext):
             return merged, None
 
         final, _ = jax.lax.scan(scan_body, init, None, length=int(bound))
+
+        # the bound is a *claim* that the loop terminates within `bound`
+        # trips; a still-true condition after the scan means the loop was
+        # silently truncated — fail loudly instead (ADVICE r1).  Some PJRT
+        # backends cannot run host callbacks; there the check degrades to a
+        # one-time warning at lowering time.
+        if _host_callbacks_supported():
+            def _check_exhausted(still_true, bound=int(bound)):
+                if bool(still_true):
+                    raise RuntimeError(
+                        f"while loop did not terminate within its static "
+                        f"trip bound of {bound} iterations (inferred from "
+                        f"TensorArray capacity or the 'max_iters' attr); "
+                        f"raise 'max_iters' on the while op")
+            jax.debug.callback(_check_exhausted, cond_fun(final))
+        else:
+            _warn_no_exhaustion_check(int(bound))
     else:
         final = jax.lax.while_loop(cond_fun, body_fun, init)
     for n, v in zip(carry_names, final):
         ctx.outputs[n] = v
+
+
+_HOST_CALLBACK_OK = None
+
+
+def _host_callbacks_supported():
+    """Whether the active backend can run jax.debug.callback (feature-
+    detected once: some PJRT plugins reject host send/recv)."""
+    global _HOST_CALLBACK_OK
+    if _HOST_CALLBACK_OK is None:
+        try:
+            def probe(x):
+                jax.debug.callback(lambda v: None, x)
+                return x
+            # ensure_compile_time_eval: the probe must really EXECUTE here,
+            # even when this runs inside an outer jit trace (otherwise the
+            # inner jit is inlined and the callback pollutes the outer
+            # computation).
+            with jax.ensure_compile_time_eval():
+                jax.jit(probe)(jnp.zeros(())).block_until_ready()
+            _HOST_CALLBACK_OK = True
+        except Exception:
+            _HOST_CALLBACK_OK = False
+    return _HOST_CALLBACK_OK
+
+
+_WARNED_NO_CHECK = set()
+
+
+def _warn_no_exhaustion_check(bound):
+    if bound not in _WARNED_NO_CHECK:
+        _WARNED_NO_CHECK.add(bound)
+        import warnings
+        warnings.warn(
+            f"backend cannot run host callbacks; a while loop lowered with "
+            f"static trip bound {bound} will be silently truncated if it "
+            f"needs more iterations", RuntimeWarning)
 
 
 def _static_trip_bound(block, env):

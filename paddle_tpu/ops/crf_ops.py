@@ -48,10 +48,15 @@ def _crf_log_alpha(emission, transition, lengths):
         new = jax.scipy.special.logsumexp(scores, axis=1) + emission[:, t]
         keep = (t < lengths)[:, None]
         alpha = jnp.where(keep, new, alpha)
-        return alpha, None
+        return alpha, alpha
 
-    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, max(T, 1)))
-    return jax.scipy.special.logsumexp(alpha + stop[None], axis=1)
+    alpha, alphas = jax.lax.scan(step, alpha0, jnp.arange(1, max(T, 1)))
+    log_z = jax.scipy.special.logsumexp(alpha + stop[None], axis=1)
+    # full forward-variable cache [B, T, K] (log space), t=0 row included
+    log_alphas = jnp.concatenate(
+        [alpha0[:, None], jnp.moveaxis(alphas, 0, 1)], axis=1) \
+        if alphas.shape[0] else alpha0[:, None]
+    return log_z, log_alphas
 
 
 def _crf_gold_score(emission, transition, labels, lengths):
@@ -86,19 +91,27 @@ def linear_chain_crf_lower(ctx: LowerContext):
     transition = ctx.input("Transition")      # [K+2, K]
     label_flat = ctx.input("Label")           # [N, 1]
     lod = _require_lod(ctx, "Emission")
-    emission, lengths, B, T, _ = _pad_batch(emission_flat, lod)
+    emission, lengths, B, T, scatter = _pad_batch(emission_flat, lod)
     labels_p, _, _, _, _ = _pad_batch(
         label_flat.reshape(-1, 1).astype(jnp.int32), lod)
     labels = labels_p[..., 0]
 
-    log_z = _crf_log_alpha(emission, transition, lengths)
+    log_z, log_alphas = _crf_log_alpha(emission, transition, lengths)
     gold = _crf_gold_score(emission, transition, labels, lengths)
     nll = (log_z - gold).reshape(B, 1)
     ctx.set_output("LogLikelihood", nll)
-    # parity outputs (reference caches these for its manual grad)
-    ctx.set_output("Alpha", emission)
-    ctx.set_output("EmissionExps", emission)
-    ctx.set_output("TransitionExps", transition)
+    # parity outputs: the reference caches the forward variables and the
+    # exponentiated potentials for its manual grad
+    # (linear_chain_crf_op.h Forward).  It stores EmissionExps row-max-
+    # normalized (exp(x - max_row)) and Alpha per-step L1-normalized —
+    # both to stay inside float32 range; the per-row scale factors cancel
+    # in the L1 normalization, so normalized alpha == softmax(log_alpha).
+    from paddle_tpu.ops.rnn_ops import _to_flat
+    alpha_n = jax.nn.softmax(log_alphas, axis=-1)
+    ctx.set_output("Alpha", _to_flat(alpha_n, scatter, B, T))
+    ctx.set_output("EmissionExps", jnp.exp(
+        emission_flat - emission_flat.max(axis=-1, keepdims=True)))
+    ctx.set_output("TransitionExps", jnp.exp(transition))
 
 
 @register_op("crf_decoding", infer_shape=_infer_skip, no_gradient=True)

@@ -193,7 +193,8 @@ def gru_lower(ctx: LowerContext):
         u = act_gate(g_ur[:, :H])
         r = act_gate(g_ur[:, H:])
         cand = act_cand(x_t[:, 2 * H:] + (r * h_prev) @ w_c)
-        h = u * h_prev + (1 - u) * cand
+        # reference math/detail/gru_kernel.h: h = prev + u * (cand - prev)
+        h = h_prev + u * (cand - h_prev)
         mask = (t < len_arr).astype(x.dtype)[:, None]
         h = mask * h + (1 - mask) * h_prev
         return (h, t + 1), h
@@ -211,13 +212,13 @@ def gru_lower(ctx: LowerContext):
 @register_op("lstm_unit", infer_shape=_infer_unit)
 def lstm_unit_lower(ctx: LowerContext):
     """One LSTM step (reference lstm_unit_op.cc): X [B,4H] pre-projected,
-    C_prev [B,H] -> C, H.  Gate order (i, g, f, o) per the reference CUDA
-    kernel."""
+    C_prev [B,H] -> C, H.  Gate order (i, f, o, g) per the reference
+    lstm_unit_op.h:63-66 / .cu:51-54 kernels."""
     x = ctx.input("X")
     c_prev = ctx.input("C_prev")
     forget_bias = ctx.attr("forget_bias", 0.0)
     H = c_prev.shape[-1]
-    i, g, f, o = (x[:, :H], x[:, H:2 * H], x[:, 2 * H:3 * H], x[:, 3 * H:])
+    i, f, o, g = (x[:, :H], x[:, H:2 * H], x[:, 2 * H:3 * H], x[:, 3 * H:])
     i = jax.nn.sigmoid(i)
     f = jax.nn.sigmoid(f + forget_bias)
     o = jax.nn.sigmoid(o)
@@ -254,7 +255,8 @@ def gru_unit_lower(ctx: LowerContext):
     r = act_gate(g_ur[:, H:])
     reset_h = r * h_prev
     cand = act_cand(x[:, 2 * H:] + reset_h @ w_c)
-    h = u * h_prev + (1 - u) * cand
+    # reference gru_unit_op.h: h = prev + u * (cand - prev)
+    h = h_prev + u * (cand - h_prev)
     ctx.set_output("Gate", jnp.concatenate([u, r, cand], axis=-1))
     ctx.set_output("ResetHiddenPrev", reset_h)
     ctx.set_output("Hidden", h)

@@ -99,8 +99,13 @@ def sequence_pool_lower(ctx: LowerContext):
                               x.dtype).reshape(-1, *([1] * (x.ndim - 1)))
     elif pooltype == "MAX":
         out = jax.ops.segment_max(x, seg, num_segments=num)
-        idx = jax.ops.segment_max(
-            jnp.arange(x.shape[0]), seg, num_segments=num)
+        # MaxIndex = per-(segment, feature) argmax row (first match), as
+        # the reference MaxSeqPoolFunctor stores (math/sequence_pooling.cc)
+        N = x.shape[0]
+        rows = jnp.arange(N).reshape(-1, *([1] * (x.ndim - 1)))
+        is_max = x == out[seg]
+        idx = jax.ops.segment_min(
+            jnp.where(is_max, rows, N), seg, num_segments=num)
         ctx.set_output("MaxIndex", idx)
     elif pooltype == "MIN":
         out = jax.ops.segment_min(x, seg, num_segments=num)
