@@ -164,6 +164,145 @@ class Executor:
         return list(fetches)
 
     # ------------------------------------------------------------------
+    def run_steps(self, program=None, feed=None, fetch_list=None, steps=1,
+                  scope=None, return_numpy=True):
+        """Run ``steps`` iterations of ``program`` in ONE device dispatch.
+
+        The training loop runs ON the device (``lax.scan`` over the step
+        function with the state donated as the carry), so host<->device
+        latency is paid once per call instead of once per step — the TPU
+        analog of the reference's double-buffered reader pipeline
+        (``operators/reader/create_double_buffer_reader_op.cc``) which
+        exists to hide exactly this latency on GPU.
+
+        ``feed`` values may be either one batch (reused every step) or
+        stacked ``[steps, ...]`` arrays (leading axis = step axis, sliced
+        per step in-graph).  Fetches come back stacked ``[steps, ...]``.
+        """
+        program = program if program is not None else default_main_program()
+        if not isinstance(program, Program):
+            raise TypeError("executor requires a Program")
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope if scope is not None else global_scope()
+        steps = int(steps)
+
+        block = program.global_block()
+        fetch_names = [f.name if isinstance(f, framework.Variable) else f
+                       for f in fetch_list]
+
+        device = self._feed_device()
+        per_step_feed = {}
+        const_feed = {}
+        for name, value in feed.items():
+            if isinstance(value, tuple) and len(value) == 2 and \
+                    isinstance(value[1], (list, tuple)):
+                raise ValueError(
+                    f"run_steps does not support LoD feeds (got one for "
+                    f"{name!r}); bucket/pad ragged batches and use run(), "
+                    f"or feed dense arrays")
+            var = block.var(name) if block.has_var(name) else None
+            dtype = var.dtype if var is not None else None
+            arr = _as_device_array(value, dtype, device)
+            want_shape = tuple(var.shape) \
+                if var is not None and var.shape is not None else None
+            # an array with exactly one extra leading dim of length `steps`
+            # is treated as stacked per-step batches (documented behavior;
+            # reshape away any coincidental match)
+            if want_shape is not None and arr.ndim == len(want_shape) + 1 \
+                    and arr.shape[0] == steps:
+                per_step_feed[name] = arr        # stacked [steps, ...]
+            else:
+                const_feed[name] = arr           # one batch, reused
+            scope.set_lod(name, None)
+
+        sample = dict(const_feed)
+        sample.update({n: a[0] for n, a in per_step_feed.items()})
+        parts = self._prepare(program, block, sample, tuple(fetch_names),
+                              scope)
+        sig = parts["sig"] + ("run_steps", steps,
+                              tuple(sorted(per_step_feed)))
+        step = parts["step"]
+        inout_names = parts["inout_names"]
+        create_state = parts["create_state"]
+        ro_names = parts["ro_names"]
+
+        ro_state = {n: self._state_value(scope, n, device)
+                    for n in ro_names}
+        inout_state = {n: self._state_value(scope, n, device)
+                       for n in inout_names}
+
+        self._run_counter += 1
+        base_key = jax.random.PRNGKey(
+            (program.random_seed or 0) * 1000003 + self._run_counter)
+
+        if parts["interpret"]:
+            # host ops: plain Python loop (still correct, just not fused)
+            keys = jax.random.split(base_key, steps)
+            outs = []
+            for i in range(steps):
+                feeds_i = dict(const_feed)
+                feeds_i.update({n: a[i] for n, a in per_step_feed.items()})
+                fetches, new_state = step(feeds_i, ro_state, inout_state,
+                                          keys[i])
+                inout_state = dict(inout_state)
+                inout_state.update(new_state)
+                outs.append(fetches)
+            for n, v in inout_state.items():
+                scope.set_var(n, v)
+            stacked = [jnp.stack([o[i] for o in outs])
+                       for i in range(len(fetch_names))]
+            return [np.asarray(v) for v in stacked] if return_numpy \
+                else stacked
+
+        if sig in self._cache:
+            self._cache[sig] = self._cache.pop(sig)
+            fn = self._cache[sig]
+        else:
+            def multi(const_feeds, per_feeds, ro_state, carry, base_key):
+                keys = jax.random.split(base_key, steps)
+
+                def body(carry, xs):
+                    key, step_feeds = xs
+                    feeds = dict(const_feeds)
+                    feeds.update(step_feeds)
+                    fetches, new_state = step(feeds, ro_state, carry, key)
+                    new_carry = {n: new_state.get(n, carry[n])
+                                 for n in carry}
+                    return new_carry, tuple(fetches)
+
+                carry, ys = jax.lax.scan(body, carry, (keys, per_feeds))
+                return ys, carry
+
+            fn = jax.jit(multi, donate_argnums=(3,))
+            if len(self._cache) >= 64:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[sig] = fn
+
+        carry = dict(inout_state)
+        # write-only persistables (create_state) ride the carry too so the
+        # final value lands back in the scope like run() does; uninitialized
+        # ones are seeded with zeros of their traced shape
+        missing = [n for n in create_state if n not in carry]
+        seeded = [n for n in missing if scope.find_var(n) is not None]
+        for n in seeded:
+            carry[n] = self._state_value(scope, n, device)
+        still = [n for n in missing if n not in carry]
+        if still:
+            _, out_shapes = jax.eval_shape(
+                step, sample, ro_state, inout_state, jax.random.PRNGKey(0))
+            for n in still:
+                if n in out_shapes:
+                    sd = out_shapes[n]
+                    carry[n] = jnp.zeros(sd.shape, sd.dtype)
+        ys, final = fn(const_feed, per_step_feed, ro_state, carry, base_key)
+        for n, v in final.items():
+            scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(v) for v in ys]
+        return list(ys)
+
+    # ------------------------------------------------------------------
     def _feed_device(self):
         """Target placement for feed arrays; ParallelExecutor overrides to
         None so sharded placement happens against the mesh instead."""
@@ -177,27 +316,42 @@ class Executor:
                 f"variable {name!r} is not initialized in the scope — "
                 f"run the startup program first")
         if isinstance(v, np.ndarray):
-            v = jnp.asarray(v)
+            # commit to the target device: mixed committed/uncommitted
+            # arguments would give the same computation two jit signatures
+            # (one extra compile on the second call)
+            v = jax.device_put(jnp.asarray(v), device) if device is not None \
+                else jnp.asarray(v)
             scope.set_var(name, v)
         return v
 
     # ------------------------------------------------------------------
-    def _get_compiled(self, program, block, feed_arrays, fetch_names, scope):
-        # LoD (ragged row-splits) is static trace-time metadata on TPU: a
-        # distinct lod means a distinct compiled executable (bucket batches
-        # upstream to bound recompiles; reference carries LoD on the tensor,
-        # lod_tensor.h:110).
+    def _signature(self, program, block, feed_arrays, fetch_names, scope):
+        """Cheap cache key — no per-op work, safe to compute every step.
+
+        LoD (ragged row-splits) is static trace-time metadata on TPU: a
+        distinct lod means a distinct compiled executable (bucket batches
+        upstream to bound recompiles; reference carries LoD on the tensor,
+        lod_tensor.h:110).
+        """
         feed_lods = tuple(sorted(
             (n, _freeze_lod(scope.find_lod(n))) for n in feed_arrays
             if scope.find_lod(n) is not None))
-        sig = (id(program), program._version, block.idx,
-               tuple(sorted((n, str(a.dtype), a.shape)
-                            for n, a in feed_arrays.items())),
-               feed_lods,
-               fetch_names)
-        if sig in self._cache:
-            self._cache[sig] = self._cache.pop(sig)  # LRU bump
-            return self._cache[sig]
+        return (id(program), program._version, block.idx,
+                tuple(sorted((n, str(a.dtype), a.shape)
+                             for n, a in feed_arrays.items())),
+                feed_lods,
+                tuple(fetch_names))
+
+    # ------------------------------------------------------------------
+    def _prepare(self, program, block, feed_arrays, fetch_names, scope):
+        """Classify block variables and build the traceable step function.
+
+        Returns a dict with the cache signature, the (untraced) ``step``
+        callable, the state-name partitions, and the interpret flag.
+        O(#ops) — callers should hit the signature cache first.
+        """
+        sig = self._signature(program, block, feed_arrays, fetch_names,
+                              scope)
 
         feed_names = tuple(sorted(feed_arrays))
 
@@ -255,8 +409,9 @@ class Executor:
         training = not program._is_inference
         interpret = _has_host_ops(block)
 
-        lod_map = {n: [list(level) for level in lod]
-                   for n, lod in feed_lods}
+        lod_map = {n: [list(level) for level in scope.find_lod(n)]
+                   for n in feed_arrays
+                   if scope.find_lod(n) is not None}
 
         def step(feeds, ro_state, inout_state, rng_key):
             env = {}
@@ -271,15 +426,31 @@ class Executor:
                          if n in env}
             return fetches, new_state
 
-        if interpret:
+        return {"sig": sig, "step": step, "feed_names": feed_names,
+                "ro_names": ro_names, "inout_names": inout_names,
+                "create_state": create_state, "interpret": interpret,
+                "uses_rng": uses_rng}
+
+    # ------------------------------------------------------------------
+    def _get_compiled(self, program, block, feed_arrays, fetch_names, scope):
+        sig = self._signature(program, block, feed_arrays, fetch_names,
+                              scope)
+        if sig in self._cache:
+            self._cache[sig] = self._cache.pop(sig)  # LRU bump
+            return self._cache[sig]
+        parts = self._prepare(program, block, feed_arrays, fetch_names,
+                              scope)
+
+        if parts["interpret"]:
             # op-by-op eager execution — needed when a host op (data-
             # dependent shapes, numpy DP) is in the block; the reference's
             # analogous path is its per-op CPU-kernel interpreter
-            fn = step
+            fn = parts["step"]
         else:
-            fn = jax.jit(step, donate_argnums=(2,))
-        compiled = _CompiledBlock(fn, feed_names, ro_names, inout_names,
-                                  tuple(fetch_names), uses_rng)
+            fn = jax.jit(parts["step"], donate_argnums=(2,))
+        compiled = _CompiledBlock(fn, parts["feed_names"],
+                                  parts["ro_names"], parts["inout_names"],
+                                  tuple(fetch_names), parts["uses_rng"])
         if len(self._cache) >= 64:  # LRU-evict the coldest executable
             self._cache.pop(next(iter(self._cache)))
         self._cache[sig] = compiled

@@ -1,0 +1,100 @@
+"""Executor.run_steps: the device-side multi-step training loop.
+
+Covers: stacked per-step feeds, single-batch broadcast feeds, state
+write-back across calls, and interleaving with plain ``run``.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+@pytest.fixture
+def regression():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        cost = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    return main, startup, cost
+
+
+def _data(steps=20, batch=8):
+    rng = np.random.RandomState(0)
+    w = np.array([[1.0], [2.0], [-1.0]], "float32")
+    xs = rng.randn(steps, batch, 3).astype("float32")
+    ys = xs @ w + 0.5
+    return xs, ys
+
+
+def test_stacked_feeds_train(regression):
+    main, startup, cost = regression
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs, ys = _data()
+        (losses,) = exe.run_steps(main, feed={"x": xs, "y": ys},
+                                  fetch_list=[cost.name], steps=20)
+        losses = np.asarray(losses).reshape(-1)
+        assert losses.shape == (20,)
+        assert losses[-1] < losses[0] * 0.2
+
+
+def test_broadcast_single_batch(regression):
+    main, startup, cost = regression
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs, ys = _data()
+        (losses,) = exe.run_steps(main, feed={"x": xs[0], "y": ys[0]},
+                                  fetch_list=[cost.name], steps=10)
+        losses = np.asarray(losses).reshape(-1)
+        assert losses.shape == (10,)
+        assert losses[-1] < losses[0]
+
+
+def test_state_persists_and_interleaves_with_run(regression):
+    main, startup, cost = regression
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs, ys = _data()
+        (l1,) = exe.run_steps(main, feed={"x": xs, "y": ys},
+                              fetch_list=[cost.name], steps=20)
+        # a second multi-step call continues from the updated params
+        (l2,) = exe.run_steps(main, feed={"x": xs, "y": ys},
+                              fetch_list=[cost.name], steps=20)
+        assert np.asarray(l2)[0] < np.asarray(l1)[0]
+        # and a plain run sees the trained weights too
+        (l3,) = exe.run(main, feed={"x": xs[0], "y": ys[0]},
+                        fetch_list=[cost.name])
+        assert float(np.asarray(l3).reshape(())) < \
+            float(np.asarray(l1).reshape(-1)[0])
+
+
+def test_equivalent_to_per_step_runs(regression):
+    main, startup, cost = regression
+    xs, ys = _data(steps=5)
+    # run_steps path
+    s1 = fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (ls,) = exe.run_steps(main, feed={"x": xs, "y": ys},
+                              fetch_list=[cost.name], steps=5)
+    # per-step path
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        per = [float(np.asarray(
+            exe.run(main, feed={"x": xs[i], "y": ys[i]},
+                    fetch_list=[cost.name])[0]).reshape(()))
+            for i in range(5)]
+    np.testing.assert_allclose(np.asarray(ls).reshape(-1), per, rtol=1e-5)
