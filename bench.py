@@ -49,7 +49,7 @@ def main():
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
     hp = T.ModelHyperParams()
     if on_tpu:
-        batch, seq = 128, 256
+        batch, seq = 256, 256
         warmup_calls, steps = 2, 16
     else:  # tiny smoke config for dev machines
         hp.d_model, hp.d_inner_hid, hp.n_layer = 64, 128, 2
@@ -64,6 +64,8 @@ def main():
         avg_cost, _ = T.transformer(batch, seq, seq, hp)
         opt = fluid.optimizer.Adam(learning_rate=1e-4)
         opt.minimize(avg_cost)
+    # bf16 compute with f32 master weights (mixed precision)
+    main_prog.amp = on_tpu
 
     scope = fluid.Scope()
     with fluid.scope_guard(scope):

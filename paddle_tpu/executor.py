@@ -336,7 +336,7 @@ class Executor:
         feed_lods = tuple(sorted(
             (n, _freeze_lod(scope.find_lod(n))) for n in feed_arrays
             if scope.find_lod(n) is not None))
-        return (id(program), program._version, block.idx,
+        return (id(program), program._version, block.idx, _amp_enabled(program),
                 tuple(sorted((n, str(a.dtype), a.shape)
                              for n, a in feed_arrays.items())),
                 feed_lods,
@@ -413,13 +413,16 @@ class Executor:
                    for n in feed_arrays
                    if scope.find_lod(n) is not None}
 
+        amp = _amp_enabled(program)
+
         def step(feeds, ro_state, inout_state, rng_key):
             env = {}
             env.update(feeds)
             env.update(ro_state)
             env.update(inout_state)
             aux = {"rng_counter": 0, "scope": scope,
-                   "lower_block": lower_block, "lod": dict(lod_map)}
+                   "lower_block": lower_block, "lod": dict(lod_map),
+                   "amp": amp}
             lower_block(block, env, rng_key, training, aux)
             fetches = [env[n] for n in self.fetch_missing_check(fetch_names, env)]
             new_state = {n: env[n] for n in inout_names + create_state
@@ -466,6 +469,17 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+def _amp_enabled(program):
+    """Mixed precision: per-program ``Program.amp`` wins; env default
+    PADDLE_TPU_AMP=1 covers existing scripts (gflags-style config,
+    SURVEY.md §5.6)."""
+    if getattr(program, "amp", None) is not None:
+        return bool(program.amp)
+    import os
+    return os.environ.get("PADDLE_TPU_AMP", "0").strip().lower() \
+        not in ("0", "", "false", "off", "no")
 
 
 def _has_host_ops(block):

@@ -406,6 +406,9 @@ class Program:
         self.random_seed = 0
         # parity with reference Program attributes
         self._is_inference = False
+        # mixed precision (bf16 compute, f32 master weights).  None = defer
+        # to the PADDLE_TPU_AMP env var; True/False = explicit per-program.
+        self.amp = None
 
     # -- blocks ------------------------------------------------------------
     def global_block(self):
@@ -502,12 +505,14 @@ class Program:
     # -- serialization -----------------------------------------------------
     def to_dict(self):
         return {"blocks": [b.to_dict() for b in self.blocks],
-                "random_seed": self.random_seed}
+                "random_seed": self.random_seed,
+                "amp": self.amp}
 
     @staticmethod
     def from_dict(d):
         p = Program()
         p.random_seed = d.get("random_seed", 0)
+        p.amp = d.get("amp")
         # create all blocks first so sub-block attrs can resolve
         for bd in d["blocks"][1:]:
             b = Block(p, bd["idx"], parent_idx=bd["parent_idx"])

@@ -124,9 +124,12 @@ def multi_head_attention(queries, keys, values, d_key, d_value, d_model,
     scale = float(d_key) ** -0.5
 
     # the VMEM-fused kernel wins once the [S,S] score tensor dominates HBM
-    # traffic (measured on v5e: S=1024 flash 6.9ms vs XLA 5.7ms; S=4096
-    # flash 13.0ms vs XLA 27.1ms) — crossover is between 1k and 4k
-    use_flash = use_flash and (k.shape[2] >= 2048)
+    # traffic; crossover is workload-dependent, so the threshold is a knob
+    # (PADDLE_TPU_FLASH_MIN_S, default 2048 from the r1 measurement:
+    # S=1024 flash 6.9ms vs XLA 5.7ms; S=4096 flash 13.0ms vs XLA 27.1ms)
+    import os
+    flash_min_s = int(os.environ.get("PADDLE_TPU_FLASH_MIN_S", "2048"))
+    use_flash = use_flash and (k.shape[2] >= flash_min_s)
 
     if use_flash and not dropout_rate:
         ctx = layers.fused_attention(q, k, v, k_mask=k_mask, causal=causal,

@@ -28,17 +28,26 @@ NEG_INF = -1e9
 
 
 def _reference_attention(q, k, v, k_mask, causal, scale):
-    """Plain-XLA attention; also the vjp path for the Pallas forward."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    """Plain-XLA attention; also the vjp path for the Pallas forward.
+
+    Dtype-stable: scores/softmax in f32, output in ``q.dtype`` — so the
+    fallback path and the Pallas kernel (out dtype = q.dtype) agree, and
+    vjp cotangents always match the forward output dtype (bf16 under AMP).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if k_mask is not None:
-        s = s + (1.0 - k_mask[:, None, None, :]) * NEG_INF
+        s = s + (1.0 - k_mask[:, None, None, :].astype(jnp.float32)) \
+            * NEG_INF
     if causal:
         S_q, S_k = q.shape[2], k.shape[2]
         row = jax.lax.broadcasted_iota(jnp.int32, (S_q, S_k), 0)
         col = jax.lax.broadcasted_iota(jnp.int32, (S_q, S_k), 1)
         s = s + jnp.where(col > row, NEG_INF, 0.0)[None, None]
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, causal, scale,
@@ -49,7 +58,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, causal, scale,
     s = jax.lax.dot_general(
         q, k, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale   # [Bq, S]
-    mask = mask_ref[0, 0]               # [S] (mask arrives [B, 1, S])
+    mask = mask_ref[0, 0].astype(jnp.float32)  # [S] (mask arrives [B, 1, S])
     s = s + (1.0 - mask)[None, :] * NEG_INF
     if causal:
         i = pl.program_id(2)
@@ -61,8 +70,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, causal, scale,
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     denom = jnp.sum(p, axis=-1, keepdims=True)
+    # second MXU pass in the kv dtype (bf16 under mixed precision)
     o = jax.lax.dot_general(
-        p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+        p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32) / denom
     o_ref[0, 0] = o.astype(o_ref.dtype)
 
@@ -158,28 +168,40 @@ def _infer_attn(op, block):
 
 
 def _attn_grad_lower(ctx: LowerContext):
-    q = ctx.env[ctx.op.input("Q")[0]]
-    k = ctx.env[ctx.op.input("K")[0]]
-    v = ctx.env[ctx.op.input("V")[0]]
+    qe = ctx.env[ctx.op.input("Q")[0]]
+    ke = ctx.env[ctx.op.input("K")[0]]
+    ve = ctx.env[ctx.op.input("V")[0]]
     mask_names = ctx.op.input("KMask")
     k_mask = ctx.env[mask_names[0]] if mask_names else None
     if k_mask is None:
-        k_mask = jnp.ones((q.shape[0], k.shape[2]), q.dtype)
+        k_mask = jnp.ones((qe.shape[0], ke.shape[2]), qe.dtype)
     causal = ctx.attr("causal", False)
     scale = ctx.attr("scale", 1.0)
     g = ctx.env[ctx.op.input("Out@GRAD")[0]]
+    # mirror the forward's AMP cast so the vjp's output dtype matches the
+    # cotangent coming back from (possibly bf16) downstream consumers;
+    # emitted grads are cast back to the primal env dtypes
+    amp = bool(ctx.aux.get("amp"))
+
+    def cast_in(x):
+        return x.astype(jnp.bfloat16) \
+            if amp and x.dtype == jnp.float32 else x
+
+    q, k, v = cast_in(qe), cast_in(ke), cast_in(ve)
     _, vjp_fn = jax.vjp(
         lambda q_, k_, v_: _reference_attention(q_, k_, v_, k_mask,
                                                 causal, scale), q, k, v)
-    dq, dk, dv = vjp_fn(g)
-    for slot, val in (("Q@GRAD", dq), ("K@GRAD", dk), ("V@GRAD", dv)):
+    dq, dk, dv = vjp_fn(g.astype(q.dtype))
+    for slot, val, prim in (("Q@GRAD", dq, qe), ("K@GRAD", dk, ke),
+                            ("V@GRAD", dv, ve)):
         names = ctx.op.output(slot)
         if names and names[0]:
-            ctx.outputs[names[0]] = val
+            ctx.outputs[names[0]] = val.astype(prim.dtype)
 
 
 @register_op("scaled_dot_product_attention", infer_shape=_infer_attn,
-             grad_lower=_attn_grad_lower, no_grad_inputs=("KMask",))
+             grad_lower=_attn_grad_lower, no_grad_inputs=("KMask",),
+             amp_cast=("Q", "K", "V"))
 def sdpa_lower(ctx: LowerContext):
     """Q,K,V: [B, H, S, D]; KMask: [B, S_k] (1=attend); Out: [B, H, Sq, D].
 

@@ -35,7 +35,7 @@ def _infer_cross_entropy(op, block):
 
 
 @register_op("cross_entropy", infer_shape=_infer_cross_entropy,
-             no_grad_inputs=("Label",))
+             no_grad_inputs=("Label",), amp_upcast=("X",))
 def cross_entropy_lower(ctx):
     x = ctx.input("X")  # probabilities (N, D)
     label = ctx.input("Label")
@@ -63,7 +63,7 @@ def _infer_softmax_ce(op, block):
 
 @register_op("softmax_with_cross_entropy", infer_shape=_infer_softmax_ce,
              no_grad_inputs=("Label",),
-             stop_gradient_outputs=("Softmax",))
+             stop_gradient_outputs=("Softmax",), amp_upcast=("Logits",))
 def softmax_with_cross_entropy_lower(ctx):
     logits = ctx.input("Logits")
     label = ctx.input("Label")

@@ -37,7 +37,7 @@ def _infer_mul(op, block):
     out.lod_level = x.lod_level
 
 
-@register_op("mul", infer_shape=_infer_mul)
+@register_op("mul", infer_shape=_infer_mul, amp_cast=("X", "Y"))
 def mul_lower(ctx):
     x, y = ctx.input("X"), ctx.input("Y")
     xn = ctx.attr("x_num_col_dims", 1)
@@ -75,7 +75,7 @@ def _infer_matmul(op, block):
     out.dtype = x.dtype
 
 
-@register_op("matmul", infer_shape=_infer_matmul)
+@register_op("matmul", infer_shape=_infer_matmul, amp_cast=("X", "Y"))
 def matmul_lower(ctx):
     x, y = ctx.input("X"), ctx.input("Y")
     if ctx.attr("transpose_X", False):

@@ -63,12 +63,14 @@ def _conv2d_lower_impl(ctx, depthwise=False):
     ctx.set_output("Output", out.astype(x.dtype))
 
 
-@register_op("conv2d", infer_shape=_infer_conv2d)
+@register_op("conv2d", infer_shape=_infer_conv2d,
+             amp_cast=("Input", "Filter"))
 def conv2d_lower(ctx):
     _conv2d_lower_impl(ctx)
 
 
-@register_op("depthwise_conv2d", infer_shape=_infer_conv2d)
+@register_op("depthwise_conv2d", infer_shape=_infer_conv2d,
+             amp_cast=("Input", "Filter"))
 def depthwise_conv2d_lower(ctx):
     _conv2d_lower_impl(ctx, depthwise=True)
 
@@ -94,7 +96,8 @@ def _infer_conv2d_transpose(op, block):
     out.dtype = x.dtype
 
 
-@register_op("conv2d_transpose", infer_shape=_infer_conv2d_transpose)
+@register_op("conv2d_transpose", infer_shape=_infer_conv2d_transpose,
+             amp_cast=("Input", "Filter"))
 def conv2d_transpose_lower(ctx):
     x = ctx.input("Input")
     w = ctx.input("Filter")  # (C_in, C_out, kh, kw)
@@ -125,7 +128,8 @@ def _infer_conv3d(op, block):
     out.dtype = x.dtype
 
 
-@register_op("conv3d", infer_shape=_infer_conv3d)
+@register_op("conv3d", infer_shape=_infer_conv3d,
+             amp_cast=("Input", "Filter"))
 def conv3d_lower(ctx):
     x = ctx.input("Input")
     w = ctx.input("Filter")

@@ -43,10 +43,21 @@ class ShapeInferenceSkip(Exception):
 class OpDef:
     def __init__(self, type, lower=None, infer_shape=None, grad_maker=None,
                  grad_lower=None, no_grad_inputs=(), stop_gradient_outputs=(),
-                 uses_rng=False, stateful_outputs=(), host=False):
+                 uses_rng=False, stateful_outputs=(), host=False,
+                 amp_cast=(), amp_upcast=()):
         self.type = type
         self.lower = lower
         self.infer_shape = infer_shape
+        # mixed precision (the reference's float16 story, platform/float16.h,
+        # re-designed for TPU bf16): when the program runs with amp enabled,
+        # float32 arrays read through the listed input slots are cast to
+        # bfloat16 (amp_cast — compute-heavy MXU ops) or forced to float32
+        # (amp_upcast — numerically sensitive ops).  bf16 shares f32's
+        # exponent range, so no loss scaling is needed; parameters stay f32
+        # in the scope (master weights) and jax.vjp of the cast returns f32
+        # cotangents, so optimizer updates are full precision.
+        self.amp_cast = frozenset(amp_cast)
+        self.amp_upcast = frozenset(amp_upcast)
         # grad_maker: fn(op, block, no_grad_set) -> (list of op-desc dicts,
         #   dict fwd_input_name -> grad_name).  None => default auto maker.
         self.grad_maker = grad_maker
@@ -68,7 +79,8 @@ class OpDef:
 
 def register_op(type, *, infer_shape=None, grad_maker=None, grad_lower=None,
                 no_grad_inputs=(), stop_gradient_outputs=(), uses_rng=False,
-                no_gradient=False, stateful_outputs=(), host=False):
+                no_gradient=False, stateful_outputs=(), host=False,
+                amp_cast=(), amp_upcast=()):
     """Decorator: register ``fn(ctx)`` as the lowering for op ``type``."""
 
     def deco(fn):
@@ -77,7 +89,7 @@ def register_op(type, *, infer_shape=None, grad_maker=None, grad_lower=None,
                       no_grad_inputs=no_grad_inputs,
                       stop_gradient_outputs=stop_gradient_outputs,
                       uses_rng=uses_rng, stateful_outputs=stateful_outputs,
-                      host=host)
+                      host=host, amp_cast=amp_cast, amp_upcast=amp_upcast)
         opdef.has_grad = not no_gradient
         _REGISTRY[type] = opdef
         return fn
@@ -184,14 +196,30 @@ class LowerContext:
         names = self.op.input(slot)
         return bool(names) and names[0] in self.env
 
+    def _amp_cast(self, slot, value):
+        """bf16-downcast / f32-upcast per the op's AMP slot lists (active
+        only when the executor enabled mixed precision for this program)."""
+        if value is None or not self.aux.get("amp"):
+            return value
+        opdef = lookup(self.op.type)
+        if opdef is None:
+            return value
+        dt = getattr(value, "dtype", None)
+        if slot in opdef.amp_cast and dt == jax.numpy.float32:
+            return value.astype(jax.numpy.bfloat16)
+        if slot in opdef.amp_upcast and dt == jax.numpy.bfloat16:
+            return value.astype(jax.numpy.float32)
+        return value
+
     def input(self, slot):
         names = self.op.input(slot)
         if not names:
             return None
-        return self.env[names[0]]
+        return self._amp_cast(slot, self.env[names[0]])
 
     def inputs(self, slot):
-        return [self.env[n] for n in self.op.input(slot)]
+        return [self._amp_cast(slot, self.env[n])
+                for n in self.op.input(slot)]
 
     def input_var(self, slot):
         names = self.op.input(slot)
@@ -409,7 +437,7 @@ def auto_vjp_grad_lower(fwd_type):
         for n in out_names:
             g = grad_of_out.get(n)
             if g and g in ctx.env:
-                cots.append(ctx.env[g])
+                cots.append(_match_cotangent_dtype(ctx.env[g], ctx.env[n]))
             else:
                 cots.append(zeros_cotangent(ctx.env[n]))
         grads = vjp_fn(tuple(cots))
@@ -420,6 +448,26 @@ def auto_vjp_grad_lower(fwd_type):
                     ctx.outputs[gname] = g
 
     return lower
+
+
+def _match_cotangent_dtype(cot, out_val):
+    """Cast inexact array cotangents to the forward output's dtype — under
+    mixed precision an op's output may be bf16 while the incoming grad is
+    f32 (or vice versa), and jax.vjp requires an exact dtype match."""
+    jnp = jax.numpy
+
+    def c(ct, ov):
+        if hasattr(ct, "dtype") and hasattr(ov, "dtype") \
+                and ct.dtype != ov.dtype \
+                and jnp.issubdtype(ct.dtype, jnp.inexact) \
+                and jnp.issubdtype(ov.dtype, jnp.inexact):
+            return ct.astype(ov.dtype)
+        return ct
+
+    try:
+        return jax.tree_util.tree_map(c, cot, out_val)
+    except ValueError:  # mismatched pytree structure — leave untouched
+        return cot
 
 
 def _fwd_output_slots(grad_op):

@@ -1,0 +1,98 @@
+"""Mixed precision (bf16 compute, f32 master weights).
+
+TPU re-design of the reference's float16 support
+(``paddle/fluid/platform/float16.h:80`` and fp16-capable kernels): instead
+of a software half type with per-kernel variants, AMP-listed op lowerings
+cast f32 inputs to bf16 (MXU-native) while parameters, optimizer state,
+and numerically sensitive ops (losses, norms) stay f32.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build_mlp():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=4, act="softmax")
+        cost = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=pred, label=y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(cost)
+    return main, startup, cost
+
+
+def test_amp_compute_is_bf16():
+    """With amp on, a matmul of two f32 feeds runs in bf16 (observable on
+    the op output dtype)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data(name="a", shape=[4, 8], dtype="float32",
+                              append_batch_size=False)
+        b = fluid.layers.data(name="b", shape=[8, 4], dtype="float32",
+                              append_batch_size=False)
+        out = fluid.layers.matmul(a, b)
+    main.amp = True
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (o,) = exe.run(main,
+                   feed={"a": np.ones((4, 8), "float32"),
+                         "b": np.ones((8, 4), "float32")},
+                   fetch_list=[out.name], return_numpy=False)
+    assert str(o.dtype) == "bfloat16"
+    np.testing.assert_allclose(np.asarray(o, dtype="float32"), 8.0)
+
+    # and with amp off (default) it stays f32
+    main.amp = False
+    (o,) = exe.run(main,
+                   feed={"a": np.ones((4, 8), "float32"),
+                         "b": np.ones((8, 4), "float32")},
+                   fetch_list=[out.name], return_numpy=False)
+    assert str(o.dtype) == "float32"
+
+
+def test_amp_trains_with_f32_master_weights():
+    main, startup, cost = _build_mlp()
+    main.amp = True
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(64, 16).astype("float32")
+        ys = (xs[:, :4].argmax(-1) % 4).astype("int64").reshape(-1, 1)
+        losses = []
+        for _ in range(40):
+            (l,) = exe.run(main, feed={"x": xs, "y": ys},
+                           fetch_list=[cost.name])
+            losses.append(float(np.asarray(l).reshape(())))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        # parameters (master weights) remain float32 in the scope
+        for name, v in scope.items():
+            if v is not None and hasattr(v, "dtype") and \
+                    "fc" in name and not name.endswith("@GRAD"):
+                assert str(v.dtype) == "float32", name
+
+
+def test_amp_matches_f32_closely():
+    """One step of amp vs f32 training must agree to bf16 tolerance."""
+    def run_once(amp):
+        main, startup, cost = _build_mlp()
+        main.amp = amp
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(1)
+            xs = rng.randn(32, 16).astype("float32")
+            ys = rng.randint(0, 4, (32, 1)).astype("int64")
+            (l,) = exe.run(main, feed={"x": xs, "y": ys},
+                           fetch_list=[cost.name])
+            return float(np.asarray(l).reshape(()))
+
+    l_f32 = run_once(False)
+    l_amp = run_once(True)
+    assert abs(l_f32 - l_amp) < 0.05 * max(1.0, abs(l_f32)), (l_f32, l_amp)
