@@ -481,6 +481,8 @@ class Program:
         kept.reverse()
 
         pruned = Program()
+        pruned.random_seed = self.random_seed
+        pruned.amp = self.amp
         # copy sub-blocks wholesale (indices preserved) so block attrs resolve
         for b in self.blocks[1:]:
             nb = Block(pruned, len(pruned.blocks), parent_idx=b.parent_idx)

@@ -765,11 +765,15 @@ def fused_attention(q, k, v, k_mask=None, causal=False, scale=1.0,
     [B, S_k] with 1 = attend."""
     helper = LayerHelper("scaled_dot_product_attention", name=name)
     out = helper.create_tmp_variable(q.dtype)
+    # Lse: softmax log-normalizer residual saved by the flash kernel so the
+    # backward op reuses it instead of re-running the forward
+    lse = helper.create_tmp_variable("float32")
+    lse.stop_gradient = True
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if k_mask is not None:
         inputs["KMask"] = [k_mask]
     helper.append_op(type="scaled_dot_product_attention", inputs=inputs,
-                     outputs={"Out": [out]},
+                     outputs={"Out": [out], "Lse": [lse]},
                      attrs={"causal": causal, "scale": float(scale),
                             "use_flash": use_flash})
     return out

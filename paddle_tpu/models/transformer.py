@@ -125,8 +125,8 @@ def multi_head_attention(queries, keys, values, d_key, d_value, d_model,
 
     # the VMEM-fused kernel wins once the [S,S] score tensor dominates HBM
     # traffic; crossover is workload-dependent, so the threshold is a knob
-    # (PADDLE_TPU_FLASH_MIN_S, default 2048 from the r1 measurement:
-    # S=1024 flash 6.9ms vs XLA 5.7ms; S=4096 flash 13.0ms vs XLA 27.1ms)
+    # (PADDLE_TPU_FLASH_MIN_S; default 2048 from the v5e fwd+bwd causal
+    # measurement: S=2048 flash 10.3ms vs XLA 13.7ms; S=8192 18.4 vs 246)
     import os
     flash_min_s = int(os.environ.get("PADDLE_TPU_FLASH_MIN_S", "2048"))
     use_flash = use_flash and (k.shape[2] >= flash_min_s)
