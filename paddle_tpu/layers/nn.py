@@ -27,6 +27,7 @@ __all__ = [
     "squeeze", "unsqueeze", "gather", "scatter", "slice", "shape",
     "prelu", "maxout", "nce", "im2sequence", "multiplex", "row_conv", "fused_attention",
     "autoincreased_step_counter", "cos_sim", "dot_product_attention",
+    "beam_search", "beam_search_decode",
 ]
 
 
@@ -514,6 +515,52 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
 def row_conv(input, future_context_size, param_attr=None, act=None):
     raise NotImplementedError(
         "row_conv lands with the sequence-op group (build-plan step 6)")
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, name=None):
+    """One beam-search pruning step over dense ``[B, K]`` beams (reference
+    ``layers`` beam_search -> ``beam_search_op.cc``; see
+    ops/beam_search_ops.py for the static-shape re-design).
+
+    Returns (selected_ids, selected_scores, parent_idx), each [B, K].
+    ``level`` is accepted for API parity (the LoD level has no dense
+    equivalent).
+    """
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_tmp_variable("int64")
+    sel_scores = helper.create_tmp_variable("float32")
+    parent = helper.create_tmp_variable("int64")
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                "ids": [ids], "scores": [scores]},
+        outputs={"selected_ids": [sel_ids],
+                 "selected_scores": [sel_scores],
+                 "parent_idx": [parent]},
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id),
+               "level": int(level)})
+    return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids, parent_idx, scores, max_len=None, name=None):
+    """Backtrack per-step (ids, parent) TensorArrays into full hypotheses
+    (reference ``beam_search_decode_op.cc``).  Returns
+    (sentence_ids [B, K, T], sentence_scores [B, K])."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_tmp_variable("int64")
+    sent_scores = helper.create_tmp_variable("float32")
+    attrs = {}
+    if max_len is not None:
+        attrs["max_len"] = int(max_len)
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "ParentIdx": [parent_idx],
+                "Scores": [scores]},
+        outputs={"SentenceIds": [sent_ids],
+                 "SentenceScores": [sent_scores]},
+        attrs=attrs)
+    return sent_ids, sent_scores
 
 
 def autoincreased_step_counter(counter_name=None, begin=1, step=1):

@@ -22,4 +22,5 @@ from paddle_tpu.ops import (  # noqa: F401
     attention_ops,
     crf_ops,
     ctc_ops,
+    beam_search_ops,
 )

@@ -1,0 +1,148 @@
+"""Beam search ops (reference ``paddle/fluid/operators/beam_search_op.cc``,
+``beam_search_decode_op.cc`` — the seq2seq decoding workload,
+``tests/book/test_machine_translation.py``).
+
+TPU re-design: the reference tracks live beams as a 2-level LoDTensor whose
+rows shrink as hypotheses finish; shrinking shapes cannot compile under
+XLA, so here beams live in a STATIC ``[batch, beam_size]`` layout for the
+whole decode:
+
+  * ``beam_search`` prunes candidates one step: finished beams (last id ==
+    end_id) survive as a single (end_id, pre_score) candidate — exactly the
+    reference's keep-finished semantics — and the per-batch top-K runs over
+    the flattened ``beam*cand`` axis on dense tensors.
+  * Parent pointers are an explicit ``parent_idx`` output ([B, K] int64)
+    instead of LoD bookkeeping.
+  * ``beam_search_decode`` backtracks the (ids, parents) step arrays in one
+    ``lax.scan`` to emit padded ``[B, K, T]`` sequences + final scores
+    (the reference walks sentence vectors on the CPU,
+    beam_search_decode_op.cc BeamSearchDecoder).
+
+First-step convention: seed ``pre_scores`` with 0 for beam 0 and -1e9 for
+beams 1..K-1 so the K initially identical beams don't flood the top-K
+(the reference starts from a 1-beam LoD instead).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.registry import (
+    register_op, LowerContext, ShapeInferenceSkip)
+from paddle_tpu.ops.control_flow_ops import TensorArray
+
+NEG_INF = -1e9
+
+
+def _infer_beam_search(op, block):
+    pre = block.var(op.input("pre_ids")[0])
+    if pre.shape is None:
+        raise ShapeInferenceSkip()
+    B, K = pre.shape[0], op.attr("beam_size")
+    for slot, dtype in (("selected_ids", "int64"),
+                        ("selected_scores", "float32"),
+                        ("parent_idx", "int64")):
+        names = op.output(slot)
+        if names:
+            v = block.var(names[0])
+            v.shape = (B, K)
+            v.dtype = dtype
+
+
+@register_op("beam_search", infer_shape=_infer_beam_search,
+             no_gradient=True)
+def beam_search_lower(ctx: LowerContext):
+    """One pruning step.
+
+    Inputs  (dense; C = number of candidates per beam, usually K):
+      pre_ids    [B, K] int    last selected token per beam
+      pre_scores [B, K] f32    accumulated log-prob per beam
+      ids        [B, K, C] int candidate token ids (e.g. topk indices)
+      scores     [B, K, C] f32 ACCUMULATED log-prob of each candidate
+                               (pre_score + log p, as the reference's
+                               callers compute, test_machine_translation.py)
+    Attrs: beam_size K, end_id.
+    Outputs: selected_ids / selected_scores / parent_idx, all [B, K].
+    """
+    pre_ids = ctx.input("pre_ids")
+    pre_scores = ctx.input("pre_scores")
+    ids = ctx.input("ids")
+    scores = ctx.input("scores")
+    K = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id"))
+    B, Kb, C = scores.shape
+
+    finished = (pre_ids == end_id)                       # [B, K]
+    # live beams offer their candidates; finished beams offer exactly one
+    # candidate: (end_id, unchanged score) in slot 0
+    cand_scores = jnp.where(finished[:, :, None],
+                            jnp.float32(NEG_INF), scores)
+    slot0 = jnp.where(finished, pre_scores,
+                      cand_scores[:, :, 0])
+    cand_scores = cand_scores.at[:, :, 0].set(slot0)
+    cand_ids = jnp.where(finished[:, :, None],
+                         jnp.asarray(end_id, ids.dtype), ids)
+
+    flat_scores = cand_scores.reshape(B, Kb * C)
+    sel_scores, flat_idx = jax.lax.top_k(flat_scores, K)  # [B, K]
+    parent = (flat_idx // C).astype(jnp.int64)
+    sel_ids = jnp.take_along_axis(
+        cand_ids.reshape(B, Kb * C), flat_idx, axis=1).astype(jnp.int64)
+
+    ctx.set_output("selected_ids", sel_ids)
+    ctx.set_output("selected_scores", sel_scores.astype(jnp.float32))
+    ctx.set_output("parent_idx", parent)
+
+
+def _infer_bs_decode(op, block):
+    raise ShapeInferenceSkip()
+
+
+@register_op("beam_search_decode", infer_shape=_infer_bs_decode,
+             no_gradient=True)
+def beam_search_decode_lower(ctx: LowerContext):
+    """Backtrack parent pointers into full hypotheses.
+
+    Inputs:
+      Ids       TensorArray of T steps, each [B, K] int64 selected ids
+      ParentIdx TensorArray of T steps, each [B, K] int64 parent beams
+      Scores    [B, K] f32 final accumulated scores
+    Outputs:
+      SentenceIds    [B, K, T] int64 (beams sorted best-first, padded with
+                     end_id after the first end_id)
+      SentenceScores [B, K] f32
+    """
+    ids_arr = ctx.input("Ids")
+    par_arr = ctx.input("ParentIdx")
+    scores = ctx.input("Scores")
+    if not isinstance(ids_arr, TensorArray):
+        raise TypeError("beam_search_decode Ids must be a TensorArray")
+    T = ctx.attr("max_len", None)
+    if T is None:
+        try:
+            T = int(ids_arr.length)
+        except Exception as e:  # traced length: require the static attr
+            raise ValueError(
+                "beam_search_decode needs a static step count: set the "
+                "'max_len' attr when decoding inside traced control flow"
+            ) from e
+    ids = ids_arr.data[:T].astype(jnp.int64)       # [T, B, K]
+    parents = par_arr.data[:T].astype(jnp.int64)   # [T, B, K]
+    B, K = ids.shape[1], ids.shape[2]
+
+    # walk backwards: token at step t for final beam k follows the parent
+    # chain from the last step
+    init_ptr = jnp.tile(jnp.arange(K, dtype=jnp.int64)[None], (B, 1))
+
+    def back(ptr, x):
+        step_ids, step_par = x
+        tok = jnp.take_along_axis(step_ids, ptr, axis=1)    # [B, K]
+        nxt = jnp.take_along_axis(step_par, ptr, axis=1)
+        return nxt, tok
+
+    _, toks = jax.lax.scan(back, init_ptr, (ids[::-1], parents[::-1]))
+    seqs = jnp.moveaxis(toks[::-1], 0, -1)          # [B, K, T]
+    ctx.set_output("SentenceIds", seqs)
+    ctx.set_output("SentenceScores", jnp.asarray(scores, jnp.float32))

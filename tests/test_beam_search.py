@@ -1,0 +1,255 @@
+"""Beam search: op-level semantics + an MT inference decode of a trained
+toy seq2seq (reference ``beam_search_op.cc``, ``beam_search_decode_op.cc``,
+``tests/book/test_machine_translation.py`` decode path)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+
+
+# ---------------------------------------------------------------------------
+# op-level
+# ---------------------------------------------------------------------------
+
+def _run_beam_search(pre_ids, pre_scores, ids, scores, K, end_id):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p_i = layers.data(name="p_i", shape=list(pre_ids.shape),
+                          dtype="int64", append_batch_size=False)
+        p_s = layers.data(name="p_s", shape=list(pre_scores.shape),
+                          dtype="float32", append_batch_size=False)
+        c_i = layers.data(name="c_i", shape=list(ids.shape),
+                          dtype="int64", append_batch_size=False)
+        c_s = layers.data(name="c_s", shape=list(scores.shape),
+                          dtype="float32", append_batch_size=False)
+        s_i, s_s, par = layers.beam_search(p_i, p_s, c_i, c_s, K, end_id)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed={"p_i": pre_ids, "p_s": pre_scores,
+                               "c_i": ids, "c_s": scores},
+                   fetch_list=[s_i, s_s, par])
+
+
+class TestBeamSearchOp:
+    def test_topk_across_beams(self):
+        # B=1, K=2, C=2: all live; candidates with accumulated scores
+        pre_ids = np.array([[5, 7]], "int64")
+        pre_scores = np.array([[-1.0, -2.0]], "float32")
+        ids = np.array([[[10, 11], [12, 13]]], "int64")
+        scores = np.array([[[-1.5, -3.0], [-2.1, -9.0]]], "float32")
+        s_i, s_s, par = _run_beam_search(pre_ids, pre_scores, ids, scores,
+                                         2, end_id=0)
+        # best two accumulated: -1.5 (beam0 tok10), -2.1 (beam1 tok12)
+        np.testing.assert_array_equal(s_i, [[10, 12]])
+        np.testing.assert_allclose(s_s, [[-1.5, -2.1]], atol=1e-6)
+        np.testing.assert_array_equal(par, [[0, 1]])
+
+    def test_finished_beam_keeps_score_and_end_id(self):
+        end = 0
+        pre_ids = np.array([[end, 7]], "int64")      # beam 0 finished
+        pre_scores = np.array([[-0.5, -2.0]], "float32")
+        ids = np.array([[[10, 11], [12, 13]]], "int64")
+        scores = np.array([[[-0.1, -0.2], [-2.5, -9.0]]], "float32")
+        s_i, s_s, par = _run_beam_search(pre_ids, pre_scores, ids, scores,
+                                         2, end_id=end)
+        # finished beam contributes ONLY (end, -0.5); its candidate scores
+        # (-0.1, better than anything) must be ignored
+        np.testing.assert_array_equal(s_i, [[end, 12]])
+        np.testing.assert_allclose(s_s, [[-0.5, -2.5]], atol=1e-6)
+        np.testing.assert_array_equal(par, [[0, 1]])
+
+
+class TestBeamSearchDecodeOp:
+    def test_backtrack(self):
+        # B=1, K=2, T=3; hand-built parent chains
+        main, startup = fluid.Program(), fluid.Program()
+        steps_ids = [np.array([[4, 5]], "int64"),
+                     np.array([[6, 7]], "int64"),
+                     np.array([[8, 9]], "int64")]
+        # step parents: t=0 trivial; t=1: beam0<-1, beam1<-0;
+        # t=2: beam0<-0, beam1<-1
+        steps_par = [np.array([[0, 1]], "int64"),
+                     np.array([[1, 0]], "int64"),
+                     np.array([[0, 1]], "int64")]
+        with fluid.program_guard(main, startup):
+            i0 = layers.zeros(shape=[1], dtype="int64")
+            ids_arr = layers.array_write(
+                layers.assign(steps_ids[0]), i=i0)
+            par_arr = layers.array_write(
+                layers.assign(steps_par[0]), i=i0)
+            for t in (1, 2):
+                it = layers.fill_constant(shape=[1], dtype="int64", value=t)
+                layers.array_write(layers.assign(steps_ids[t]), i=it,
+                                   array=ids_arr)
+                layers.array_write(layers.assign(steps_par[t]), i=it,
+                                   array=par_arr)
+            final_scores = layers.assign(
+                np.array([[-1.0, -2.0]], "float32"))
+            sent, sscores = layers.beam_search_decode(
+                ids_arr, par_arr, final_scores, max_len=3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        seq, sc = exe.run(main, fetch_list=[sent, sscores])
+        # final beam 0: t2 tok 8 parent 0 -> t1 beam0 tok 6 parent 1 ->
+        # t0 beam1 tok 5
+        np.testing.assert_array_equal(seq[0, 0], [5, 6, 8])
+        # final beam 1: t2 tok 9 parent 1 -> t1 tok 7 parent 0 -> t0 tok 4
+        np.testing.assert_array_equal(seq[0, 1], [4, 7, 9])
+        np.testing.assert_allclose(sc, [[-1.0, -2.0]], atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: train a toy seq2seq, beam-decode actual token ids
+# ---------------------------------------------------------------------------
+
+DICT, EMB, HID = 64, 16, 32
+B, K, SRC_LEN, TRG_LEN = 4, 3, 6, 5
+START = 1
+
+
+def _build_train():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data(name="src", shape=[-1, 1], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        trg = layers.data(name="trg", shape=[-1, 1], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        label = layers.data(name="label", shape=[-1, 1], dtype="int64",
+                            append_batch_size=False, lod_level=1)
+        src_emb = layers.embedding(input=src, size=[DICT, EMB],
+                                   param_attr=fluid.ParamAttr("src_emb_w"))
+        enc_proj = layers.fc(input=src_emb, size=HID * 3,
+                             param_attr=fluid.ParamAttr("enc_proj_w"),
+                             bias_attr=False)
+        enc = layers.dynamic_gru(input=enc_proj, size=HID,
+                                 param_attr=fluid.ParamAttr("enc_gru_w"))
+        enc_last = layers.sequence_last_step(enc)
+        trg_emb = layers.embedding(input=trg, size=[DICT, EMB],
+                                   param_attr=fluid.ParamAttr("trg_emb_w"))
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            cur = drnn.step_input(trg_emb)
+            mem = drnn.memory(init=enc_last)
+            dec_h = layers.fc(input=[cur, mem], size=HID, act="tanh",
+                              param_attr=fluid.ParamAttr("dec_fc_w"),
+                              bias_attr=fluid.ParamAttr("dec_fc_b"))
+            drnn.update_memory(mem, dec_h)
+            out = layers.fc(input=dec_h, size=DICT, act="softmax",
+                            param_attr=fluid.ParamAttr("dec_out_w"),
+                            bias_attr=fluid.ParamAttr("dec_out_b"))
+            drnn.output(out)
+        predictions = drnn()
+        cost = layers.cross_entropy(input=predictions, label=label)
+        avg_cost = layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(avg_cost)
+    return main, startup, avg_cost
+
+
+def _build_decode():
+    """Unrolled beam decode re-using the TRAINED parameter names."""
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        src = layers.data(name="src", shape=[-1, 1], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        src_emb = layers.embedding(input=src, size=[DICT, EMB],
+                                   param_attr=fluid.ParamAttr("src_emb_w"))
+        enc_proj = layers.fc(input=src_emb, size=HID * 3,
+                             param_attr=fluid.ParamAttr("enc_proj_w"),
+                             bias_attr=False)
+        enc = layers.dynamic_gru(input=enc_proj, size=HID,
+                                 param_attr=fluid.ParamAttr("enc_gru_w"))
+        enc_last = layers.sequence_last_step(enc)          # [B, HID]
+
+        # tile the encoder state over the beam axis: [B*K, HID]
+        mem = layers.reshape(
+            layers.expand(layers.reshape(enc_last, shape=[B, 1, HID]),
+                          expand_times=[1, K, 1]),
+            shape=[B * K, HID])
+
+        pre_ids = layers.assign(np.full((B, K), START, "int64"))
+        pre_scores = layers.assign(
+            np.tile(np.array([[0.0] + [-1e9] * (K - 1)], "float32"),
+                    (B, 1)))
+        beam_offset = layers.assign(
+            (np.arange(B, dtype="int64")[:, None] * K).repeat(K, 1))
+
+        i0 = layers.zeros(shape=[1], dtype="int64")
+        ids_arr = None
+        par_arr = None
+        for t in range(TRG_LEN):
+            cur = layers.embedding(
+                input=layers.reshape(pre_ids, shape=[B * K, 1]),
+                size=[DICT, EMB], param_attr=fluid.ParamAttr("trg_emb_w"))
+            dec_h = layers.fc(input=[cur, mem], size=HID, act="tanh",
+                              param_attr=fluid.ParamAttr("dec_fc_w"),
+                              bias_attr=fluid.ParamAttr("dec_fc_b"))
+            out = layers.fc(input=dec_h, size=DICT, act="softmax",
+                            param_attr=fluid.ParamAttr("dec_out_w"),
+                            bias_attr=fluid.ParamAttr("dec_out_b"))
+            probs = layers.reshape(out, shape=[B, K, DICT])
+            topk_scores, topk_idx = layers.topk(probs, k=K)   # [B, K, K]
+            acc = layers.ops.log(topk_scores) + layers.reshape(
+                pre_scores, shape=[B, K, 1])
+            sel_ids, sel_scores, parent = layers.beam_search(
+                pre_ids, pre_scores, topk_idx, acc, K, end_id=0)
+            # reorder decoder memories by parent beam
+            flat_parent = layers.reshape(parent + beam_offset,
+                                         shape=[B * K])
+            mem = layers.gather(dec_h, flat_parent)
+            it = layers.fill_constant(shape=[1], dtype="int64", value=t)
+            if ids_arr is None:
+                ids_arr = layers.array_write(sel_ids, i=it)
+                par_arr = layers.array_write(parent, i=it)
+            else:
+                layers.array_write(sel_ids, i=it, array=ids_arr)
+                layers.array_write(parent, i=it, array=par_arr)
+            pre_ids, pre_scores = sel_ids, sel_scores
+
+        sent, sscores = layers.beam_search_decode(
+            ids_arr, par_arr, pre_scores, max_len=TRG_LEN)
+    return prog, startup, sent, sscores
+
+
+def test_mt_beam_decode_nondegenerate():
+    from tests.test_book_machine_translation import _batches
+
+    train, startup, avg_cost = _build_train()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for src_f, src_lod, trg_f, trg_lod, lab in _batches(200):
+            (lv,) = exe.run(
+                train,
+                feed={"src": (src_f, src_lod), "trg": (trg_f, trg_lod),
+                      "label": (lab, trg_lod)},
+                fetch_list=[avg_cost])
+        assert float(np.asarray(lv).reshape(())) < 1.5
+
+        decode, dec_startup, sent, sscores = _build_decode()
+        exe.run(dec_startup)    # no-op: all params already trained
+        rng = np.random.RandomState(7)
+        src = rng.randint(2, DICT, size=(B, SRC_LEN)).astype("int64")
+        src_lod = [list(range(0, B * SRC_LEN + 1, SRC_LEN))]
+        seqs, scores = exe.run(
+            decode, feed={"src": (src.reshape(-1, 1), src_lod)},
+            fetch_list=[sent, sscores])
+
+    assert seqs.shape == (B, K, TRG_LEN)
+    # non-degenerate: top beams differ across examples and aren't constant
+    top = seqs[:, 0, :]
+    assert len({tuple(r) for r in top}) > 1
+    assert not np.all(top == top[:, :1])
+    # the task is deterministic (next = 3*prev+1 seeded by src[:,0]); a
+    # trained model's top beam should match most target positions
+    want = np.empty((B, TRG_LEN), "int64")
+    want[:, 0] = (src[:, 0] * 3 + 1) % DICT
+    for t in range(1, TRG_LEN):
+        want[:, t] = (want[:, t - 1] * 3 + 1) % DICT
+    acc = (top == want).mean()
+    assert acc > 0.6, (acc, top[:2], want[:2])
+    # beams come back best-first
+    assert np.all(np.diff(scores, axis=1) <= 1e-5)
