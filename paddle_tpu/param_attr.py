@@ -33,7 +33,9 @@ class ParamAttr:
         if isinstance(arg, init_mod.Initializer):
             return ParamAttr(initializer=arg)
         if isinstance(arg, bool):
-            return ParamAttr() if arg else ParamAttr(trainable=False)
+            # reference layer_helper.py:381 treats a falsy bias_attr as
+            # "no bias"; True means default attrs
+            return ParamAttr() if arg else None
         raise TypeError(f"cannot make ParamAttr from {arg!r}")
 
     def set_default_initializer(self, initializer):

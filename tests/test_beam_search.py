@@ -123,9 +123,13 @@ def _build_train():
         enc_proj = layers.fc(input=src_emb, size=HID * 3,
                              param_attr=fluid.ParamAttr("enc_proj_w"),
                              bias_attr=False)
-        enc = layers.dynamic_gru(input=enc_proj, size=HID,
-                                 param_attr=fluid.ParamAttr("enc_gru_w"))
-        enc_last = layers.sequence_last_step(enc)
+        # reversed encoder: the t=0 state has consumed the whole source
+        # ending at src[0] (the chain seed), so sequence_first_step carries
+        # the seed directly into the decoder init
+        enc = layers.dynamic_gru(input=enc_proj, size=HID, is_reverse=True,
+                                 param_attr=fluid.ParamAttr("enc_gru_w"),
+                                 bias_attr=fluid.ParamAttr("enc_gru_b"))
+        enc_last = layers.sequence_first_step(enc)
         trg_emb = layers.embedding(input=trg, size=[DICT, EMB],
                                    param_attr=fluid.ParamAttr("trg_emb_w"))
         drnn = layers.DynamicRNN()
@@ -133,7 +137,8 @@ def _build_train():
             cur = drnn.step_input(trg_emb)
             mem = drnn.memory(init=enc_last)
             dec_h = layers.fc(input=[cur, mem], size=HID, act="tanh",
-                              param_attr=fluid.ParamAttr("dec_fc_w"),
+                              param_attr=[fluid.ParamAttr("dec_fc_w_x"),
+                                          fluid.ParamAttr("dec_fc_w_h")],
                               bias_attr=fluid.ParamAttr("dec_fc_b"))
             drnn.update_memory(mem, dec_h)
             out = layers.fc(input=dec_h, size=DICT, act="softmax",
@@ -159,9 +164,13 @@ def _build_decode():
         enc_proj = layers.fc(input=src_emb, size=HID * 3,
                              param_attr=fluid.ParamAttr("enc_proj_w"),
                              bias_attr=False)
-        enc = layers.dynamic_gru(input=enc_proj, size=HID,
-                                 param_attr=fluid.ParamAttr("enc_gru_w"))
-        enc_last = layers.sequence_last_step(enc)          # [B, HID]
+        # reversed encoder: the t=0 state has consumed the whole source
+        # ending at src[0] (the chain seed), so sequence_first_step carries
+        # the seed directly into the decoder init
+        enc = layers.dynamic_gru(input=enc_proj, size=HID, is_reverse=True,
+                                 param_attr=fluid.ParamAttr("enc_gru_w"),
+                                 bias_attr=fluid.ParamAttr("enc_gru_b"))
+        enc_last = layers.sequence_first_step(enc)          # [B, HID]
 
         # tile the encoder state over the beam axis: [B*K, HID]
         mem = layers.reshape(
@@ -184,7 +193,8 @@ def _build_decode():
                 input=layers.reshape(pre_ids, shape=[B * K, 1]),
                 size=[DICT, EMB], param_attr=fluid.ParamAttr("trg_emb_w"))
             dec_h = layers.fc(input=[cur, mem], size=HID, act="tanh",
-                              param_attr=fluid.ParamAttr("dec_fc_w"),
+                              param_attr=[fluid.ParamAttr("dec_fc_w_x"),
+                                          fluid.ParamAttr("dec_fc_w_h")],
                               bias_attr=fluid.ParamAttr("dec_fc_b"))
             out = layers.fc(input=dec_h, size=DICT, act="softmax",
                             param_attr=fluid.ParamAttr("dec_out_w"),
@@ -230,7 +240,9 @@ def test_mt_beam_decode_nondegenerate():
         assert float(np.asarray(lv).reshape(())) < 1.5
 
         decode, dec_startup, sent, sscores = _build_decode()
-        exe.run(dec_startup)    # no-op: all params already trained
+        # do NOT run dec_startup: every decode parameter is named and
+        # already trained; re-running init ops would clobber them (same
+        # behavior as the reference executor)
         rng = np.random.RandomState(7)
         src = rng.randint(2, DICT, size=(B, SRC_LEN)).astype("int64")
         src_lod = [list(range(0, B * SRC_LEN + 1, SRC_LEN))]
