@@ -12,6 +12,8 @@ from paddle_tpu.layers import sequence  # noqa: F401
 from paddle_tpu.layers import learning_rate_scheduler  # noqa: F401
 from paddle_tpu.layers.learning_rate_scheduler import *  # noqa: F401,F403
 
+from paddle_tpu.layers.detection import *  # noqa: F401,F403
+from paddle_tpu.layers import detection  # noqa: F401
 from paddle_tpu.layers import nn  # noqa: F401
 from paddle_tpu.layers import tensor  # noqa: F401
 from paddle_tpu.layers import ops  # noqa: F401

@@ -160,13 +160,19 @@ class Auc(MetricBase):
 
 
 class DetectionMAP(MetricBase):
+    """Running mean of the per-batch mAP produced by the ``detection_map``
+    op (reference ``python/paddle/fluid/metrics.py`` DetectionMAP)."""
+
     def __init__(self, name=None):
         super().__init__(name)
-        self.has_state = None
+        self.value = 0.0
+        self.weight = 0.0
 
-    def update(self, value, weight=None):
-        self.has_state = True
+    def update(self, value, weight=1.0):
+        self.value += float(np.asarray(value).reshape(-1)[0]) * float(weight)
+        self.weight += float(weight)
 
-    def eval(self):  # pragma: no cover
-        raise NotImplementedError(
-            "DetectionMAP metric lands with the detection op group")
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("DetectionMAP: no batches accumulated")
+        return self.value / self.weight

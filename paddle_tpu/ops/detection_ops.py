@@ -1,0 +1,799 @@
+"""Detection / vision op group — the reference's SSD pipeline.
+
+Reference kernels: ``paddle/fluid/operators/prior_box_op.h``,
+``box_coder_op.h``, ``iou_similarity_op.h``, ``bipartite_match_op.cc``,
+``target_assign_op.h``, ``mine_hard_examples_op.cc``, ``multiclass_nms_op.cc``,
+``roi_pool_op.h``, ``detection_map_op.h``; Python wrappers
+``python/paddle/fluid/layers/detection.py``.
+
+TPU re-design notes
+-------------------
+* ``prior_box`` depends only on static shapes + attrs, so boxes are computed
+  in numpy at trace time and emitted as XLA constants (folded into the graph).
+* ``bipartite_match`` — the reference's greedy CPU loop becomes a
+  ``lax.fori_loop`` with a static trip bound of min(rows, cols) per LoD
+  instance, so the whole op stays inside the jitted computation (the
+  reference pins it to CPUPlace).
+* ``mine_hard_examples`` emits ``NegIndices`` as a DENSE ``[N, P]`` int32
+  tensor padded with -1 (indices sorted by descending loss) instead of the
+  reference's ragged LoD tensor — static shapes for XLA; ``target_assign``
+  accepts this dense form (and the flat LoD form for parity).
+* ``multiclass_nms`` / ``detection_map`` produce data-dependent row counts,
+  so they are host ops (eager numpy), mirroring the reference which registers
+  both as CPU-only kernels.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.registry import (
+    register_op, register_grad_lower, ShapeInferenceSkip)
+
+_KEPS = 1e-6
+
+
+# ---------------------------------------------------------------------------
+# iou_similarity
+# ---------------------------------------------------------------------------
+
+def _infer_iou(op, block):
+    x = block.var(op.input("X")[0])
+    y = block.var(op.input("Y")[0])
+    out = block.var(op.output("Out")[0])
+    if x.shape is None or y.shape is None:
+        raise ShapeInferenceSkip()
+    out.shape = (x.shape[0], y.shape[0])
+    out.dtype = x.dtype
+    out.lod_level = x.lod_level
+
+
+def _iou_matrix(a, b):
+    """Pairwise IoU of [N,4] x [M,4] boxes (xmin,ymin,xmax,ymax) —
+    vectorized form of reference IOUSimilarity (iou_similarity_op.h:20)."""
+    area1 = (a[:, 3] - a[:, 1]) * (a[:, 2] - a[:, 0])       # [N]
+    area2 = (b[:, 3] - b[:, 1]) * (b[:, 2] - b[:, 0])       # [M]
+    ixmin = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iymin = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ixmax = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iymax = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ixmax - ixmin, 0.0)
+    ih = jnp.maximum(iymax - iymin, 0.0)
+    inter = iw * ih
+    union = area1[:, None] + area2[None, :] - inter
+    return inter / union
+
+
+@register_op("iou_similarity", infer_shape=_infer_iou, no_gradient=True)
+def iou_similarity_lower(ctx):
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    ctx.set_output("Out", _iou_matrix(x, y))
+    lod = ctx.input_lod("X")
+    if lod is not None:
+        ctx.set_output_lod("Out", lod)
+
+
+# ---------------------------------------------------------------------------
+# box_coder
+# ---------------------------------------------------------------------------
+
+def _infer_box_coder(op, block):
+    tb = block.var(op.input("TargetBox")[0])
+    pb = block.var(op.input("PriorBox")[0])
+    out = block.var(op.output("OutputBox")[0])
+    if tb.shape is None or pb.shape is None:
+        raise ShapeInferenceSkip()
+    ct = op.attr("code_type", "encode_center_size")
+    if ct == "encode_center_size":
+        out.shape = (tb.shape[0], pb.shape[0], 4)
+    else:
+        out.shape = tuple(tb.shape)
+    out.dtype = tb.dtype
+    out.lod_level = tb.lod_level
+
+
+@register_op("box_coder", infer_shape=_infer_box_coder, no_gradient=True)
+def box_coder_lower(ctx):
+    """Reference box_coder_op.h EncodeCenterSize/DecodeCenterSize."""
+    prior = ctx.input("PriorBox")          # [M, 4]
+    pvar = ctx.input("PriorBoxVar")        # [M, 4] or None
+    target = ctx.input("TargetBox")
+    code_type = ctx.attr("code_type", "encode_center_size")
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = (prior[:, 2] + prior[:, 0]) / 2
+    pcy = (prior[:, 3] + prior[:, 1]) / 2
+    if code_type == "encode_center_size":
+        # target [N,4] -> out [N, M, 4]
+        tcx = (target[:, 2] + target[:, 0]) / 2
+        tcy = (target[:, 3] + target[:, 1]) / 2
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :])) / pvar[None, :, 2]
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :])) / pvar[None, :, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+    elif code_type == "decode_center_size":
+        # target [N, M, 4] (deltas) -> out [N, M, 4] (corner boxes)
+        tcx = pvar[None, :, 0] * target[..., 0] * pw[None, :] + pcx[None, :]
+        tcy = pvar[None, :, 1] * target[..., 1] * ph[None, :] + pcy[None, :]
+        tw = jnp.exp(pvar[None, :, 2] * target[..., 2]) * pw[None, :]
+        th = jnp.exp(pvar[None, :, 3] * target[..., 3]) * ph[None, :]
+        out = jnp.stack([tcx - tw / 2, tcy - th / 2,
+                         tcx + tw / 2, tcy + th / 2], axis=-1)
+    else:
+        raise ValueError(f"box_coder: unknown code_type {code_type!r}")
+    ctx.set_output("OutputBox", out)
+    lod = ctx.input_lod("TargetBox")
+    if lod is not None:
+        ctx.set_output_lod("OutputBox", lod)
+
+
+# ---------------------------------------------------------------------------
+# prior_box
+# ---------------------------------------------------------------------------
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """Reference ExpandAspectRatios (prior_box_op.h:23)."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+def _infer_prior_box(op, block):
+    inp = block.var(op.input("Input")[0])
+    if inp.shape is None:
+        raise ShapeInferenceSkip()
+    min_sizes = op.attr("min_sizes", [])
+    max_sizes = op.attr("max_sizes", []) or []
+    ars = _expand_aspect_ratios(op.attr("aspect_ratios", []),
+                                op.attr("flip", False))
+    num_priors = len(ars) * len(min_sizes) + len(max_sizes)
+    h, w = inp.shape[2], inp.shape[3]
+    for slot in ("Boxes", "Variances"):
+        v = block.var(op.output(slot)[0])
+        v.shape = (h, w, num_priors, 4)
+        v.dtype = "float32"
+
+
+@register_op("prior_box", infer_shape=_infer_prior_box, no_gradient=True)
+def prior_box_lower(ctx):
+    """Shape/attr-only computation: done in numpy at trace time, emitted as
+    constants (reference prior_box_op.h:56 loops per pixel at run time)."""
+    inp = ctx.input("Input")
+    img = ctx.input("Image")
+    fh, fw = inp.shape[2], inp.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    min_sizes = [float(s) for s in ctx.attr("min_sizes", [])]
+    max_sizes = [float(s) for s in (ctx.attr("max_sizes", []) or [])]
+    ars = _expand_aspect_ratios(ctx.attr("aspect_ratios", []),
+                                ctx.attr("flip", False))
+    variances = [float(v) for v in
+                 ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(ctx.attr("step_w", 0.0) or 0.0)
+    step_h = float(ctx.attr("step_h", 0.0) or 0.0)
+    offset = float(ctx.attr("offset", 0.5))
+    sw = step_w if step_w > 0 else iw / fw
+    sh = step_h if step_h > 0 else ih / fh
+
+    # per-prior half-sizes in the reference's interleaved order: for each
+    # min_size, all aspect ratios then (optionally) the max_size square
+    half_w, half_h = [], []
+    for s, ms in enumerate(min_sizes):
+        for ar in ars:
+            half_w.append(ms * math.sqrt(ar) / 2.0)
+            half_h.append(ms / math.sqrt(ar) / 2.0)
+        if max_sizes:
+            sq = math.sqrt(ms * max_sizes[s]) / 2.0
+            half_w.append(sq)
+            half_h.append(sq)
+    num_priors = len(half_w)
+    hw = np.asarray(half_w, np.float32)[None, None, :]
+    hh = np.asarray(half_h, np.float32)[None, None, :]
+    cx = ((np.arange(fw, dtype=np.float32) + offset) * sw)[None, :, None]
+    cy = ((np.arange(fh, dtype=np.float32) + offset) * sh)[:, None, None]
+    boxes = np.stack(
+        np.broadcast_arrays((cx - hw) / iw, (cy - hh) / ih,
+                            (cx + hw) / iw, (cy + hh) / ih),
+        axis=-1).astype(np.float32)
+    if ctx.attr("clip", False):
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(
+        np.asarray(variances, np.float32),
+        (fh, fw, num_priors, 4)).copy()
+    ctx.set_output("Boxes", jnp.asarray(boxes))
+    ctx.set_output("Variances", jnp.asarray(vars_))
+
+
+# ---------------------------------------------------------------------------
+# bipartite_match
+# ---------------------------------------------------------------------------
+
+def _infer_bipartite(op, block):
+    d = block.var(op.input("DistMat")[0])
+    if d.shape is None:
+        raise ShapeInferenceSkip()
+    for slot, dt in (("ColToRowMatchIndices", "int32"),
+                     ("ColToRowMatchDist", d.dtype)):
+        v = block.var(op.output(slot)[0])
+        v.shape = (-1, d.shape[1])
+        v.dtype = dt
+
+
+def _bipartite_match_one(dist):
+    """Greedy max-dist matching for one instance ([rows, cols] dist) —
+    reference BipartiteMatchKernel::BipartiteMatch (bipartite_match_op.cc),
+    as a fori_loop with static bound min(rows, cols)."""
+    rows, cols = dist.shape
+    n_iter = min(rows, cols)
+
+    def body(_, state):
+        match_idx, match_dist, row_used = state
+        eligible = ((~row_used[:, None]) & (match_idx == -1)[None, :]
+                    & (dist >= _KEPS))
+        masked = jnp.where(eligible, dist, -1.0)
+        flat = jnp.argmax(masked)
+        r, c = flat // cols, flat % cols
+        ok = masked[r, c] >= _KEPS
+        match_idx = jnp.where(
+            ok, match_idx.at[c].set(r.astype(jnp.int32)), match_idx)
+        match_dist = jnp.where(ok, match_dist.at[c].set(dist[r, c]),
+                               match_dist)
+        row_used = jnp.where(ok, row_used.at[r].set(True), row_used)
+        return match_idx, match_dist, row_used
+
+    init = (jnp.full((cols,), -1, jnp.int32),
+            jnp.zeros((cols,), dist.dtype),
+            jnp.zeros((rows,), bool))
+    match_idx, match_dist, _ = jax.lax.fori_loop(0, n_iter, body, init)
+    return match_idx, match_dist
+
+
+def _argmax_match(dist, match_idx, match_dist, threshold):
+    """Reference ArgMaxMatch: per-prediction extra matches for unmatched
+    columns whose best row distance >= threshold."""
+    col_best = jnp.max(dist, axis=0)
+    col_arg = jnp.argmax(dist, axis=0).astype(jnp.int32)
+    cond = (match_idx == -1) & (col_best >= threshold) & (col_best >= _KEPS)
+    return (jnp.where(cond, col_arg, match_idx),
+            jnp.where(cond, col_best, match_dist))
+
+
+@register_op("bipartite_match", infer_shape=_infer_bipartite,
+             no_gradient=True)
+def bipartite_match_lower(ctx):
+    dist = ctx.input("DistMat")
+    lod = ctx.input_lod("DistMat")
+    match_type = ctx.attr("match_type") or "bipartite"
+    threshold = ctx.attr("dist_threshold") or 0.5
+    if lod is None:
+        segments = [(0, dist.shape[0])]
+    else:
+        splits = lod[-1]
+        segments = [(int(splits[i]), int(splits[i + 1]))
+                    for i in range(len(splits) - 1)]
+    idx_rows, dist_rows = [], []
+    for lo, hi in segments:
+        sub = jax.lax.slice_in_dim(dist, lo, hi, axis=0)
+        mi, md = _bipartite_match_one(sub)
+        if match_type == "per_prediction":
+            mi, md = _argmax_match(sub, mi, md, threshold)
+        idx_rows.append(mi)
+        dist_rows.append(md)
+    ctx.set_output("ColToRowMatchIndices", jnp.stack(idx_rows))
+    ctx.set_output("ColToRowMatchDist", jnp.stack(dist_rows))
+
+
+# ---------------------------------------------------------------------------
+# target_assign
+# ---------------------------------------------------------------------------
+
+def _infer_target_assign(op, block):
+    x = block.var(op.input("X")[0])
+    mi = block.var(op.input("MatchIndices")[0])
+    if x.shape is None or mi.shape is None:
+        raise ShapeInferenceSkip()
+    k = x.shape[-1]
+    out = block.var(op.output("Out")[0])
+    out.shape = (mi.shape[0], mi.shape[1], k)
+    out.dtype = x.dtype
+    ow = block.var(op.output("OutWeight")[0])
+    ow.shape = (mi.shape[0], mi.shape[1], 1)
+    ow.dtype = "float32"
+
+
+@register_op("target_assign", infer_shape=_infer_target_assign,
+             no_gradient=True)
+def target_assign_lower(ctx):
+    """out[i, j] = X[lod[i] + match[i, j]][j % P] where matched, else
+    mismatch_value (reference target_assign_op.h)."""
+    x = ctx.input("X")                       # [M, P, K] (LoD rows)
+    match = ctx.input("MatchIndices")        # [N, Pm] int32
+    mismatch = ctx.attr("mismatch_value", 0)
+    lod = ctx.input_lod("X")
+    n, pm = match.shape
+    if x.ndim == 2:
+        x = x[:, None, :]
+    p_x, k = x.shape[1], x.shape[2]
+    if lod is None:
+        if n != 1:
+            # reference target_assign_op.h enforces LoD on X; without it the
+            # per-instance row offsets are unknowable for a real batch
+            raise ValueError(
+                "target_assign: X must carry LoD when MatchIndices has "
+                f"{n} > 1 instances")
+        starts = [0]
+    else:
+        starts = [int(s) for s in lod[-1][:-1]]
+    col = jnp.arange(pm) % p_x               # j % P
+    outs, weights = [], []
+    for i in range(n):
+        idx = match[i]                        # [Pm]
+        rows = starts[i] + jnp.maximum(idx, 0)
+        gathered = x[rows, col]               # [Pm, K]
+        matched = (idx >= 0)[:, None]
+        out_i = jnp.where(matched, gathered,
+                          jnp.asarray(mismatch, x.dtype))
+        w_i = matched.astype(jnp.float32)
+        outs.append(out_i)
+        weights.append(w_i)
+    out = jnp.stack(outs)                     # [N, Pm, K]
+    w = jnp.stack(weights)                    # [N, Pm, 1]
+
+    neg = ctx.input("NegIndices")
+    if neg is not None:
+        neg_lod = ctx.input_lod("NegIndices")
+        if neg.ndim == 2 and neg.shape[0] == n and neg_lod is None:
+            # dense [N, P] -1-padded form from mine_hard_examples
+            neg_masks = []
+            for i in range(n):
+                ids = neg[i].reshape(-1)
+                valid = ids >= 0
+                m = jnp.zeros((pm,), bool).at[
+                    jnp.maximum(ids, 0)].max(valid)
+                neg_masks.append(m)
+            neg_mask = jnp.stack(neg_masks)   # [N, Pm]
+        else:
+            # flat [Neg, 1] + LoD form (reference layout)
+            ids = neg.reshape(-1)
+            splits = (neg_lod[-1] if neg_lod is not None
+                      else [0, ids.shape[0]])
+            rows_mask = []
+            for i in range(n):
+                lo, hi = int(splits[i]), int(splits[i + 1])
+                seg = jax.lax.slice_in_dim(ids, lo, hi)
+                m = jnp.zeros((pm,), bool).at[seg].set(True)
+                rows_mask.append(m)
+            neg_mask = jnp.stack(rows_mask)
+        out = jnp.where(neg_mask[:, :, None],
+                        jnp.asarray(mismatch, out.dtype), out)
+        w = jnp.where(neg_mask[:, :, None], 1.0, w)
+    ctx.set_output("Out", out)
+    ctx.set_output("OutWeight", w)
+
+
+# ---------------------------------------------------------------------------
+# mine_hard_examples
+# ---------------------------------------------------------------------------
+
+def _infer_mine(op, block):
+    mi = block.var(op.input("MatchIndices")[0])
+    if mi.shape is None:
+        raise ShapeInferenceSkip()
+    for slot in ("NegIndices", "UpdatedMatchIndices"):
+        names = op.output(slot)
+        if names:
+            v = block.var(names[0])
+            v.shape = tuple(mi.shape)
+            v.dtype = "int32"
+
+
+@register_op("mine_hard_examples", infer_shape=_infer_mine, no_gradient=True)
+def mine_hard_examples_lower(ctx):
+    """Reference mine_hard_examples_op.cc; NegIndices is emitted dense
+    [N, P] (-1 padded, loss-descending order) — see module docstring."""
+    cls_loss = ctx.input("ClsLoss")              # [N, P]
+    loc_loss = ctx.input("LocLoss")              # optional [N, P]
+    match = ctx.input("MatchIndices")            # [N, P] int32
+    match_dist = ctx.input("MatchDist")          # [N, P]
+    neg_pos_ratio = float(ctx.attr("neg_pos_ratio", 3.0))
+    neg_dist_threshold = float(ctx.attr("neg_dist_threshold", 0.5))
+    mining_type = ctx.attr("mining_type", "max_negative")
+    sample_size = ctx.attr("sample_size") or 0
+    n, p = match.shape
+
+    if mining_type == "hard_example":
+        if sample_size <= 0:
+            # reference mine_hard_examples_op.cc enforces sample_size > 0
+            raise ValueError(
+                "mine_hard_examples: sample_size must be > 0 in "
+                "hard_example mode")
+        eligible = jnp.ones_like(match, bool)
+        loss = cls_loss + (loc_loss if loc_loss is not None else 0.0)
+    else:  # max_negative
+        eligible = (match == -1) & (match_dist < neg_dist_threshold)
+        loss = cls_loss
+
+    masked_loss = jnp.where(eligible, loss, -jnp.inf)
+    order = jnp.argsort(-masked_loss, axis=1)    # [N, P] desc
+    num_elig = jnp.sum(eligible, axis=1)
+    if mining_type == "hard_example":
+        neg_sel = jnp.minimum(jnp.asarray(sample_size), num_elig)
+    else:
+        num_pos = jnp.sum(match != -1, axis=1)
+        neg_sel = jnp.minimum(
+            (num_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32),
+            num_elig)
+    pos_in_order = jnp.arange(p)[None, :]
+    selected_order = pos_in_order < neg_sel[:, None]
+    neg_indices = jnp.where(selected_order, order, -1).astype(jnp.int32)
+
+    if mining_type == "hard_example":
+        # positives not selected are demoted to -1
+        sel_mask = jnp.zeros((n, p), bool)
+        rows = jnp.arange(n)[:, None]
+        sel_mask = sel_mask.at[rows, order].max(selected_order)
+        updated = jnp.where((match > -1) & ~sel_mask, -1, match)
+    else:
+        updated = match
+    ctx.set_output("NegIndices", neg_indices)
+    ctx.set_output("UpdatedMatchIndices", updated)
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms (host op — data-dependent output rows, like the
+# reference's CPU-only kernel)
+# ---------------------------------------------------------------------------
+
+def _nms_one_class(boxes, scores, score_threshold, nms_top_k, nms_threshold,
+                   nms_eta):
+    """Greedy NMS for one class: returns kept indices (into boxes)."""
+    idx = np.where(scores > score_threshold)[0]
+    idx = idx[np.argsort(-scores[idx], kind="stable")]
+    if nms_top_k > -1 and len(idx) > nms_top_k:
+        idx = idx[:nms_top_k]
+    kept = []
+    adaptive_threshold = nms_threshold
+    for i in idx:
+        keep = True
+        for j in kept:
+            a, b = boxes[i], boxes[j]
+            inter_w = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+            inter_h = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+            inter = inter_w * inter_h
+            union = ((a[2] - a[0]) * (a[3] - a[1])
+                     + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+            iou = inter / union if union > 0 else 0.0
+            if iou > adaptive_threshold:
+                keep = False
+                break
+        if keep:
+            kept.append(int(i))
+            if nms_eta < 1.0 and adaptive_threshold > 0.5:
+                adaptive_threshold *= nms_eta
+    return kept
+
+
+@register_op("multiclass_nms", no_gradient=True, host=True)
+def multiclass_nms_lower(ctx):
+    """Reference multiclass_nms_op.cc; output [No, 6] rows
+    [label, score, xmin, ymin, xmax, ymax] with per-image LoD."""
+    bboxes = np.asarray(ctx.input("BBoxes"))     # [N, M, 4]
+    scores = np.asarray(ctx.input("Scores"))     # [N, C, M]
+    background = ctx.attr("background_label", 0)
+    score_threshold = float(ctx.attr("score_threshold", 0.01))
+    nms_top_k = int(ctx.attr("nms_top_k", 400))
+    keep_top_k = int(ctx.attr("keep_top_k", 200))
+    nms_threshold = float(ctx.attr("nms_threshold", 0.3))
+    nms_eta = float(ctx.attr("nms_eta", 1.0))
+    n, c, m = scores.shape
+    all_rows = []
+    lod = [0]
+    for i in range(n):
+        dets = []  # (label, score, box)
+        for cls in range(c):
+            if cls == background:
+                continue
+            kept = _nms_one_class(bboxes[i], scores[i, cls], score_threshold,
+                                  nms_top_k, nms_threshold, nms_eta)
+            dets.extend((cls, float(scores[i, cls, k]), bboxes[i, k])
+                        for k in kept)
+        if keep_top_k > -1 and len(dets) > keep_top_k:
+            dets.sort(key=lambda d: -d[1])
+            dets = dets[:keep_top_k]
+        for label, score, box in dets:
+            all_rows.append([float(label), score] + [float(v) for v in box])
+        lod.append(len(all_rows))
+    if not all_rows:
+        out = np.full((1, 1), -1.0, np.float32)
+        lod = [0] * (n + 1)
+    else:
+        out = np.asarray(all_rows, np.float32)
+    ctx.set_output("Out", jnp.asarray(out))
+    ctx.set_output_lod("Out", [lod])
+
+
+# ---------------------------------------------------------------------------
+# roi_pool
+# ---------------------------------------------------------------------------
+
+def _infer_roi_pool(op, block):
+    x = block.var(op.input("X")[0])
+    rois = block.var(op.input("ROIs")[0])
+    if x.shape is None or rois.shape is None:
+        raise ShapeInferenceSkip()
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    out = block.var(op.output("Out")[0])
+    out.shape = (rois.shape[0], x.shape[1], ph, pw)
+    out.dtype = x.dtype
+    names = op.output("Argmax")
+    if names:
+        a = block.var(names[0])
+        a.shape = out.shape
+        a.dtype = "int64"
+
+
+@register_op("roi_pool", infer_shape=_infer_roi_pool,
+             no_grad_inputs=("ROIs",), stop_gradient_outputs=("Argmax",))
+def roi_pool_lower(ctx):
+    """Max pooling over ROI bins (reference roi_pool_op.h:30).  ROI bin
+    membership is computed as masks over the full H×W plane so the op stays
+    dense/jittable; the backward scatters grads through Argmax."""
+    x = ctx.input("X")                       # [B, C, H, W]
+    rois = ctx.input("ROIs")                 # [R, 5] (batch, x1, y1, x2, y2)
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    b, c, h, w = x.shape
+
+    def one_roi(roi):
+        batch_id = roi[0].astype(jnp.int32)
+        xs = jnp.round(roi[1].astype(jnp.float32) * scale).astype(jnp.int32)
+        ys = jnp.round(roi[2].astype(jnp.float32) * scale).astype(jnp.int32)
+        xe = jnp.round(roi[3].astype(jnp.float32) * scale).astype(jnp.int32)
+        ye = jnp.round(roi[4].astype(jnp.float32) * scale).astype(jnp.int32)
+        rh = jnp.maximum(ye - ys + 1, 1).astype(jnp.float32)
+        rw = jnp.maximum(xe - xs + 1, 1).astype(jnp.float32)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        pi = jnp.arange(ph, dtype=jnp.float32)
+        pj = jnp.arange(pw, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(pi * bin_h).astype(jnp.int32) + ys, 0, h)
+        hend = jnp.clip(jnp.ceil((pi + 1) * bin_h).astype(jnp.int32) + ys,
+                        0, h)
+        wstart = jnp.clip(jnp.floor(pj * bin_w).astype(jnp.int32) + xs, 0, w)
+        wend = jnp.clip(jnp.ceil((pj + 1) * bin_w).astype(jnp.int32) + xs,
+                        0, w)
+        hh = jnp.arange(h)
+        ww = jnp.arange(w)
+        hmask = (hh[None, :] >= hstart[:, None]) & (hh[None, :] <
+                                                    hend[:, None])   # [PH,H]
+        wmask = (ww[None, :] >= wstart[:, None]) & (ww[None, :] <
+                                                    wend[:, None])   # [PW,W]
+        mask = hmask[:, None, :, None] & wmask[None, :, None, :]  # [PH,PW,H,W]
+        feat = jnp.take(x, batch_id, axis=0)                      # [C, H, W]
+        vals = jnp.where(mask[None], feat[:, None, None, :, :], -jnp.inf)
+        flat = vals.reshape(c, ph, pw, h * w)
+        out = jnp.max(flat, axis=-1)
+        arg = jnp.argmax(flat, axis=-1).astype(jnp.int64)
+        empty = ~jnp.any(mask, axis=(2, 3))                       # [PH, PW]
+        out = jnp.where(empty[None], 0.0, out)
+        arg = jnp.where(empty[None], -1, arg)
+        return out, arg, batch_id
+
+    outs, args, batch_ids = jax.vmap(one_roi)(rois)
+    ctx.set_output("Out", outs)
+    ctx.set_output("Argmax", args)
+
+
+@register_grad_lower("roi_pool")
+def roi_pool_grad_lower(ctx):
+    """Scatter-add dOut into dX at the recorded Argmax positions."""
+    x = ctx.input("X")
+    rois = ctx.input("ROIs")
+    argmax = ctx.input("Argmax")             # [R, C, PH, PW] flat h*w or -1
+    dout = ctx.input("Out@GRAD")
+    gname = ctx.op.output("X@GRAD")
+    if not gname or not gname[0]:
+        return
+    b, c, h, w = x.shape
+    r = rois.shape[0]
+    batch_ids = rois[:, 0].astype(jnp.int32)            # [R]
+    dx = jnp.zeros((b, c, h * w), x.dtype)
+    valid = argmax >= 0
+    flat_arg = jnp.maximum(argmax, 0).astype(jnp.int32)  # [R, C, PH, PW]
+    contrib = jnp.where(valid, dout, 0.0)
+    bidx = jnp.broadcast_to(batch_ids[:, None, None, None], argmax.shape)
+    cidx = jnp.broadcast_to(jnp.arange(c)[None, :, None, None], argmax.shape)
+    dx = dx.at[bidx.reshape(-1), cidx.reshape(-1),
+               flat_arg.reshape(-1)].add(contrib.reshape(-1))
+    ctx.outputs[gname[0]] = dx.reshape(b, c, h, w)
+
+
+# ---------------------------------------------------------------------------
+# detection_map (host op; streaming mAP accumulators)
+# ---------------------------------------------------------------------------
+
+def _clip_box(box):
+    return np.clip(box, 0.0, 1.0)
+
+
+def _jaccard(a, b):
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = iw * ih
+    union = ((a[2] - a[0]) * (a[3] - a[1])
+             + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / union if union > 0 else 0.0
+
+
+def _average_precision(tps, fps, num_pos, ap_type):
+    """tps/fps: lists of (score, count) pairs; reference CalcMAP."""
+    pairs_tp = sorted(tps, key=lambda p: -p[0])
+    pairs_fp = sorted(fps, key=lambda p: -p[0])
+    tp_sum = np.cumsum([p[1] for p in pairs_tp])
+    fp_sum = np.cumsum([p[1] for p in pairs_fp])
+    if len(tp_sum) == 0 or num_pos == 0:
+        return None
+    precision = tp_sum / np.maximum(tp_sum + fp_sum, 1e-12)
+    recall = tp_sum / float(num_pos)
+    if ap_type == "11point":
+        max_precisions = np.zeros(11)
+        start_idx = len(recall) - 1
+        for j in range(10, -1, -1):
+            for i in range(start_idx, -1, -1):
+                if recall[i] < j / 10.0:
+                    start_idx = i
+                    if j > 0:
+                        max_precisions[j - 1] = max_precisions[j]
+                    break
+                if max_precisions[j] < precision[i]:
+                    max_precisions[j] = precision[i]
+        return float(np.sum(max_precisions) / 11.0)
+    # integral
+    ap = 0.0
+    prev_recall = 0.0
+    for p, r in zip(precision, recall):
+        if abs(r - prev_recall) > 1e-6:
+            ap += p * abs(r - prev_recall)
+        prev_recall = r
+    return float(ap)
+
+
+@register_op("detection_map", no_gradient=True, host=True)
+def detection_map_lower(ctx):
+    """Streaming VOC mAP (reference detection_map_op.h).  Accumulator state
+    is carried as: AccumPosCount [C,1] int32; AccumTruePos / AccumFalsePos
+    [K,2] float32 (score, flag) with a per-class LoD."""
+    detect = np.asarray(ctx.input("DetectRes"))  # [Nd, 6]
+    label = np.asarray(ctx.input("Label"))       # [Ng, 5 or 6]
+    det_lod = ctx.input_lod("DetectRes")
+    label_lod = ctx.input_lod("Label")
+    class_num = int(ctx.attr("class_num"))
+    overlap_threshold = float(ctx.attr("overlap_threshold", 0.3))
+    evaluate_difficult = bool(ctx.attr("evaluate_difficult", True))
+    ap_type = ctx.attr("ap_type", "integral")
+    background = ctx.attr("background_label", 0)
+    if det_lod is None or label_lod is None:
+        raise ValueError("detection_map requires LoD on DetectRes and Label")
+    det_splits = det_lod[0]
+    lab_splits = label_lod[0]
+    batch = len(lab_splits) - 1
+
+    pos_count = {}
+    true_pos = {i: [] for i in range(class_num)}
+    false_pos = {i: [] for i in range(class_num)}
+
+    # merge previous state
+    has_state = ctx.input("HasState")
+    state_on = has_state is not None and int(np.asarray(has_state).reshape(-1)[0]) != 0
+    in_pos = ctx.input("PosCount")
+    if in_pos is not None and state_on:
+        arr = np.asarray(in_pos).reshape(-1)
+        for i in range(min(class_num, arr.shape[0])):
+            pos_count[i] = int(arr[i])
+        for slot, store in (("TruePos", true_pos), ("FalsePos", false_pos)):
+            t = ctx.input(slot)
+            tl = ctx.input_lod(slot)
+            if t is None or tl is None:
+                continue
+            t = np.asarray(t)
+            sp = tl[0]
+            for i in range(len(sp) - 1):
+                for j in range(int(sp[i]), int(sp[i + 1])):
+                    store[i].append((float(t[j, 0]), int(t[j, 1])))
+
+    # parse boxes per image
+    for n in range(batch):
+        gts = {}
+        for i in range(int(lab_splits[n]), int(lab_splits[n + 1])):
+            row = label[i]
+            if row.shape[0] == 6:
+                cls, difficult, box = int(row[0]), bool(row[1]), row[2:6]
+            else:
+                cls, difficult, box = int(row[0]), False, row[1:5]
+            gts.setdefault(cls, []).append((box, difficult))
+        for cls, boxes in gts.items():
+            cnt = (len(boxes) if evaluate_difficult
+                   else sum(1 for _, d in boxes if not d))
+            if cnt:
+                pos_count[cls] = pos_count.get(cls, 0) + cnt
+
+        dets = {}
+        for i in range(int(det_splits[n]), int(det_splits[n + 1])):
+            row = detect[i]
+            if row.shape[0] < 6:
+                continue  # the all-empty "-1" sentinel tensor
+            dets.setdefault(int(row[0]), []).append(
+                (float(row[1]), row[2:6]))
+        for cls, preds in dets.items():
+            gt_cls = gts.get(cls)
+            if not gt_cls:
+                for score, _ in preds:
+                    true_pos[cls].append((score, 0))
+                    false_pos[cls].append((score, 1))
+                continue
+            visited = [False] * len(gt_cls)
+            preds = sorted(preds, key=lambda p: -p[0])
+            for score, box in preds:
+                box = _clip_box(np.asarray(box, np.float64))
+                overlaps = [_jaccard(box, np.asarray(g, np.float64))
+                            for g, _ in gt_cls]
+                max_idx = int(np.argmax(overlaps)) if overlaps else 0
+                max_overlap = overlaps[max_idx] if overlaps else -1.0
+                if max_overlap > overlap_threshold:
+                    difficult = gt_cls[max_idx][1]
+                    if evaluate_difficult or not difficult:
+                        if not visited[max_idx]:
+                            true_pos[cls].append((score, 1))
+                            false_pos[cls].append((score, 0))
+                            visited[max_idx] = True
+                        else:
+                            true_pos[cls].append((score, 0))
+                            false_pos[cls].append((score, 1))
+                else:
+                    true_pos[cls].append((score, 0))
+                    false_pos[cls].append((score, 1))
+
+    # mAP over classes with positives (background excluded)
+    aps = []
+    for cls, num_pos in pos_count.items():
+        if cls == background or num_pos == 0 or not true_pos.get(cls):
+            continue
+        ap = _average_precision(true_pos[cls], false_pos[cls], num_pos,
+                                ap_type)
+        if ap is not None:
+            aps.append(ap)
+    m_ap = float(np.mean(aps)) if aps else 0.0
+    ctx.set_output("MAP", jnp.asarray([m_ap], jnp.float32))
+
+    # serialize accumulators
+    pc = np.zeros((class_num, 1), np.int32)
+    for cls, cnt in pos_count.items():
+        if 0 <= cls < class_num:
+            pc[cls, 0] = cnt
+    ctx.set_output("AccumPosCount", jnp.asarray(pc))
+    for slot, store in (("AccumTruePos", true_pos),
+                        ("AccumFalsePos", false_pos)):
+        rows, starts = [], [0]
+        for i in range(class_num):
+            rows.extend(store.get(i, []))
+            starts.append(len(rows))
+        arr = (np.asarray(rows, np.float32) if rows
+               else np.zeros((0, 2), np.float32))
+        ctx.set_output(slot, jnp.asarray(arr))
+        ctx.set_output_lod(slot, [starts])

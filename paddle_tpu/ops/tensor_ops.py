@@ -225,7 +225,13 @@ def _infer_reshape(op, block):
 def reshape_lower(ctx):
     x = ctx.input("X")
     shape = _resolve_reshape(ctx.attr("shape"), x.shape)
-    ctx.set_output("Out", x.reshape(shape))
+    out = x.reshape(shape)
+    ctx.set_output("Out", out)
+    # row identity preserved => ragged metadata survives (reference keeps
+    # LoD through reshape when dim 0 is untouched)
+    lod = ctx.input_lod("X")
+    if lod is not None and out.ndim >= 1 and out.shape[0] == x.shape[0]:
+        ctx.set_output_lod("Out", lod)
 
 
 def _infer_transpose(op, block):

@@ -1,0 +1,362 @@
+"""Detection op group tests (mirror reference test_prior_box_op.py,
+test_box_coder_op.py, test_iou_similarity_op.py, test_bipartite_match_op.py,
+test_target_assign_op.py, test_mine_hard_examples_op.py,
+test_multiclass_nms_op.py, test_roi_pool_op.py, test_detection_map_op.py,
+plus an SSD-head convergence test in the book-test style)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from op_test import OpTest
+
+
+def _np_iou(a, b):
+    n, m = a.shape[0], b.shape[0]
+    out = np.zeros((n, m), np.float32)
+    for i in range(n):
+        for j in range(m):
+            ixmin = max(a[i, 0], b[j, 0])
+            iymin = max(a[i, 1], b[j, 1])
+            ixmax = min(a[i, 2], b[j, 2])
+            iymax = min(a[i, 3], b[j, 3])
+            iw = max(ixmax - ixmin, 0.0)
+            ih = max(iymax - iymin, 0.0)
+            inter = iw * ih
+            union = ((a[i, 2] - a[i, 0]) * (a[i, 3] - a[i, 1]) +
+                     (b[j, 2] - b[j, 0]) * (b[j, 3] - b[j, 1]) - inter)
+            out[i, j] = inter / union if union > 0 else 0.0
+    return out
+
+
+def _rand_boxes(rng, n):
+    x1 = rng.rand(n) * 0.5
+    y1 = rng.rand(n) * 0.5
+    x2 = x1 + rng.rand(n) * 0.5
+    y2 = y1 + rng.rand(n) * 0.5
+    return np.stack([x1, y1, x2, y2], axis=1).astype("float32")
+
+
+def _run_program(feed, fetch_list):
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe.run(fluid.default_main_program(), feed=feed,
+                   fetch_list=fetch_list)
+
+
+class TestIouSimilarity:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        a = _rand_boxes(rng, 5)
+        b = _rand_boxes(rng, 7)
+        x = layers.data(name="x", shape=[5, 4], append_batch_size=False)
+        y = layers.data(name="y", shape=[7, 4], append_batch_size=False)
+        out = layers.iou_similarity(x=x, y=y)
+        (got,) = _run_program({"x": a, "y": b}, [out])
+        np.testing.assert_allclose(got, _np_iou(a, b), rtol=1e-5, atol=1e-6)
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(1)
+        prior = _rand_boxes(rng, 6)
+        pvar = (rng.rand(6, 4).astype("float32") * 0.3 + 0.1)
+        target = _rand_boxes(rng, 3)
+        pb = layers.data(name="pb", shape=[6, 4], append_batch_size=False)
+        pv = layers.data(name="pv", shape=[6, 4], append_batch_size=False)
+        tb = layers.data(name="tb", shape=[3, 4], append_batch_size=False)
+        enc = layers.box_coder(prior_box=pb, prior_box_var=pv, target_box=tb,
+                               code_type="encode_center_size")
+        dec = layers.box_coder(prior_box=pb, prior_box_var=pv,
+                               target_box=enc,
+                               code_type="decode_center_size")
+        enc_v, dec_v = _run_program({"pb": prior, "pv": pvar, "tb": target},
+                                    [enc, dec])
+        assert enc_v.shape == (3, 6, 4)
+        # decoding the encoded deltas must recover the target box for every
+        # prior column
+        for j in range(6):
+            np.testing.assert_allclose(dec_v[:, j, :], target, rtol=1e-4,
+                                       atol=1e-5)
+
+
+class TestPriorBox:
+    def test_shapes_and_values(self):
+        feat = np.zeros((1, 8, 2, 2), np.float32)
+        img = np.zeros((1, 3, 8, 8), np.float32)
+        fv = layers.data(name="feat", shape=list(feat.shape),
+                         append_batch_size=False)
+        iv = layers.data(name="img", shape=list(img.shape),
+                         append_batch_size=False)
+        box, var = layers.prior_box(
+            fv, iv, min_sizes=[4.0], max_sizes=[8.0], aspect_ratios=[2.0],
+            flip=True, clip=True)
+        b, v = _run_program({"feat": feat, "img": img}, [box, var])
+        # priors = len([1, 2, 1/2]) * 1 min_size + 1 max_size = 4
+        assert b.shape == (2, 2, 4, 4)
+        assert v.shape == (2, 2, 4, 4)
+        np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2],
+                                   rtol=1e-6)
+        # first prior at (0,0): center (2,2) of an 8x8 image, ar=1, size 4
+        cx = cy = 0.5 * (8 / 2)
+        expect = [(cx - 2) / 8, (cy - 2) / 8, (cx + 2) / 8, (cy + 2) / 8]
+        np.testing.assert_allclose(b[0, 0, 0], expect, rtol=1e-5)
+        assert b.min() >= 0.0 and b.max() <= 1.0  # clip
+
+
+def _np_bipartite(dist):
+    """Reference greedy BipartiteMatch (bipartite_match_op.cc)."""
+    row, col = dist.shape
+    match_idx = np.full(col, -1, np.int32)
+    match_dist = np.zeros(col, np.float32)
+    row_pool = list(range(row))
+    while row_pool:
+        max_idx = max_row = -1
+        max_d = -1.0
+        for j in range(col):
+            if match_idx[j] != -1:
+                continue
+            for m in row_pool:
+                if dist[m, j] < 1e-6:
+                    continue
+                if dist[m, j] > max_d:
+                    max_idx, max_row, max_d = j, m, dist[m, j]
+        if max_idx == -1:
+            break
+        match_idx[max_idx] = max_row
+        match_dist[max_idx] = max_d
+        row_pool.remove(max_row)
+    return match_idx, match_dist
+
+
+class TestBipartiteMatch:
+    def test_vs_reference_greedy(self):
+        rng = np.random.RandomState(3)
+        lod = [[0, 5, 11]]
+        dist = rng.rand(11, 7).astype("float32")
+        d = layers.data(name="d", shape=[11, 7], append_batch_size=False,
+                        lod_level=1)
+        mi, md = layers.bipartite_match(d)
+        mi_v, md_v = _run_program({"d": (dist, lod)}, [mi, md])
+        for i, (lo, hi) in enumerate([(0, 5), (5, 11)]):
+            want_idx, want_dist = _np_bipartite(dist[lo:hi])
+            np.testing.assert_array_equal(mi_v[i], want_idx)
+            np.testing.assert_allclose(md_v[i], want_dist, rtol=1e-5)
+
+    def test_per_prediction(self):
+        rng = np.random.RandomState(4)
+        dist = rng.rand(4, 10).astype("float32")
+        d = layers.data(name="d", shape=[4, 10], append_batch_size=False)
+        mi, md = layers.bipartite_match(d, match_type="per_prediction",
+                                        dist_threshold=0.5)
+        mi_v, md_v = _run_program({"d": dist}, [mi, md])
+        base_idx, _ = _np_bipartite(dist)
+        for j in range(10):
+            if base_idx[j] != -1:
+                assert mi_v[0, j] == base_idx[j]
+            else:
+                best = dist[:, j].max()
+                if best >= 0.5:
+                    assert mi_v[0, j] == dist[:, j].argmax()
+                    np.testing.assert_allclose(md_v[0, j], best, rtol=1e-5)
+                else:
+                    assert mi_v[0, j] == -1
+
+
+class TestTargetAssign:
+    def test_assign_with_lod(self):
+        # 2 instances: 2 and 1 gt rows; P (cols) = 3
+        x = np.arange(3 * 1 * 2, dtype="float32").reshape(3, 1, 2)
+        lod = [[0, 2, 3]]
+        match = np.array([[0, -1, 1], [-1, 0, -1]], np.int32)
+        xv = layers.data(name="x", shape=[3, 1, 2], append_batch_size=False,
+                         lod_level=1)
+        mv = layers.data(name="m", shape=[2, 3], append_batch_size=False,
+                         dtype="int32")
+        out, w = layers.target_assign(xv, mv, mismatch_value=9)
+        out_v, w_v = _run_program({"x": (x, lod), "m": match}, [out, w])
+        # instance 0: col0 -> row 0, col2 -> row 1 (offset 0)
+        np.testing.assert_allclose(out_v[0, 0], x[0, 0])
+        np.testing.assert_allclose(out_v[0, 1], [9, 9])
+        np.testing.assert_allclose(out_v[0, 2], x[1, 0])
+        # instance 1: col1 -> row 0 + offset 2
+        np.testing.assert_allclose(out_v[1, 1], x[2, 0])
+        np.testing.assert_allclose(
+            w_v.reshape(2, 3), [[1, 0, 1], [0, 1, 0]])
+
+
+class TestMineHardExamples:
+    def test_max_negative(self):
+        cls_loss = np.array([[0.1, 0.9, 0.5, 0.3, 0.7]], np.float32)
+        match = np.array([[0, -1, -1, -1, -1]], np.int32)
+        match_dist = np.array([[0.8, 0.1, 0.2, 0.3, 0.1]], np.float32)
+        cl = layers.data(name="cl", shape=[1, 5], append_batch_size=False)
+        mi = layers.data(name="mi", shape=[1, 5], append_batch_size=False,
+                         dtype="int32")
+        md = layers.data(name="md", shape=[1, 5], append_batch_size=False)
+        helper = fluid.layer_helper.LayerHelper("mine_hard_examples")
+        neg = helper.create_tmp_variable(dtype="int32")
+        upd = helper.create_tmp_variable(dtype="int32")
+        helper.append_op(
+            type="mine_hard_examples",
+            inputs={"ClsLoss": cl, "MatchIndices": mi, "MatchDist": md},
+            outputs={"NegIndices": neg, "UpdatedMatchIndices": upd},
+            attrs={"neg_pos_ratio": 2.0, "neg_dist_threshold": 0.5,
+                   "mining_type": "max_negative", "sample_size": 0})
+        neg_v, upd_v = _run_program(
+            {"cl": cls_loss, "mi": match, "md": match_dist}, [neg, upd])
+        # 1 positive, ratio 2 -> 2 negatives; eligible: cols 1..4; highest
+        # losses are col 1 (0.9) and col 4 (0.7)
+        picked = set(neg_v[0][neg_v[0] >= 0].tolist())
+        assert picked == {1, 4}
+        np.testing.assert_array_equal(upd_v, match)  # unchanged
+
+
+class TestMulticlassNMS:
+    def test_suppression(self):
+        # two nearly identical boxes + one distinct, 2 classes (0=background)
+        bboxes = np.array([[[0.1, 0.1, 0.4, 0.4],
+                            [0.11, 0.11, 0.41, 0.41],
+                            [0.6, 0.6, 0.9, 0.9]]], np.float32)
+        scores = np.array([[[0.1, 0.2, 0.3],         # class 0 (bg)
+                            [0.9, 0.85, 0.8]]], np.float32)  # class 1
+        bv = layers.data(name="b", shape=[1, 3, 4], append_batch_size=False)
+        sv = layers.data(name="s", shape=[1, 2, 3], append_batch_size=False)
+        helper = fluid.layer_helper.LayerHelper("multiclass_nms")
+        out = helper.create_tmp_variable(dtype="float32")
+        helper.append_op(type="multiclass_nms",
+                         inputs={"BBoxes": bv, "Scores": sv},
+                         outputs={"Out": out},
+                         attrs={"background_label": 0, "nms_threshold": 0.5,
+                                "nms_top_k": 10, "keep_top_k": 10,
+                                "score_threshold": 0.01, "nms_eta": 1.0})
+        (got,) = _run_program({"b": bboxes, "s": scores}, [out])
+        # box 1 suppressed by box 0; two rows remain, both class 1
+        assert got.shape == (2, 6)
+        assert set(got[:, 0].astype(int).tolist()) == {1}
+        np.testing.assert_allclose(sorted(got[:, 1], reverse=True),
+                                   [0.9, 0.8], rtol=1e-5)
+
+
+class TestRoiPool(OpTest):
+    op_type = "roi_pool"
+
+    def setup(self):
+        rng = np.random.RandomState(7)
+        x = rng.rand(2, 3, 6, 6).astype("float32")
+        # (batch_id, x1, y1, x2, y2) in input scale
+        rois = np.array([[0, 0, 0, 3, 3], [1, 2, 2, 5, 5]], np.int64)
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0}
+        out = np.zeros((2, 3, 2, 2), np.float32)
+        for r, roi in enumerate(rois):
+            b, x1, y1, x2, y2 = [int(v) for v in roi]
+            rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+            for c in range(3):
+                for ph in range(2):
+                    for pw in range(2):
+                        hs = min(max(int(math.floor(ph * rh / 2.)) + y1, 0), 6)
+                        he = min(max(int(math.ceil((ph + 1) * rh / 2.)) + y1,
+                                     0), 6)
+                        ws = min(max(int(math.floor(pw * rw / 2.)) + x1, 0), 6)
+                        we = min(max(int(math.ceil((pw + 1) * rw / 2.)) + x1,
+                                     0), 6)
+                        patch = x[b, c, hs:he, ws:we]
+                        out[r, c, ph, pw] = patch.max() if patch.size else 0.0
+        self.outputs = {"Out": out, "Argmax": None}
+
+    def test_forward(self):
+        self.setup()
+        self.check_output(no_check_set=("Argmax",))
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestDetectionMAP:
+    def _build(self, with_state=False):
+        det = layers.data(name="det", shape=[6, 6],
+                          append_batch_size=False, lod_level=1)
+        lab = layers.data(name="lab", shape=[4, 6],
+                          append_batch_size=False, lod_level=1)
+        return det, lab
+
+    def test_perfect_detection(self):
+        det, lab = self._build()
+        m = layers.detection_map(det, lab, class_num=3,
+                                 overlap_threshold=0.5)
+        # image 0: one gt class 1; detection matches exactly
+        dets = np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4]], np.float32)
+        labels = np.array([[1, 0, 0.1, 0.1, 0.4, 0.4]], np.float32)
+        (got,) = _run_program({"det": (dets, [[0, 1]]),
+                               "lab": (labels, [[0, 1]])}, [m])
+        np.testing.assert_allclose(got, [1.0], atol=1e-6)
+
+    def test_false_positive_halves_ap(self):
+        det, lab = self._build()
+        m = layers.detection_map(det, lab, class_num=3,
+                                 overlap_threshold=0.5)
+        dets = np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                         [1, 0.8, 0.6, 0.6, 0.9, 0.9]], np.float32)
+        labels = np.array([[1, 0, 0.1, 0.1, 0.4, 0.4]], np.float32)
+        (got,) = _run_program({"det": (dets, [[0, 2]]),
+                               "lab": (labels, [[0, 1]])}, [m])
+        # tp at rank 1 (p=1, r=1), fp at rank 2 -> integral AP = 1.0
+        np.testing.assert_allclose(got, [1.0], atol=1e-6)
+        # flip scores: fp first -> AP = 0.5
+        fluid.switch_main_program(fluid.Program())
+        det2 = layers.data(name="det", shape=[6, 6],
+                           append_batch_size=False, lod_level=1)
+        lab2 = layers.data(name="lab", shape=[4, 6],
+                           append_batch_size=False, lod_level=1)
+        m2 = layers.detection_map(det2, lab2, class_num=3,
+                                  overlap_threshold=0.5)
+        dets2 = np.array([[1, 0.9, 0.6, 0.6, 0.9, 0.9],
+                          [1, 0.8, 0.1, 0.1, 0.4, 0.4]], np.float32)
+        (got2,) = _run_program({"det": (dets2, [[0, 2]]),
+                                "lab": (labels, [[0, 1]])}, [m2])
+        np.testing.assert_allclose(got2, [0.5], atol=1e-6)
+
+
+class TestSSDHeadTraining:
+    def test_loss_decreases(self):
+        rng = np.random.RandomState(11)
+        images = rng.rand(2, 3, 8, 8).astype("float32")
+        gt_box = np.array([[0.1, 0.1, 0.45, 0.45],
+                           [0.5, 0.5, 0.95, 0.95],
+                           [0.2, 0.3, 0.6, 0.7]], np.float32)
+        gt_label = np.array([[1], [2], [1]], np.int32)
+        lod = [[0, 2, 3]]
+
+        img = layers.data(name="img", shape=[2, 3, 8, 8],
+                          append_batch_size=False)
+        gb = layers.data(name="gb", shape=[3, 4], append_batch_size=False,
+                         lod_level=1)
+        gl = layers.data(name="gl", shape=[3, 1], append_batch_size=False,
+                         dtype="int32", lod_level=1)
+        feat = layers.conv2d(input=img, num_filters=8, filter_size=3,
+                             padding=1, act="relu")
+        locs, confs, box, var = layers.multi_box_head(
+            inputs=[feat], image=img, base_size=8, num_classes=3,
+            aspect_ratios=[[2.0]], min_sizes=[3.0], max_sizes=[6.0],
+            flip=True, clip=True)
+        loss = layers.ssd_loss(locs, confs, gb, gl, box, var)
+        avg = layers.reduce_mean(loss)
+        opt = fluid.optimizer.SGD(learning_rate=0.05)
+        opt.minimize(avg)
+
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        feed = {"img": images, "gb": (gt_box, lod), "gl": (gt_label, lod)}
+        losses = []
+        for _ in range(12):
+            (lv,) = exe.run(fluid.default_main_program(), feed=feed,
+                            fetch_list=[avg])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.8, losses
