@@ -73,7 +73,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     helper.append_op(
         type="lookup_table", inputs={"W": [w], "Ids": [input]},
         outputs={"Out": [tmp]},
-        attrs={"is_sparse": is_sparse, "padding_idx": padding_idx})
+        attrs={"is_sparse": is_sparse, "is_distributed": is_distributed,
+               "padding_idx": padding_idx})
     return tmp
 
 
@@ -503,8 +504,35 @@ def cos_sim(X, Y):
 
 def nce(input, label, num_total_classes, sample_weight=None,
         param_attr=None, bias_attr=None, num_neg_samples=None):
-    raise NotImplementedError(
-        "nce is part of the sparse/CTR subsystem (build-plan step 8)")
+    """Noise-contrastive estimation loss (reference ``nn.py`` nce over
+    ``operators/nce_op.h``); returns per-example cost / (num_neg + 1)."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr)
+    dim = input.shape[1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                is_bias=False, dtype=input.dtype)
+    bias_attr_ = helper.bias_attr
+    b = None if bias_attr_ is None else helper.create_parameter(
+        attr=bias_attr_, shape=[num_total_classes, 1], is_bias=True,
+        dtype=input.dtype)
+    cost = helper.create_tmp_variable(dtype=input.dtype)
+    sample_logits = helper.create_tmp_variable(dtype=input.dtype,
+                                               stop_gradient=True)
+    sample_labels = helper.create_tmp_variable(dtype="int64",
+                                               stop_gradient=True)
+    num_neg_samples = 10 if num_neg_samples is None else int(num_neg_samples)
+    inputs = {"Input": input, "Label": label, "Weight": w}
+    if b is not None:
+        inputs["Bias"] = b
+    if sample_weight is not None:
+        inputs["SampleWeight"] = sample_weight
+    helper.append_op(
+        type="nce", inputs=inputs,
+        outputs={"Cost": cost, "SampleLogits": sample_logits,
+                 "SampleLabels": sample_labels},
+        attrs={"num_total_classes": int(num_total_classes),
+               "num_neg_samples": num_neg_samples})
+    return cost / (num_neg_samples + 1)
 
 
 def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):

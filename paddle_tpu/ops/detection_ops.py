@@ -278,7 +278,8 @@ def bipartite_match_lower(ctx):
     dist = ctx.input("DistMat")
     lod = ctx.input_lod("DistMat")
     match_type = ctx.attr("match_type") or "bipartite"
-    threshold = ctx.attr("dist_threshold") or 0.5
+    threshold = ctx.attr("dist_threshold")
+    threshold = 0.5 if threshold is None else float(threshold)
     if lod is None:
         segments = [(0, dist.shape[0])]
     else:
@@ -439,15 +440,19 @@ def mine_hard_examples_lower(ctx):
             num_elig)
     pos_in_order = jnp.arange(p)[None, :]
     selected_order = pos_in_order < neg_sel[:, None]
-    neg_indices = jnp.where(selected_order, order, -1).astype(jnp.int32)
 
     if mining_type == "hard_example":
-        # positives not selected are demoted to -1
-        sel_mask = jnp.zeros((n, p), bool)
+        # reference tail loop: selected+matched priors STAY positive (and
+        # are excluded from NegIndices); unselected positives demote to -1;
+        # NegIndices = selected ∩ unmatched
         rows = jnp.arange(n)[:, None]
-        sel_mask = sel_mask.at[rows, order].max(selected_order)
+        match_at_order = match[rows, order]
+        neg_sel_mask = selected_order & (match_at_order == -1)
+        neg_indices = jnp.where(neg_sel_mask, order, -1).astype(jnp.int32)
+        sel_mask = jnp.zeros((n, p), bool).at[rows, order].max(selected_order)
         updated = jnp.where((match > -1) & ~sel_mask, -1, match)
     else:
+        neg_indices = jnp.where(selected_order, order, -1).astype(jnp.int32)
         updated = match
     ctx.set_output("NegIndices", neg_indices)
     ctx.set_output("UpdatedMatchIndices", updated)
@@ -457,6 +462,15 @@ def mine_hard_examples_lower(ctx):
 # multiclass_nms (host op — data-dependent output rows, like the
 # reference's CPU-only kernel)
 # ---------------------------------------------------------------------------
+
+def _jaccard(a, b):
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = iw * ih
+    union = ((a[2] - a[0]) * (a[3] - a[1])
+             + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / union if union > 0 else 0.0
+
 
 def _nms_one_class(boxes, scores, score_threshold, nms_top_k, nms_threshold,
                    nms_eta):
@@ -468,18 +482,8 @@ def _nms_one_class(boxes, scores, score_threshold, nms_top_k, nms_threshold,
     kept = []
     adaptive_threshold = nms_threshold
     for i in idx:
-        keep = True
-        for j in kept:
-            a, b = boxes[i], boxes[j]
-            inter_w = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
-            inter_h = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
-            inter = inter_w * inter_h
-            union = ((a[2] - a[0]) * (a[3] - a[1])
-                     + (b[2] - b[0]) * (b[3] - b[1]) - inter)
-            iou = inter / union if union > 0 else 0.0
-            if iou > adaptive_threshold:
-                keep = False
-                break
+        keep = all(_jaccard(boxes[i], boxes[j]) <= adaptive_threshold
+                   for j in kept)
         if keep:
             kept.append(int(i))
             if nms_eta < 1.0 and adaptive_threshold > 0.5:
@@ -630,15 +634,6 @@ def roi_pool_grad_lower(ctx):
 
 def _clip_box(box):
     return np.clip(box, 0.0, 1.0)
-
-
-def _jaccard(a, b):
-    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
-    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
-    inter = iw * ih
-    union = ((a[2] - a[0]) * (a[3] - a[1])
-             + (b[2] - b[0]) * (b[3] - b[1]) - inter)
-    return inter / union if union > 0 else 0.0
 
 
 def _average_precision(tps, fps, num_pos, ap_type):

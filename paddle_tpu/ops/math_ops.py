@@ -152,9 +152,21 @@ def scale_lower(ctx):
         ctx.set_output("Out", (x + bias) * scale)
 
 
-@register_op("sum", infer_shape=infer_shape_unary())
+@register_op("sum", infer_shape=infer_shape_unary(),
+             selected_rows_inputs=("X",))
 def sum_lower(ctx):
+    """Reference sum_op.cc: sums LoDTensors and/or SelectedRows.  All-sparse
+    inputs concatenate into one SelectedRows (duplicate rows are fine —
+    consumers scatter-add or merge); mixed inputs densify."""
+    from paddle_tpu.selected_rows import SelectedRows, is_selected_rows
     xs = ctx.inputs("X")
+    if any(is_selected_rows(x) for x in xs):
+        if all(is_selected_rows(x) for x in xs):
+            rows = jnp.concatenate([x.rows for x in xs])
+            vals = jnp.concatenate([x.value for x in xs])
+            ctx.set_output("Out", SelectedRows(rows, vals, xs[0].height))
+            return
+        xs = [x.to_dense() if is_selected_rows(x) else x for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x

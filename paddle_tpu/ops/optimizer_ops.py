@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.ops.registry import register_op, infer_shape_unary
+from paddle_tpu.selected_rows import is_selected_rows
 
 
 def _infer_param_out(op, block):
@@ -45,11 +46,18 @@ def _infer_param_out(op, block):
 
 
 @register_op("sgd", infer_shape=_infer_param_out, no_gradient=True,
-             stateful_outputs=("ParamOut",))
+             stateful_outputs=("ParamOut",),
+             selected_rows_inputs=("Grad",))
 def sgd_lower(ctx):
     p = ctx.input("Param")
     g = ctx.input("Grad")
     lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    if is_selected_rows(g):
+        # sparse branch (reference sgd_op.h SelectedRows kernel): touch
+        # only the gradient's rows; duplicates accumulate via scatter-add
+        ctx.set_output("ParamOut",
+                       p.at[g.rows].add((-lr * g.value).astype(p.dtype)))
+        return
     ctx.set_output("ParamOut", p - lr * g)
 
 
@@ -71,7 +79,8 @@ def momentum_lower(ctx):
 
 @register_op("adam", infer_shape=_infer_param_out, no_gradient=True,
              stateful_outputs=("ParamOut", "Moment1Out", "Moment2Out",
-                               "Beta1PowOut", "Beta2PowOut"))
+                               "Beta1PowOut", "Beta2PowOut"),
+             selected_rows_inputs=("Grad",))
 def adam_lower(ctx):
     p, g = ctx.input("Param"), ctx.input("Grad")
     m1, m2 = ctx.input("Moment1"), ctx.input("Moment2")
@@ -81,15 +90,28 @@ def adam_lower(ctx):
     beta1 = ctx.attr("beta1", 0.9)
     beta2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
+    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    ctx.set_output("Beta1PowOut", (b1p * beta1).reshape(1))
+    ctx.set_output("Beta2PowOut", (b2p * beta2).reshape(1))
+    if is_selected_rows(g):
+        # reference adam_op.h SparseAdamFunctor: lazy row-wise update of
+        # the moments/param at the (merged) gradient rows only
+        sr = g.merge_duplicates()
+        gv = sr.value
+        m1_rows = beta1 * m1[sr.rows] + (1.0 - beta1) * gv
+        m2_rows = beta2 * m2[sr.rows] + (1.0 - beta2) * jnp.square(gv)
+        p_rows = p[sr.rows] - (lr_t * m1_rows /
+                               (jnp.sqrt(m2_rows) + eps)).astype(p.dtype)
+        ctx.set_output("ParamOut", p.at[sr.rows].set(p_rows))
+        ctx.set_output("Moment1Out", m1.at[sr.rows].set(m1_rows))
+        ctx.set_output("Moment2Out", m2.at[sr.rows].set(m2_rows))
+        return
     m1n = beta1 * m1 + (1.0 - beta1) * g
     m2n = beta2 * m2 + (1.0 - beta2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
     p_new = p - (lr_t * m1n / (jnp.sqrt(m2n) + eps)).astype(p.dtype)
     ctx.set_output("ParamOut", p_new)
     ctx.set_output("Moment1Out", m1n)
     ctx.set_output("Moment2Out", m2n)
-    ctx.set_output("Beta1PowOut", (b1p * beta1).reshape(1))
-    ctx.set_output("Beta2PowOut", (b2p * beta2).reshape(1))
 
 
 @register_op("adamax", infer_shape=_infer_param_out, no_gradient=True,
@@ -114,12 +136,24 @@ def adamax_lower(ctx):
 
 
 @register_op("adagrad", infer_shape=_infer_param_out, no_gradient=True,
-             stateful_outputs=("ParamOut", "MomentOut"))
+             stateful_outputs=("ParamOut", "MomentOut"),
+             selected_rows_inputs=("Grad",))
 def adagrad_lower(ctx):
     p, g = ctx.input("Param"), ctx.input("Grad")
     m = ctx.input("Moment")
     lr = ctx.input("LearningRate").reshape(())
     eps = ctx.attr("epsilon", 1e-6)
+    if is_selected_rows(g):
+        # reference adagrad_op.h sparse kernel: merge duplicate rows, then
+        # update moment/param only at those rows
+        sr = g.merge_duplicates()
+        gv = sr.value
+        m_rows = m[sr.rows] + jnp.square(gv)
+        m_new = m.at[sr.rows].set(m_rows)
+        p_rows = p[sr.rows] - lr * gv / (jnp.sqrt(m_rows) + eps)
+        ctx.set_output("ParamOut", p.at[sr.rows].set(p_rows.astype(p.dtype)))
+        ctx.set_output("MomentOut", m_new)
+        return
     m_new = m + jnp.square(g)
     ctx.set_output("ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
     ctx.set_output("MomentOut", m_new)

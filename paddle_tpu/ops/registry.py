@@ -44,10 +44,15 @@ class OpDef:
     def __init__(self, type, lower=None, infer_shape=None, grad_maker=None,
                  grad_lower=None, no_grad_inputs=(), stop_gradient_outputs=(),
                  uses_rng=False, stateful_outputs=(), host=False,
-                 amp_cast=(), amp_upcast=()):
+                 amp_cast=(), amp_upcast=(), selected_rows_inputs=()):
         self.type = type
         self.lower = lower
         self.infer_shape = infer_shape
+        # input slots whose lowering understands a SelectedRows value (the
+        # sparse-grad path, selected_rows.py); every other slot densifies
+        # a SelectedRows automatically, like the reference's data-transform
+        # layer converts kernel-incompatible inputs (data_transform.cc)
+        self.selected_rows_inputs = frozenset(selected_rows_inputs)
         # mixed precision (the reference's float16 story, platform/float16.h,
         # re-designed for TPU bf16): when the program runs with amp enabled,
         # float32 arrays read through the listed input slots are cast to
@@ -80,7 +85,7 @@ class OpDef:
 def register_op(type, *, infer_shape=None, grad_maker=None, grad_lower=None,
                 no_grad_inputs=(), stop_gradient_outputs=(), uses_rng=False,
                 no_gradient=False, stateful_outputs=(), host=False,
-                amp_cast=(), amp_upcast=()):
+                amp_cast=(), amp_upcast=(), selected_rows_inputs=()):
     """Decorator: register ``fn(ctx)`` as the lowering for op ``type``."""
 
     def deco(fn):
@@ -89,7 +94,8 @@ def register_op(type, *, infer_shape=None, grad_maker=None, grad_lower=None,
                       no_grad_inputs=no_grad_inputs,
                       stop_gradient_outputs=stop_gradient_outputs,
                       uses_rng=uses_rng, stateful_outputs=stateful_outputs,
-                      host=host, amp_cast=amp_cast, amp_upcast=amp_upcast)
+                      host=host, amp_cast=amp_cast, amp_upcast=amp_upcast,
+                      selected_rows_inputs=selected_rows_inputs)
         opdef.has_grad = not no_gradient
         _REGISTRY[type] = opdef
         return fn
@@ -198,7 +204,15 @@ class LowerContext:
 
     def _amp_cast(self, slot, value):
         """bf16-downcast / f32-upcast per the op's AMP slot lists (active
-        only when the executor enabled mixed precision for this program)."""
+        only when the executor enabled mixed precision for this program);
+        also densifies SelectedRows values for slots whose lowering does
+        not declare sparse support."""
+        from paddle_tpu.selected_rows import SelectedRows
+        if isinstance(value, SelectedRows):
+            opdef_sr = lookup(self.op.type)
+            if opdef_sr is None or \
+                    slot not in opdef_sr.selected_rows_inputs:
+                value = value.to_dense()
         if value is None or not self.aux.get("amp"):
             return value
         opdef = lookup(self.op.type)

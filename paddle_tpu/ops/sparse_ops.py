@@ -1,0 +1,183 @@
+"""Sparse / CTR op group.
+
+Reference: ``paddle/fluid/operators/nce_op.h`` (noise-contrastive
+estimation), ``split_ids_op.cc`` / ``split_selected_rows_op.cc`` (the
+pserver sharding helpers).  On TPU the *distribution* of sparse tables is
+GSPMD's job (shard the embedding param over the mesh 'model' axis and XLA
+inserts the collectives — see ``parallel/distribute_transpiler.py``); these
+ops provide the remaining compute/parity surface.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.registry import register_op, ShapeInferenceSkip
+from paddle_tpu.selected_rows import SelectedRows, is_selected_rows
+
+
+# ---------------------------------------------------------------------------
+# nce
+# ---------------------------------------------------------------------------
+
+def _infer_nce(op, block):
+    x = block.var(op.input("Input")[0])
+    label = block.var(op.input("Label")[0])
+    if x.shape is None or label.shape is None:
+        raise ShapeInferenceSkip()
+    n = x.shape[0]
+    num_true = label.shape[1] if len(label.shape) == 2 else 1
+    num_sampled = num_true + int(op.attr("num_neg_samples", 10))
+    cost = block.var(op.output("Cost")[0])
+    cost.shape = (n, 1)
+    cost.dtype = x.dtype
+    for slot, dt in (("SampleLogits", x.dtype), ("SampleLabels", "int64")):
+        names = op.output(slot)
+        if names:
+            v = block.var(names[0])
+            v.shape = (n, num_sampled)
+            v.dtype = dt
+
+
+def _nce_forward(x, w, bias, sample_labels, num_true, num_total_classes,
+                 num_neg, sample_weight=None):
+    """Shared by fwd lowering and the grad's vjp: returns (cost, logits).
+
+    Reference nce_op.h NCEKernel: o = sigmoid(x·w[y] + b[y]);
+    b_q = num_neg / num_classes (uniform sampler density);
+    cost_i = sum_true -log(o/(o+b_q)) + sum_neg -log(b_q/(o+b_q)).
+    """
+    b_q = float(num_neg) / float(num_total_classes)
+    w_rows = w[sample_labels]                     # [N, S, D]
+    logits = jnp.einsum("nd,nsd->ns", x, w_rows)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[sample_labels]
+    o = jax.nn.sigmoid(logits)
+    s = sample_labels.shape[1]
+    is_true = jnp.arange(s)[None, :] < num_true
+    eps = 1e-12
+    cost_elem = jnp.where(is_true,
+                          -jnp.log(o / (o + b_q) + eps),
+                          -jnp.log(b_q / (o + b_q) + eps))
+    cost = jnp.sum(cost_elem, axis=1, keepdims=True)
+    if sample_weight is not None:
+        cost = cost * sample_weight.reshape(-1, 1)
+    return cost, o
+
+
+@register_op("nce", infer_shape=_infer_nce, uses_rng=True,
+             no_grad_inputs=("Label", "SampleWeight"),
+             stop_gradient_outputs=("SampleLogits", "SampleLabels"))
+def nce_lower(ctx):
+    x = ctx.input("Input")                    # [N, D]
+    label = ctx.input("Label")                # [N, T] int64
+    w = ctx.input("Weight")                   # [V, D]
+    bias = ctx.input("Bias")                  # [V, 1] or None
+    sample_weight = ctx.input("SampleWeight")
+    num_total = int(ctx.attr("num_total_classes"))
+    num_neg = int(ctx.attr("num_neg_samples", 10))
+    custom_neg = ctx.attr("custom_neg_classes", []) or []
+    if label.ndim == 1:
+        label = label[:, None]
+    n, num_true = label.shape
+    if custom_neg:
+        # the reference fills exactly num_neg_samples slots from
+        # custom_neg_classes (uninitialized memory otherwise) — require
+        # the lengths to agree
+        if len(custom_neg) != num_neg:
+            raise ValueError(
+                f"nce: len(custom_neg_classes)={len(custom_neg)} must "
+                f"equal num_neg_samples={num_neg}")
+        neg = jnp.broadcast_to(
+            jnp.asarray(custom_neg, label.dtype)[None, :],
+            (n, len(custom_neg)))
+    else:
+        neg = jax.random.randint(ctx.rng_key(), (n, num_neg), 0,
+                                 num_total).astype(label.dtype)
+    sample_labels = jnp.concatenate([label, neg], axis=1)  # [N, T+S]
+    cost, o = _nce_forward(x, w, bias, sample_labels, num_true, num_total,
+                           num_neg, sample_weight)
+    ctx.set_output("Cost", cost)
+    ctx.set_output("SampleLogits", o)
+    ctx.set_output("SampleLabels", sample_labels)
+
+
+def _nce_grad_lower(ctx):
+    """Analytic grads by vjp of the forward with SampleLabels FIXED (they
+    were sampled in the forward; re-sampling in backward would decouple
+    the two, reference NCEGradKernel reads SampleLogits for the same
+    reason)."""
+    x = ctx.input("Input")
+    w = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    sample_weight = ctx.input("SampleWeight")
+    sample_labels = ctx.input("SampleLabels")
+    dcost = ctx.input("Cost@GRAD")
+    label = ctx.input("Label")
+    num_true = label.shape[1] if label.ndim == 2 else 1
+    num_total = int(ctx.attr("num_total_classes"))
+    num_neg = int(ctx.attr("num_neg_samples", 10))
+
+    has_bias = bias is not None
+
+    def f(x_, w_, b_):
+        cost, _ = _nce_forward(x_, w_, b_, sample_labels, num_true,
+                               num_total, num_neg, sample_weight)
+        return cost
+
+    if has_bias:
+        _, vjp = jax.vjp(f, x, w, bias)
+        dx, dw, db = vjp(dcost)
+    else:
+        _, vjp = jax.vjp(lambda x_, w_: f(x_, w_, None), x, w)
+        dx, dw = vjp(dcost)
+        db = None
+    for slot, g in (("Input@GRAD", dx), ("Weight@GRAD", dw),
+                    ("Bias@GRAD", db)):
+        names = ctx.op.output(slot)
+        if names and names[0] and g is not None:
+            ctx.outputs[names[0]] = g
+
+
+from paddle_tpu.ops.registry import lookup as _lookup  # noqa: E402
+_lookup("nce").grad_lower = _nce_grad_lower
+
+
+# ---------------------------------------------------------------------------
+# split_ids / split_selected_rows (host ops — data-dependent split sizes;
+# the reference registers both as CPU kernels for pserver sharding)
+# ---------------------------------------------------------------------------
+
+@register_op("split_ids", no_gradient=True, host=True)
+def split_ids_lower(ctx):
+    """Partition ids by ``id % num_shards`` (reference split_ids_op.cc)."""
+    ids = np.asarray(ctx.input("Ids")).reshape(-1)
+    out_names = ctx.op.output("Out")
+    n_shard = len(out_names)
+    for i, name in enumerate(out_names):
+        part = ids[ids % n_shard == i]
+        ctx.outputs[name] = jnp.asarray(part.reshape(-1, 1))
+
+
+@register_op("split_selected_rows", no_gradient=True, host=True,
+             selected_rows_inputs=("X",))
+def split_selected_rows_lower(ctx):
+    """Split rows into height sections (reference
+    split_selected_rows_op.cc); each output is a SelectedRows whose row
+    indices are local to its section."""
+    x = ctx.input("X")
+    sections = ctx.attr("height_sections")
+    out_names = ctx.op.output("Out")
+    if not is_selected_rows(x):
+        x = SelectedRows(jnp.arange(x.shape[0], dtype=jnp.int32), x,
+                         x.shape[0])
+    rows = np.asarray(x.rows)
+    vals = np.asarray(x.value)
+    offset = 0
+    for name, h in zip(out_names, sections):
+        m = (rows >= offset) & (rows < offset + h)
+        ctx.outputs[name] = SelectedRows(
+            jnp.asarray(rows[m] - offset), jnp.asarray(vals[m]), int(h))
+        offset += h

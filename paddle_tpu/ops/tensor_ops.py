@@ -12,7 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.ops.registry import (
-    register_op, register_grad_lower, infer_shape_unary, ShapeInferenceSkip)
+    register_op, register_grad_lower, infer_shape_unary, ShapeInferenceSkip,
+    lookup)
 
 
 def _np_dtype(name):
@@ -566,3 +567,30 @@ def lookup_table_lower(ctx):
         mask = (ids != padding_idx)[..., None].astype(out.dtype)
         out = out * mask
     ctx.set_output("Out", out)
+
+
+def _lookup_table_grad_lower(ctx):
+    """``is_sparse=True`` emits a SelectedRows gradient (reference
+    lookup_table_op.cc SelectedRows branch) — O(batch·dim), no dense
+    [vocab, dim] scatter; dense mode falls back to auto-vjp."""
+    from paddle_tpu.ops.registry import auto_vjp_grad_lower
+    if not ctx.attr("is_sparse", False):
+        return auto_vjp_grad_lower("lookup_table")(ctx)
+    from paddle_tpu.selected_rows import SelectedRows
+    w = ctx.input("W")
+    ids = ctx.input("Ids")
+    dout = ctx.input("Out@GRAD")
+    gname = ctx.op.output("W@GRAD")
+    if not gname or not gname[0]:
+        return
+    if ids.shape and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    rows = ids.reshape(-1).astype(jnp.int32)
+    vals = dout.reshape(-1, w.shape[-1])
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        vals = vals * (rows != padding_idx)[:, None].astype(vals.dtype)
+    ctx.outputs[gname[0]] = SelectedRows(rows, vals, w.shape[0])
+
+
+lookup("lookup_table").grad_lower = _lookup_table_grad_lower

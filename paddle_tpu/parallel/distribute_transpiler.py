@@ -62,21 +62,49 @@ class DistributeTranspiler:
         ignores endpoints (no gRPC) and instead decides, per parameter,
         whether to shard it over ``mesh_axis`` (the pserver-sharding analog)
         or replicate it.
+
+        Sparse path: the reference distributes ``is_distributed`` embedding
+        tables across pservers and rewrites lookups into ``prefetch_op``
+        RPCs (``distribute_transpiler.py:138`` sparse branch,
+        ``operators/prefetch_op.cc``).  Here such tables are sharded over
+        the mesh's model axis on dim 0 (the vocab dim); GSPMD turns the
+        in-graph gather into the all-to-all/all-gather exchange that
+        prefetch performed by hand, so no program rewrite is needed.
         """
         self._program = program or default_main_program()
         self._startup = startup_program or default_startup_program()
         num_shards = max(len(pservers.split(",")) if pservers else 1, 1)
         self.spec.num_shards = num_shards
-        params = self._program.global_block().all_parameters()
+        block = self._program.global_block()
+
+        # distributed embedding tables (the pserver sparse-table analog)
+        dist_tables = set()
+        for op in block.ops:
+            if op.type == "lookup_table" and op.attr("is_distributed", False):
+                dist_tables.add(op.input("W")[0])
+
+        params = block.all_parameters()
         for p in params:
-            if shard_params and p.shape and p.shape[0] % num_shards == 0 \
-                    and len(p.shape) >= 1:
+            first_dim_shards = (p.shape and len(p.shape) >= 1 and
+                                p.shape[0] is not None and p.shape[0] > 0)
+            if p.name in dist_tables and first_dim_shards:
+                self.spec.param_specs[p.name] = P(mesh_axis, None)
+            elif shard_params and first_dim_shards \
+                    and p.shape[0] % num_shards == 0:
                 # shard the first (output/vocab) dim — the same dim the
                 # reference splits into pserver blocks
                 self.spec.param_specs[p.name] = P(mesh_axis)
             else:
                 self.spec.param_specs[p.name] = P()
         return self
+
+    def param_shardings(self):
+        """The plan as ``ParallelExecutor(param_shardings=...)`` rules:
+        exact-name regexes, non-replicated params only."""
+        import re as _re
+        return [(f"^{_re.escape(name)}$", spec)
+                for name, spec in self.spec.param_specs.items()
+                if tuple(spec) != ()]
 
     def get_trainer_program(self):
         """On TPU the trainer program IS the program: collectives are

@@ -1,0 +1,88 @@
+"""SelectedRows: sparse row-subset gradient value.
+
+TPU-native equivalent of the reference's SelectedRows type
+(``paddle/fluid/framework/selected_rows.h``, functors
+``operators/math/selected_rows_functor.cc``): a (rows, value) pair standing
+for a ``[height, ...]`` tensor that is zero outside ``rows``.  Produced by
+``lookup_table_grad`` when the embedding was built with ``is_sparse=True``
+and consumed directly by the sparse branches of the optimizer ops — the
+full-vocab dense gradient is never materialized, so the update step is
+O(batch·dim) instead of O(vocab·dim).
+
+Registered as a jax pytree, so it flows through ``jax.jit``/``vjp``
+boundaries inside the compiled block.  ``rows`` may contain duplicates
+(one per occurrence in the batch); linear consumers (sgd, sum) scatter-add
+directly, while non-linear consumers (adagrad/adam moment updates) call
+``merge_duplicates()`` first — the analog of the reference's
+``scatter::MergeAdd``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "is_selected_rows", "to_dense"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    def __init__(self, rows, value, height):
+        self.rows = rows          # [N] int array
+        self.value = value        # [N, ...] array
+        self.height = int(height)  # static logical dim-0 extent
+
+    def tree_flatten(self):
+        return (self.rows, self.value), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, value = children
+        return cls(rows, value, height)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.value.astype(dtype), self.height)
+
+    def to_dense(self):
+        """Densify (duplicate rows accumulate)."""
+        out = jnp.zeros((self.height,) + tuple(self.value.shape[1:]),
+                        self.value.dtype)
+        return out.at[self.rows].add(self.value)
+
+    def merge_duplicates(self):
+        """Combine duplicate row indices by summation, statically shaped
+        (reference ``scatter::MergeAdd``): the result has the same slot
+        count; slot g < #unique holds (unique row id, summed value), and
+        unused tail slots get row index ``height`` — OUT OF BOUNDS, so
+        jax's default scatter drop-semantics make them no-ops for both
+        ``.at[].add`` and ``.at[].set`` consumers (safe for the lazy
+        adagrad/adam row updates)."""
+        order = jnp.argsort(self.rows)
+        sorted_rows = self.rows[order]
+        sorted_vals = self.value[order]
+        is_head = jnp.concatenate([
+            jnp.ones((1,), bool), sorted_rows[1:] != sorted_rows[:-1]])
+        seg = jnp.cumsum(is_head) - 1                  # group id per slot
+        n = self.rows.shape[0]
+        merged_vals = jnp.zeros_like(sorted_vals).at[seg].add(sorted_vals)
+        group_rows = jnp.full_like(sorted_rows, -1).at[seg].max(sorted_rows)
+        valid = jnp.arange(n) <= seg[-1]               # slot < #unique rows
+        rows = jnp.where(valid, group_rows,
+                         jnp.asarray(self.height, group_rows.dtype))
+        return SelectedRows(rows, merged_vals, self.height)
+
+
+def is_selected_rows(v):
+    return isinstance(v, SelectedRows)
+
+
+def to_dense(v):
+    return v.to_dense() if isinstance(v, SelectedRows) else v

@@ -1,0 +1,118 @@
+"""Wide&Deep CTR book test — the sparse/CTR subsystem end to end
+(SURVEY.md build-plan step 8; replaces the reference's pserver sparse
+distribution, ``distribute_transpiler.py:138`` sparse branch).
+
+Two modes:
+* single device, ``is_sparse=True`` — SelectedRows gradient + lazy
+  optimizer rows (reference lookup_table_op.cc sparse path);
+* 8-device mesh, ``is_distributed=True`` — vocab-sharded embedding table
+  via DistributeTranspiler -> ParallelExecutor, the table too big to want
+  replication (reference prefetch_op pserver lookup).
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from paddle_tpu.parallel import ParallelExecutor
+from paddle_tpu.parallel.distribute_transpiler import DistributeTranspiler
+from paddle_tpu.parallel.mesh import make_mesh
+
+VOCAB = 8000
+BATCH = 16
+N_SPARSE = 3   # sparse id features per example
+N_DENSE = 8
+
+
+def _synthetic_ctr(rng, n):
+    """Clicks correlated with (id mod 7) and one dense feature."""
+    ids = rng.randint(0, VOCAB, size=(n, N_SPARSE)).astype("int64")
+    dense = rng.rand(n, N_DENSE).astype("float32")
+    logit = ((ids[:, 0] % 7) - 3) * 0.8 + (dense[:, 0] - 0.5) * 2.0
+    click = (1.0 / (1.0 + np.exp(-logit)) > rng.rand(n)).astype("int64")
+    return ids, dense, click.reshape(-1, 1)
+
+
+def _wide_deep(distributed):
+    ids = layers.data(name="ids", shape=[BATCH, N_SPARSE],
+                      append_batch_size=False, dtype="int64")
+    dense = layers.data(name="dense", shape=[BATCH, N_DENSE],
+                        append_batch_size=False)
+    label = layers.data(name="label", shape=[BATCH, 1],
+                        append_batch_size=False, dtype="int64")
+
+    # deep part: shared embedding table over all id slots -> MLP
+    emb = layers.embedding(ids, size=[VOCAB, 16],
+                           is_sparse=not distributed,
+                           is_distributed=distributed,
+                           param_attr="emb_0")
+    deep = layers.reshape(x=emb, shape=[BATCH, N_SPARSE * 16])
+    deep = layers.fc(input=deep, size=32, act="relu")
+    deep = layers.fc(input=deep, size=16, act="relu")
+
+    # wide part: dense features straight into the logit
+    wide = layers.fc(input=dense, size=1)
+    deep_logit = layers.fc(input=deep, size=1)
+    logit = deep_logit + wide
+    loss = layers.mean(layers.sigmoid_cross_entropy_with_logits(
+        x=logit, label=layers.cast(label, "float32")))
+    return loss
+
+
+class TestWideDeepSparse:
+    def test_single_device_sparse_grads(self):
+        rng = np.random.RandomState(0)
+        loss = _wide_deep(distributed=False)
+        fluid.optimizer.Adagrad(learning_rate=0.2).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        losses = []
+        for _ in range(30):
+            ids, dense, click = _synthetic_ctr(rng, BATCH)
+            (lv,) = exe.run(fluid.default_main_program(),
+                            feed={"ids": ids, "dense": dense,
+                                  "label": click},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        assert np.isfinite(losses).all()
+        assert np.mean(losses[-10:]) < np.mean(losses[:10]), losses
+
+
+class TestWideDeepDistributed:
+    def test_vocab_sharded_embedding_on_mesh(self):
+        rng = np.random.RandomState(1)
+        loss = _wide_deep(distributed=True)
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+
+        t = DistributeTranspiler()
+        t.transpile(trainer_id=0)
+        import re
+        rules = dict(t.param_shardings())
+        # the distributed table is sharded over the model axis on dim 0
+        spec = next(s for pat, s in rules.items()
+                    if re.search(pat, "emb_0"))
+        assert tuple(spec) == ("model", None)
+
+        mesh = make_mesh((2, 4), ("data", "model"))
+        pexe = ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                                param_shardings=t.param_shardings())
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        losses = []
+        for _ in range(30):
+            ids, dense, click = _synthetic_ctr(rng, BATCH)
+            (lv,) = pexe.run(feed={"ids": ids, "dense": dense,
+                                   "label": click}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        assert np.isfinite(losses).all()
+        assert np.mean(losses[-10:]) < np.mean(losses[:10]), losses
+
+        # the table is actually sharded on devices: check the placed
+        # sharding of the persisted param after a step
+        w = fluid.global_scope().find_var("emb_0")
+        shard = getattr(w, "sharding", None)
+        if shard is not None and hasattr(shard, "spec"):
+            assert tuple(shard.spec)[:1] == ("model",)

@@ -1,0 +1,188 @@
+"""Sparse/CTR subsystem tests: SelectedRows gradients, sparse optimizer
+branches, nce, split_ids, split_selected_rows (mirror reference
+test_lookup_table_op.py sparse cases, test_nce.py, test_split_ids_op.py,
+test_split_selected_rows_op.py, test_sgd_op.py TestSparseSGDOp)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from paddle_tpu.selected_rows import SelectedRows
+
+
+def _train_embedding(is_sparse, optimizer_fn, steps=3, seed=5):
+    """Tiny embedding regression; returns final weight matrix."""
+    rng = np.random.RandomState(seed)
+    ids = np.array([[1], [3], [1], [7]], np.int64)
+    target = rng.rand(4, 6).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="ids", shape=[4, 1], append_batch_size=False,
+                        dtype="int64")
+        t = layers.data(name="t", shape=[4, 6], append_batch_size=False)
+        emb = layers.embedding(x, size=[10, 6], is_sparse=is_sparse,
+                               param_attr="emb_w")
+        loss = layers.reduce_mean(layers.square(emb - t))
+        optimizer_fn().minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    for _ in range(steps):
+        exe.run(main, feed={"ids": ids, "t": target}, fetch_list=[loss])
+    scope = fluid.global_scope()
+    return np.asarray(scope.find_var("emb_w"))
+
+
+class TestSparseGradEquivalence:
+    """is_sparse=True must produce numerically identical training to the
+    dense scatter path for every optimizer with a sparse branch."""
+
+    def test_sgd(self):
+        w_dense = _train_embedding(False, lambda: fluid.optimizer.SGD(0.1))
+        w_sparse = _train_embedding(True, lambda: fluid.optimizer.SGD(0.1))
+        np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-5, atol=1e-6)
+
+    def test_adagrad(self):
+        mk = lambda: fluid.optimizer.Adagrad(learning_rate=0.1)
+        w_dense = _train_embedding(False, mk)
+        w_sparse = _train_embedding(True, mk)
+        np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-4, atol=1e-5)
+
+    def test_adam_rows_match(self):
+        # adam's sparse branch is LAZY (touched rows only, like the
+        # reference SparseAdamFunctor) so untouched rows must stay put and
+        # touched rows must match the dense update of the same rows
+        mk = lambda: fluid.optimizer.Adam(learning_rate=0.05)
+        w_dense = _train_embedding(False, mk)
+        w_sparse = _train_embedding(True, mk)
+        touched = [1, 3, 7]
+        np.testing.assert_allclose(w_sparse[touched], w_dense[touched],
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestSelectedRows:
+    def test_to_dense_accumulates_duplicates(self):
+        sr = SelectedRows(np.array([2, 0, 2]),
+                          np.array([[1.0], [2.0], [3.0]], np.float32), 4)
+        np.testing.assert_allclose(np.asarray(sr.to_dense()),
+                                   [[2.0], [0.0], [4.0], [0.0]])
+
+    def test_merge_duplicates(self):
+        sr = SelectedRows(np.array([5, 1, 5, 1, 5]),
+                          np.arange(5, dtype=np.float32).reshape(5, 1), 8)
+        merged = sr.merge_duplicates()
+        np.testing.assert_allclose(np.asarray(merged.to_dense()).reshape(-1),
+                                   np.asarray(sr.to_dense()).reshape(-1))
+        rows = np.asarray(merged.rows)
+        # two unique rows; remaining slots point out of bounds (dropped)
+        assert sorted(rows[rows < 8].tolist()) == [1, 5]
+        assert (rows >= 8).sum() == 3
+
+
+class TestNCE:
+    def test_forward_matches_numpy(self):
+        rng = np.random.RandomState(2)
+        n, d, v, num_neg = 4, 5, 11, 3
+        x_np = rng.rand(n, d).astype("float32")
+        lbl_np = rng.randint(0, v, (n, 1)).astype("int64")
+        custom_neg = [2, 5, 9]
+
+        xv = layers.data(name="x", shape=[n, d], append_batch_size=False)
+        lv = layers.data(name="l", shape=[n, 1], append_batch_size=False,
+                         dtype="int64")
+        helper = fluid.layer_helper.LayerHelper("nce")
+        w = helper.create_parameter(
+            attr=fluid.ParamAttr(name="nce_w"), shape=[v, d],
+            is_bias=False, dtype="float32")
+        b = helper.create_parameter(
+            attr=fluid.ParamAttr(name="nce_b"), shape=[v, 1],
+            is_bias=True, dtype="float32")
+        cost = helper.create_tmp_variable(dtype="float32")
+        logits = helper.create_tmp_variable(dtype="float32")
+        samples = helper.create_tmp_variable(dtype="int64")
+        helper.append_op(
+            type="nce",
+            inputs={"Input": xv, "Label": lv, "Weight": w, "Bias": b},
+            outputs={"Cost": cost, "SampleLogits": logits,
+                     "SampleLabels": samples},
+            attrs={"num_total_classes": v, "num_neg_samples": num_neg,
+                   "custom_neg_classes": custom_neg})
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        cost_v, samples_v = exe.run(
+            fluid.default_main_program(),
+            feed={"x": x_np, "l": lbl_np}, fetch_list=[cost, samples])
+
+        scope = fluid.global_scope()
+        w_np = np.asarray(scope.find_var("nce_w"))
+        b_np = np.asarray(scope.find_var("nce_b")).reshape(-1)
+        bq = num_neg / v
+        expect = np.zeros((n, 1), np.float32)
+        for i in range(n):
+            labs = [int(lbl_np[i, 0])] + custom_neg
+            assert samples_v[i].tolist() == labs
+            for j, y in enumerate(labs):
+                o = 1.0 / (1.0 + np.exp(-(x_np[i] @ w_np[y] + b_np[y])))
+                expect[i, 0] += (-np.log(o / (o + bq)) if j == 0
+                                 else -np.log(bq / (o + bq)))
+        np.testing.assert_allclose(cost_v, expect, rtol=1e-4, atol=1e-5)
+
+    def test_nce_layer_trains(self):
+        rng = np.random.RandomState(4)
+        x_np = rng.rand(8, 6).astype("float32")
+        lbl_np = rng.randint(0, 20, (8, 1)).astype("int64")
+        xv = layers.data(name="x", shape=[8, 6], append_batch_size=False)
+        lv = layers.data(name="l", shape=[8, 1], append_batch_size=False,
+                         dtype="int64")
+        cost = layers.nce(input=xv, label=lv, num_total_classes=20,
+                          num_neg_samples=5)
+        loss = layers.reduce_mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        losses = []
+        for _ in range(20):
+            (lv_,) = exe.run(fluid.default_main_program(),
+                             feed={"x": x_np, "l": lbl_np},
+                             fetch_list=[loss])
+            losses.append(float(np.asarray(lv_).reshape(-1)[0]))
+        assert np.isfinite(losses).all()
+        # sampled loss is noisy; compare smoothed start/end
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+class TestSplitIds:
+    def test_mod_sharding(self):
+        ids = np.array([[0], [3], [7], [4], [9], [2]], np.int64)
+        iv = layers.data(name="ids", shape=[6, 1], append_batch_size=False,
+                         dtype="int64")
+        helper = fluid.layer_helper.LayerHelper("split_ids")
+        outs = [helper.create_tmp_variable(dtype="int64") for _ in range(3)]
+        helper.append_op(type="split_ids", inputs={"Ids": iv},
+                         outputs={"Out": outs})
+        exe = fluid.Executor()
+        got = exe.run(fluid.default_main_program(), feed={"ids": ids},
+                      fetch_list=outs)
+        assert sorted(np.asarray(got[0]).reshape(-1).tolist()) == [0, 3, 9]
+        assert sorted(np.asarray(got[1]).reshape(-1).tolist()) == [4, 7]
+        assert sorted(np.asarray(got[2]).reshape(-1).tolist()) == [2]
+
+
+class TestSplitSelectedRows:
+    def test_height_sections(self):
+        x = np.arange(12, dtype=np.float32).reshape(6, 2)
+        xv = layers.data(name="x", shape=[6, 2], append_batch_size=False)
+        helper = fluid.layer_helper.LayerHelper("split_selected_rows")
+        outs = [helper.create_tmp_variable(dtype="float32")
+                for _ in range(2)]
+        helper.append_op(type="split_selected_rows", inputs={"X": xv},
+                         outputs={"Out": outs},
+                         attrs={"height_sections": [4, 2]})
+        exe = fluid.Executor()
+        res = exe.run(fluid.default_main_program(), feed={"x": x},
+                      fetch_list=outs, return_numpy=False)
+        d0 = np.asarray(res[0].to_dense())
+        d1 = np.asarray(res[1].to_dense())
+        np.testing.assert_allclose(d0, x[:4])
+        np.testing.assert_allclose(d1, x[4:])
