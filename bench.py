@@ -58,10 +58,49 @@ def main():
         batch, seq = 4, 32
         warmup_calls, steps = 1, 4
 
+    # input mode: "memory" (default) stages pre-stacked device arrays;
+    # "recordio" exercises the full reader-op pipeline (recordio file ->
+    # open_recordio_file -> double_buffer -> read ops feeding run_steps)
+    input_mode = os.environ.get("PADDLE_TPU_BENCH_INPUT", "memory")
+
     main_prog = fluid.Program()
     startup = fluid.Program()
+    batches = [T.fake_batch(batch, seq, seq, hp, seed=s)
+               for s in range(steps)]
+    keys = ["src_word", "trg_word", "src_mask", "lbl_word", "lbl_weight"]
+    recordio_path = None
+    if input_mode == "recordio":
+        import tempfile
+        from paddle_tpu.recordio_writer import (
+            convert_reader_to_recordio_file)
+        recordio_path = os.path.join(tempfile.mkdtemp(), "bench.recordio")
+
+        def _samples():
+            # one record per STEP batch, repeated for warmup+measure calls
+            for _ in range(warmup_calls + 1):
+                for b in batches:
+                    yield tuple(b[k] for k in keys)
+
+        # RAW chunks: zlib decode of ~20MB/call would dominate the host
+        # side of the pipeline
+        convert_reader_to_recordio_file(recordio_path, _samples,
+                                        compressor=0)
+
     with fluid.program_guard(main_prog, startup):
-        avg_cost, _ = T.transformer(batch, seq, seq, hp)
+        input_vars = None
+        if input_mode == "recordio":
+            from paddle_tpu import layers as L
+            reader = L.open_recordio_file(
+                filename=recordio_path,
+                shapes=[(batch, seq), (batch, seq), (batch, seq),
+                        (batch, seq), (batch, seq)],
+                lod_levels=[0] * 5,
+                dtypes=["int32", "int32", "float32", "int32", "float32"],
+                pass_num=10**6)
+            reader = L.double_buffer(reader, capacity=steps + 2)
+            input_vars = L.read_file(reader)
+        avg_cost, _ = T.transformer(batch, seq, seq, hp,
+                                    input_vars=input_vars)
         opt = fluid.optimizer.Adam(learning_rate=1e-4)
         opt.minimize(avg_cost)
     # bf16 compute with f32 master weights (mixed precision)
@@ -77,10 +116,11 @@ def main():
         # so per-step host->device latency is off the measured path — the
         # double-buffered-reader discipline of the reference
         # (operators/reader/create_double_buffer_reader_op.cc), TPU-style.
-        batches = [T.fake_batch(batch, seq, seq, hp, seed=s)
-                   for s in range(steps)]
-        stacked = {k: jax.device_put(np.stack([b[k] for b in batches]))
-                   for k in batches[0]}
+        if input_mode == "recordio":
+            stacked = {}
+        else:
+            stacked = {k: jax.device_put(np.stack([b[k] for b in batches]))
+                       for k in batches[0]}
         for _ in range(warmup_calls):
             losses = exe.run_steps(main_prog, feed=stacked,
                                    fetch_list=[avg_cost.name], steps=steps)

@@ -33,7 +33,65 @@ __all__ = ["Executor", "fetch_var"]
 logger = logging.getLogger(__name__)
 
 # op types that exist for API parity but are no-ops inside a lowered block
-_SKIP_OPS = frozenset({"feed", "fetch"})
+from paddle_tpu.ops.reader_ops import (READER_CREATE_OPS, READER_OPS,
+                                       EOFException, build_reader)
+
+# feed/fetch are rewritten by the executor; reader ops run in the host-side
+# pre-pass (_run_reader_ops) so the compiled step never sees them
+_SKIP_OPS = frozenset({"feed", "fetch"}) | READER_OPS
+
+
+def _run_reader_ops(block, scope, feed_arrays, device, steps=None):
+    """Host-side reader pre-pass: construct reader objects (idempotent) and
+    pop one batch per ``read`` op into ``feed_arrays`` (or ``steps`` stacked
+    batches for the device-side loop).  Runs BEFORE compile/dispatch each
+    step — the TPU placement of the reference's per-op reader dispatch
+    (``operators/reader/reader_op_registry.h``)."""
+    for op in block.ops:
+        if op.type in READER_CREATE_OPS:
+            out = op.output("Out")[0]
+            if scope.find_var(out) is None:
+                reader = build_reader(op, scope, device=device)
+                scope.set_var(out, reader)
+                # back-pointer for Variable.reset() so the user-facing
+                # handle works with explicit (non-global) scopes too
+                try:
+                    block.var(out)._reader_runtime = reader
+                except KeyError:
+                    pass
+        elif op.type == "read":
+            reader = scope.find_var(op.input("Reader")[0])
+            if reader is None:
+                raise RuntimeError(
+                    f"reader {op.input('Reader')[0]!r} is not created — "
+                    f"run the startup program first")
+            try:
+                if steps is None:
+                    batch = reader.next()
+                else:
+                    pulled = []
+                    try:
+                        for _ in range(steps):
+                            pulled.append(reader.next())
+                    except StopIteration:
+                        # mid-pull EOF: return the consumed batches so a
+                        # later pull serves them (in order) instead of
+                        # dropping them
+                        for p in reversed(pulled):
+                            reader.unget(p)
+                        raise
+                    # keep the stack on-device when the reader (double
+                    # buffer) already staged the batches there
+                    stack = jnp.stack if hasattr(pulled[0][0], "devices") \
+                        else np.stack
+                    batch = tuple(stack([p[i] for p in pulled])
+                                  for i in range(len(pulled[0])))
+            except StopIteration:
+                raise EOFException(
+                    "reader exhausted — call reader.reset() to rewind")
+            for name, arr in zip(op.output("Out"), batch):
+                feed_arrays[name] = _as_device_array(arr, None, device) \
+                    if not hasattr(arr, "devices") else arr
 
 
 def _as_device_array(value, dtype=None, device=None):
@@ -143,6 +201,8 @@ class Executor:
             # ragged feed of the same variable
             scope.set_lod(name, lod)
 
+        _run_reader_ops(block, scope, feed_arrays, device)
+
         compiled = self._get_compiled(program, block, feed_arrays,
                                       tuple(fetch_names), scope)
 
@@ -215,6 +275,12 @@ class Executor:
             else:
                 const_feed[name] = arr           # one batch, reused
             scope.set_lod(name, None)
+
+        # reader ops: pull `steps` batches and ride the per-step axis of
+        # the device-side loop (double-buffer + scan = the full pipeline)
+        reader_feed = {}
+        _run_reader_ops(block, scope, reader_feed, device, steps=steps)
+        per_step_feed.update(reader_feed)
 
         sample = dict(const_feed)
         sample.update({n: a[0] for n, a in per_step_feed.items()})

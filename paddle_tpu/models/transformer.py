@@ -270,11 +270,20 @@ def _position_ids(batch_size, seq_len):
     return layers.assign(pos)
 
 
-def transformer(batch_size, src_len, trg_len, hp: ModelHyperParams = None):
-    """Build the full training graph; returns (avg_cost, feed_vars)."""
+def transformer(batch_size, src_len, trg_len, hp: ModelHyperParams = None,
+                input_vars=None):
+    """Build the full training graph; returns (avg_cost, feed_vars).
+
+    ``input_vars``: optional 5-tuple (src_ids, trg_ids, src_mask, labels,
+    weights) of pre-built variables — e.g. ``layers.read_file`` outputs of
+    a recordio reader pipeline — replacing the dense feed declarations.
+    """
     hp = hp or ModelHyperParams()
-    src_ids, trg_ids, src_mask, labels, weights = build_inputs(
-        batch_size, src_len, trg_len, hp)
+    if input_vars is not None:
+        src_ids, trg_ids, src_mask, labels, weights = input_vars
+    else:
+        src_ids, trg_ids, src_mask, labels, weights = build_inputs(
+            batch_size, src_len, trg_len, hp)
 
     src_pos = _position_ids(batch_size, src_len)
     trg_pos = _position_ids(batch_size, trg_len)

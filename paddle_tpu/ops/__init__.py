@@ -8,6 +8,7 @@ reference's ``USE_OP`` generated pybind stubs,
 from paddle_tpu.ops import registry  # noqa: F401
 from paddle_tpu.ops import (  # noqa: F401
     detection_ops,
+    reader_ops,
     sparse_ops,
     math_ops,
     tensor_ops,

@@ -198,8 +198,11 @@ def _spec_fits(spec, shape, mesh):
 
 
 def _written_persistables(block):
+    from paddle_tpu.executor import _SKIP_OPS
     out = []
     for op in block.ops:
+        if op.type in _SKIP_OPS:  # reader vars hold host objects, not state
+            continue
         for n in op.output_arg_names:
             try:
                 var = block.var(n)
