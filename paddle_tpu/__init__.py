@@ -19,6 +19,10 @@ from paddle_tpu.scope import Scope, global_scope, scope_guard
 from paddle_tpu import ops  # registers all op lowerings
 from paddle_tpu.executor import Executor, fetch_var
 from paddle_tpu.ops.reader_ops import EOFException
+from paddle_tpu import concurrency
+from paddle_tpu.concurrency import (Go, Select, make_channel, channel_send,
+                                    channel_recv, channel_close)
+from paddle_tpu.channel import Channel as CSPChannel, ChannelClosedError
 from paddle_tpu.backward import append_backward, calc_gradient
 from paddle_tpu import initializer
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr

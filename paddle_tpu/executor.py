@@ -120,6 +120,57 @@ class _CompiledBlock:
         self.uses_rng = uses_rng
 
 
+class ScopeEnv(dict):
+    """Interpret-mode env with write-through/read-through of PERSISTABLE
+    vars to the scope — the reference's semantics, where every thread's op
+    reads and writes one shared Scope (scope.h).  Needed so CSP go-routine
+    threads and the main block observe each other's persistable writes."""
+
+    def __init__(self, scope, persistable_names, init=None):
+        super().__init__()
+        self.scope = scope
+        self.persistable_names = persistable_names
+        if init:
+            dict.update(self, init)
+
+    def __getitem__(self, k):
+        if k in self.persistable_names:
+            v = self.scope.find_var(k)
+            if v is not None:
+                return v
+        return dict.__getitem__(self, k)
+
+    def get(self, k, default=None):
+        try:
+            return self[k]
+        except KeyError:
+            return default
+
+    def __setitem__(self, k, v):
+        dict.__setitem__(self, k, v)
+        if k in self.persistable_names:
+            self.scope.set_var(k, v)
+
+    def update(self, other=(), **kw):
+        items = other.items() if hasattr(other, "items") else other
+        for k, v in items:
+            self[k] = v
+        for k, v in kw.items():
+            self[k] = v
+
+    def clone_for_thread(self):
+        return ScopeEnv(self.scope, self.persistable_names, init=self)
+
+
+def _persistable_names(program):
+    names = set()
+    for blk in program.blocks:
+        for v in blk.vars.values():
+            if getattr(v, "persistable", False):
+                names.add(v.name)
+    return names
+
+
 def lower_block(block, env, rng_key, training, aux):
     """Trace all ops of ``block`` into ``env`` (used for the main block and,
     recursively, by control-flow op lowerings for sub-blocks)."""
@@ -403,6 +454,7 @@ class Executor:
             (n, _freeze_lod(scope.find_lod(n))) for n in feed_arrays
             if scope.find_lod(n) is not None))
         return (id(program), program._version, block.idx, _amp_enabled(program),
+                id(scope),  # interpret-mode steps bind the scope (ScopeEnv)
                 tuple(sorted((n, str(a.dtype), a.shape)
                              for n, a in feed_arrays.items())),
                 feed_lods,
@@ -481,14 +533,20 @@ class Executor:
 
         amp = _amp_enabled(program)
 
+        persist_names = _persistable_names(program) if interpret else None
+
         def step(feeds, ro_state, inout_state, rng_key):
-            env = {}
+            if interpret:
+                # shared-scope semantics for persistables (CSP threads)
+                env = ScopeEnv(scope, persist_names)
+            else:
+                env = {}
             env.update(feeds)
             env.update(ro_state)
             env.update(inout_state)
             aux = {"rng_counter": 0, "scope": scope,
                    "lower_block": lower_block, "lod": dict(lod_map),
-                   "amp": amp}
+                   "amp": amp, "interpret": interpret, "block": block}
             lower_block(block, env, rng_key, training, aux)
             fetches = [env[n] for n in self.fetch_missing_check(fetch_names, env)]
             new_state = {n: env[n] for n in inout_names + create_state

@@ -7,6 +7,7 @@ reference's ``USE_OP`` generated pybind stubs,
 
 from paddle_tpu.ops import registry  # noqa: F401
 from paddle_tpu.ops import (  # noqa: F401
+    csp_ops,
     detection_ops,
     reader_ops,
     sparse_ops,

@@ -119,6 +119,11 @@ def lod_array_length_lower(ctx: LowerContext):
 # while  (while_op.cc)
 # ---------------------------------------------------------------------------
 
+def _block_has_host_ops(block):
+    from paddle_tpu.executor import _has_host_ops
+    return _has_host_ops(block)
+
+
 def _collect_written(block):
     names = []
     for op in block.ops:
@@ -152,6 +157,21 @@ def while_lower(ctx: LowerContext):
     sub_block = ctx.attr("sub_block")
     cond_name = ctx.op.input("Condition")[0]
     written = _collect_written(sub_block)
+
+    # CSP/host ops in the body (go/select/channel_*) cannot trace into a
+    # lax loop; in interpret mode run a plain Python while over the eager
+    # sub-block instead (the reference's per-iteration re-interpretation,
+    # while_op.cc)
+    if ctx.aux.get("interpret") and _block_has_host_ops(sub_block):
+        env = ctx.env
+        lb = ctx.aux["lower_block"]
+        import numpy as _np
+        while bool(_np.asarray(env[cond_name]).reshape(-1)[0]):
+            lb(sub_block, env, ctx._rng_key, ctx.training, ctx.aux)
+        for n in written:
+            if n in env:
+                ctx.outputs[n] = env[n]
+        return
 
     outer_env = dict(ctx.env)
     # snapshot for the grad op: loop carries overwrite their own names in

@@ -1,0 +1,185 @@
+"""CSP ops: channel_create/send/recv/close, go, select.
+
+Reference: ``operators/channel_{create,send,recv,close}_op.cc``,
+``go_op.cc``, ``select_op.cc`` over ``framework/channel.h``.
+
+All are HOST ops (the reference registers them CPU-only and drives them
+from its interpreter threads): a block using them runs in the executor's
+op-by-op interpret mode, with ``go`` bodies on Python daemon threads and
+channels coordinating through ``paddle_tpu.channel.Channel``.  This layer
+is host-side control orchestration — device compute inside go/select
+bodies still lowers through the normal op registry (eagerly here).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.channel import Channel
+from paddle_tpu.ops.registry import register_op, ShapeInferenceSkip
+
+
+def _infer_skip(op, block):
+    raise ShapeInferenceSkip()
+
+
+@register_op("channel_create", infer_shape=_infer_skip, no_gradient=True,
+             host=True)
+def channel_create_lower(ctx):
+    out = ctx.op.output("Out")[0]
+    # idempotent across steps: reuse the channel living in the scope
+    scope = ctx.aux.get("scope")
+    existing = scope.find_var(out) if scope is not None else None
+    if isinstance(existing, Channel):
+        ctx.outputs[out] = existing
+        return
+    ctx.outputs[out] = Channel(capacity=ctx.attr("capacity", 0),
+                               dtype=ctx.attr("data_type"))
+
+
+@register_op("channel_send", infer_shape=_infer_skip, no_gradient=True,
+             host=True)
+def channel_send_lower(ctx):
+    ch = ctx.env[ctx.op.input("Channel")[0]]
+    value = ctx.input("X")
+    ch.send(np.asarray(value))
+    ctx.set_output("Status", jnp.asarray([True]))
+
+
+@register_op("channel_recv", infer_shape=_infer_skip, no_gradient=True,
+             host=True)
+def channel_recv_lower(ctx):
+    ch = ctx.env[ctx.op.input("Channel")[0]]
+    value, ok = ch.receive()
+    out_name = ctx.op.output("Out")[0]
+    if ok:
+        ctx.outputs[out_name] = jnp.asarray(value)
+    else:
+        # closed-and-drained: zero value of the placeholder's shape if known
+        prev = ctx.env.get(out_name)
+        ctx.outputs[out_name] = (jnp.zeros_like(prev) if prev is not None
+                                 else jnp.zeros((1,), jnp.float32))
+    ctx.set_output("Status", jnp.asarray([ok]))
+
+
+@register_op("channel_close", infer_shape=_infer_skip, no_gradient=True,
+             host=True)
+def channel_close_lower(ctx):
+    ctx.env[ctx.op.input("Channel")[0]].close()
+
+
+def _run_block_on_thread(sub_block, env, aux, training):
+    """go body: execute the sub-block eagerly on a daemon thread; writes
+    to persistable vars go to the scope immediately so other routines see
+    them (the reference shares one Scope across its threads)."""
+    lower_block = aux["lower_block"]
+    scope = aux.get("scope")
+
+    def find_var(name):
+        b = sub_block
+        while b is not None:
+            if b.has_var_local(name):
+                return b.var(name)
+            b = b.parent_block
+        return None
+
+    def body():
+        thread_aux = dict(aux)
+        thread_aux["rng_counter"] = 0
+        for op in sub_block.ops:
+            from paddle_tpu.ops import registry as _registry
+            opdef = _registry.resolve_lowering(op.type)
+            octx = _registry.LowerContext(op, env, sub_block, rng_key=None,
+                                          training=training, aux=thread_aux)
+            opdef.lower(octx)
+            env.update(octx.outputs)
+            if scope is not None:
+                for n in octx.outputs:
+                    v = find_var(n)
+                    if v is not None and getattr(v, "persistable", False):
+                        scope.set_var(n, env[n])
+
+    t = threading.Thread(target=body, daemon=True)
+    t.start()
+    return t
+
+
+@register_op("go", infer_shape=_infer_skip, no_gradient=True, host=True)
+def go_lower(ctx):
+    """Launch the sub-block as a goroutine (reference go_op.cc:
+    ExecuteOnThread with a detached std::thread)."""
+    sub_block = ctx.attr("sub_block")
+    # closure snapshot; channels are shared objects and persistables
+    # write/read through the shared scope (ScopeEnv)
+    env = ctx.env.clone_for_thread() if hasattr(ctx.env, "clone_for_thread") \
+        else dict(ctx.env)
+    threads = ctx.aux.setdefault("go_threads", [])
+    threads.append(_run_block_on_thread(sub_block, env, ctx.aux,
+                                        ctx.training))
+
+
+@register_op("select", infer_shape=_infer_skip, no_gradient=True, host=True)
+def select_lower(ctx):
+    """Block until one case can proceed, perform its channel action, then
+    run that case's body block (reference select_op.cc semantics with the
+    same 'idx,action,channel,value' case serialization; DEFAULT fires when
+    no other case is immediately ready)."""
+    cases = ctx.attr("cases", [])  # ["idx,action,ch_name,val_name", ...]
+    parsed = []
+    default_idx = None
+    for c in cases:
+        parts = c.split(",")
+        idx, action = int(parts[0]), int(parts[1])
+        ch_name = parts[2] if len(parts) > 2 else ""
+        val_name = parts[3] if len(parts) > 3 else ""
+        if action == 0:  # DEFAULT
+            default_idx = idx
+        parsed.append((idx, action, ch_name, val_name))
+
+    lower_block = ctx.aux["lower_block"]
+
+    def fire(idx, recv_name=None, recv_value=None, recv_ok=None):
+        blk = ctx.op.attrs.get(f"case_block_{idx}")
+        if recv_name:
+            ctx.env[recv_name] = jnp.asarray(recv_value) if recv_ok else \
+                jnp.zeros_like(ctx.env[recv_name]) \
+                if ctx.env.get(recv_name) is not None else \
+                jnp.zeros((1,), jnp.float32)
+            ctx.outputs[recv_name] = ctx.env[recv_name]
+        if blk is not None:
+            lower_block(blk, ctx.env, None, ctx.training, ctx.aux)
+            # surface case-body writes as op outputs so they reach the
+            # surrounding env/state
+            for op in blk.ops:
+                for n in op.output_arg_names:
+                    if n in ctx.env:
+                        ctx.outputs[n] = ctx.env[n]
+
+    # hoist send-value host transfers out of the poll loop
+    send_values = {val_name: np.asarray(ctx.env[val_name])
+                   for _, action, __, val_name in parsed if action == 1}
+    deadline = time.monotonic() + float(ctx.attr("timeout_s", 60.0))
+    while True:
+        for idx, action, ch_name, val_name in parsed:
+            if action == 1:  # SEND
+                ch = ctx.env[ch_name]
+                if ch.try_send(send_values[val_name]):
+                    fire(idx)
+                    return
+            elif action == 2:  # RECEIVE
+                ch = ctx.env[ch_name]
+                value, ok, ready = ch.try_receive()
+                if ready:
+                    fire(idx, recv_name=val_name, recv_value=value,
+                         recv_ok=ok)
+                    return
+        if default_idx is not None:
+            fire(default_idx)
+            return
+        if time.monotonic() > deadline:
+            raise RuntimeError("select: no case became ready (deadlock?)")
+        time.sleep(0.0005)

@@ -76,7 +76,9 @@ class _ReaderBase:
         self._pushback.append(batch)
 
     def reset(self):
-        self._pushback = None
+        """Rewind.  Pushed-back batches (pulled but never consumed — a
+        run_steps call that hit EOF mid-pull) SURVIVE the reset and are
+        served before the rewound stream, so no data is silently lost."""
         self._reset()
 
     def _next(self):
@@ -276,24 +278,28 @@ class DoubleBufferReader(_ReaderBase):
         self._q = queue.Queue(maxsize=self.capacity)
         self._stop = threading.Event()
 
-        def worker():
-            while not self._stop.is_set():
+        def worker(q, stop):
+            # q/stop are LOCALS: a worker that outlives a reset can only
+            # ever touch its own (abandoned) queue and stop event
+            while not stop.is_set():
                 try:
                     batch = self.u.next()
                 except StopIteration:
-                    self._q.put(self._SENTINEL)
+                    q.put(self._SENTINEL)
                     return
                 except Exception as e:  # surface errors on the consumer
-                    self._q.put(e)
+                    q.put(e)
                     return
                 if self.device is not None:
                     batch = tuple(jax.device_put(b, self.device)
                                   for b in batch)
                 else:
                     batch = tuple(jax.numpy.asarray(b) for b in batch)
-                self._q.put(batch)
+                q.put(batch)
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(target=worker,
+                                        args=(self._q, self._stop),
+                                        daemon=True)
         self._thread.start()
 
     def _next(self):
@@ -316,6 +322,12 @@ class DoubleBufferReader(_ReaderBase):
         except queue.Empty:
             pass
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            # resetting the underlying reader under a live worker would
+            # corrupt its stream — fail loudly instead
+            raise RuntimeError(
+                "double-buffer worker did not stop within 5s (blocked in "
+                "underlying reader?); cannot safely reset")
         self.u.reset()
         self._start()
 

@@ -1,0 +1,306 @@
+"""CSP tests.
+
+Part 1 ports the reference channel semantics suite
+(``paddle/fluid/framework/channel_test.cc``, ~1k LoC) to pytest against
+``paddle_tpu.channel.Channel``.  Part 2 mirrors the IR-level
+``python/paddle/fluid/tests/test_concurrency.py`` flows (Go routines,
+select, fibonacci) through the real Executor.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from paddle_tpu.channel import Channel, ChannelClosedError
+
+
+# ===========================================================================
+# Part 1: channel semantics (channel_test.cc ports)
+# ===========================================================================
+
+class TestChannelSemantics:
+    def test_capacity(self):
+        assert Channel(capacity=10).cap() == 10
+        assert Channel().cap() == 0
+
+    def test_sufficient_buffer_doesnt_block(self):
+        # channel_test.cc SufficientBufferSizeDoesntBlock
+        ch = Channel(capacity=10)
+        for i in range(10):
+            ch.send(i)          # must not block
+        for i in range(10):
+            v, ok = ch.receive()
+            assert ok and v == i
+
+    def test_send_on_closed_buffered_panics(self):
+        # channel_test.cc SendReceiveClosedBufferedChannelPanics
+        ch = Channel(capacity=1)
+        ch.send(1)
+        ch.close()
+        with pytest.raises(ChannelClosedError):
+            ch.send(2)
+
+    def test_send_on_closed_unbuffered_panics(self):
+        ch = Channel()
+        ch.close()
+        with pytest.raises(ChannelClosedError):
+            ch.send(1)
+
+    def test_residual_values_after_close(self):
+        # channel_test.cc ReceiveFromBufferedChannelReturnResidualValuesTest
+        ch = Channel(capacity=10)
+        for i in range(10):
+            ch.send(i)
+        ch.close()
+        for i in range(10):
+            v, ok = ch.receive()  # residuals drain with ok=True
+            assert ok and v == i
+        for _ in range(2):
+            v, ok = ch.receive()  # then closed-and-drained
+            assert not ok
+
+    def test_unbuffered_order_matches_send_order(self):
+        # channel_test.cc RecevingOrderEqualToSendingOrderWithUnBufferedChannel
+        ch = Channel()
+        got = []
+
+        def sender():
+            for i in range(20):
+                ch.send(i)
+
+        t = threading.Thread(target=sender)
+        t.start()
+        for _ in range(20):
+            v, ok = ch.receive()
+            assert ok
+            got.append(v)
+        t.join()
+        assert got == list(range(20))
+
+    def test_buffered_order_matches_send_order(self):
+        ch = Channel(capacity=3)
+        got = []
+
+        def sender():
+            for i in range(50):
+                ch.send(i)
+
+        t = threading.Thread(target=sender)
+        t.start()
+        for _ in range(50):
+            v, ok = ch.receive()
+            assert ok
+            got.append(v)
+        t.join()
+        assert got == list(range(50))
+
+    def test_close_unblocks_receivers(self):
+        # channel_test.cc {Buffered,Unbuffered}ChannelCloseUnblocksReceiversTest
+        for cap in (0, 3):
+            ch = Channel(capacity=cap)
+            ended = [False] * 4
+
+            def recv(i):
+                v, ok = ch.receive()
+                assert not ok
+                ended[i] = True
+
+            threads = [threading.Thread(target=recv, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+            assert not any(ended)
+            ch.close()
+            for t in threads:
+                t.join(timeout=5)
+            assert all(ended)
+
+    def test_close_unblocks_senders(self):
+        # channel_test.cc {Buffered,Unbuffered}ChannelCloseUnblocksSendersTest
+        for cap in (0, 2):
+            ch = Channel(capacity=cap)
+            if cap:
+                for i in range(cap):
+                    ch.send(i)  # fill the buffer
+            results = [None] * 4
+
+            def send(i):
+                try:
+                    ch.send(i)
+                    results[i] = "sent"
+                except ChannelClosedError:
+                    results[i] = "closed"
+
+            threads = [threading.Thread(target=send, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+            assert all(r is None for r in results)  # all blocked
+            ch.close()
+            for t in threads:
+                t.join(timeout=5)
+            assert all(r == "closed" for r in results)
+
+    def test_unbuffered_less_receive_more_send(self):
+        # channel_test.cc UnbufferedLessReceiveMoreSendTest
+        ch = Channel()
+        sent = []
+
+        def sender():
+            for i in range(4):
+                try:
+                    ch.send(i)
+                    sent.append(i)
+                except ChannelClosedError:
+                    return
+
+        t = threading.Thread(target=sender)
+        t.start()
+        for i in range(3):
+            v, ok = ch.receive()
+            assert ok and v == i
+        time.sleep(0.05)
+        assert sent == [0, 1, 2]  # 4th send still blocked
+        ch.close()
+        t.join(timeout=5)
+
+    def test_concurrent_send_sufficient_buffer(self):
+        ch = Channel(capacity=10)
+        threads = [threading.Thread(target=ch.send, args=(i,))
+                   for i in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        got = sorted(ch.receive()[0] for _ in range(10))
+        assert got == list(range(10))
+
+
+# ===========================================================================
+# Part 2: IR-level concurrency flows (test_concurrency.py ports)
+# ===========================================================================
+
+def _int_tensor(name_hint, value=0, dtype="int64"):
+    from paddle_tpu.framework import unique_name, default_main_program
+    block = default_main_program().current_block()
+    var = block.create_var(name=unique_name(name_hint), dtype=dtype)
+    return var
+
+
+class TestRoutineOp:
+    def test_simple_routine(self):
+        ch = fluid.make_channel(dtype="float64")
+        result = _int_tensor("return_value", dtype="float64")
+
+        with fluid.Go():
+            input_value = layers.fill_constant(shape=[1], dtype="float64",
+                                               value=1234)
+            fluid.channel_send(ch, input_value)
+
+        result, status = fluid.channel_recv(ch, result)
+        fluid.channel_close(ch)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        outs = exe.run(fluid.default_main_program(), fetch_list=[result])
+        assert float(np.asarray(outs[0]).reshape(-1)[0]) == 1234
+
+    def test_daisy_chain(self):
+        """Go daisy chain (talks.golang.org/2012/concurrency.slide#39),
+        scaled down to n=20."""
+        n = 20
+        leftmost = fluid.make_channel(dtype="int64")
+        left = leftmost
+        for _ in range(n):
+            right = fluid.make_channel(dtype="int64")
+            with fluid.Go():
+                one = layers.fill_constant(shape=[1], dtype="int64", value=1)
+                result = _int_tensor("return_value")
+                result, _ = fluid.channel_recv(right, result)
+                one_added = layers.elementwise_add(x=one, y=result)
+                fluid.channel_send(left, one_added)
+            left = right
+
+        with fluid.Go():
+            one = layers.fill_constant(shape=[1], dtype="int64", value=1)
+            fluid.channel_send(right, one)
+
+        leftmost_result = _int_tensor("return_value")
+        leftmost_result, _ = fluid.channel_recv(leftmost, leftmost_result)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        out = exe.run(fluid.default_main_program(),
+                      fetch_list=[leftmost_result])
+        assert int(np.asarray(out[0]).reshape(-1)[0]) == n + 1
+
+    def test_select_buffered_send(self):
+        ch1 = fluid.make_channel(dtype="float64", capacity=1)
+        result1 = _int_tensor("return_value", dtype="float64")
+        input_value = layers.fill_constant(shape=[1], dtype="float64",
+                                           value=10)
+        with fluid.Select() as select:
+            with select.case(fluid.channel_send, ch1, input_value):
+                pass
+            with select.default():
+                pass
+        result1, status = fluid.channel_recv(ch1, result1)
+        fluid.channel_close(ch1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        out = exe.run(fluid.default_main_program(), fetch_list=[result1])
+        assert float(np.asarray(out[0]).reshape(-1)[0]) == 10
+
+    def test_fibonacci(self):
+        """Go Fibonacci select example (tour.golang.org/concurrency/5)."""
+        from paddle_tpu.framework import default_main_program
+        block = default_main_program().current_block()
+
+        def persistable(name, dtype="int32"):
+            from paddle_tpu.framework import unique_name
+            v = block.create_var(name=unique_name(name), dtype=dtype)
+            v.persistable = True
+            return v
+
+        quit_input = persistable("quit_ch_input")
+        layers.fill_constant(shape=[1], dtype="int32", value=0,
+                             out=quit_input)
+        result = persistable("result")
+        layers.fill_constant(shape=[1], dtype="int32", value=0, out=result)
+
+        x = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        y = layers.fill_constant(shape=[1], dtype="int32", value=1)
+        while_cond = layers.fill_constant(shape=[1], dtype="bool", value=True)
+        while_false = layers.fill_constant(shape=[1], dtype="bool",
+                                           value=False)
+        x_tmp = layers.fill_constant(shape=[1], dtype="int32", value=0)
+
+        ch1 = fluid.make_channel(dtype="int32")
+        quit_ch = fluid.make_channel(dtype="int32")
+
+        with fluid.Go():
+            for _ in range(10):
+                fluid.channel_recv(ch1, result)
+            fluid.channel_send(quit_ch, quit_input)
+
+        while_op = layers.While(cond=while_cond)
+        with while_op.block():
+            result2 = layers.fill_constant(shape=[1], dtype="int32", value=0)
+            with fluid.Select() as select:
+                with select.case(fluid.channel_send, ch1, x, is_copy=True):
+                    layers.assign(x, output=x_tmp)
+                    layers.assign(y, output=x)
+                    layers.assign(layers.elementwise_add(x=x_tmp, y=y),
+                                  output=y)
+                with select.case(fluid.channel_recv, quit_ch, result2):
+                    layers.assign(while_false, output=while_cond)
+
+        fluid.channel_close(ch1)
+        fluid.channel_close(quit_ch)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        out = exe.run(fluid.default_main_program(), fetch_list=[result])
+        assert int(np.asarray(out[0]).reshape(-1)[0]) == 34
