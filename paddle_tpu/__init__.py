@@ -19,6 +19,9 @@ from paddle_tpu.scope import Scope, global_scope, scope_guard
 from paddle_tpu import ops  # registers all op lowerings
 from paddle_tpu.executor import Executor, fetch_var
 from paddle_tpu.ops.reader_ops import EOFException
+from paddle_tpu import memory_optimization_transpiler
+from paddle_tpu.memory_optimization_transpiler import (memory_optimize,
+                                                       release_memory)
 from paddle_tpu import concurrency
 from paddle_tpu.concurrency import (Go, Select, make_channel, channel_send,
                                     channel_recv, channel_close)
@@ -38,6 +41,8 @@ from paddle_tpu.optimizer import (
 from paddle_tpu import regularizer
 from paddle_tpu import clip
 from paddle_tpu import metrics
+from paddle_tpu import evaluator
+from paddle_tpu import debuger
 from paddle_tpu import profiler
 from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu import io

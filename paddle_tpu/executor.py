@@ -174,7 +174,10 @@ def _persistable_names(program):
 def lower_block(block, env, rng_key, training, aux):
     """Trace all ops of ``block`` into ``env`` (used for the main block and,
     recursively, by control-flow op lowerings for sub-blocks)."""
-    for op in block.ops:
+    from paddle_tpu import profiler as _profiler
+    profiling = _profiler.op_profiling_enabled() and aux.get("interpret")
+    release = aux.get("release", {}).get(block.idx)
+    for i, op in enumerate(block.ops):
         if op.type in _SKIP_OPS:
             continue
         opdef = registry.resolve_lowering(op.type)
@@ -184,9 +187,25 @@ def lower_block(block, env, rng_key, training, aux):
             key = jax.random.fold_in(rng_key, aux["rng_counter"])
         ctx = registry.LowerContext(op, env, block, rng_key=key,
                                     training=training, aux=aux)
-        opdef.lower(ctx)
+        if profiling:
+            with _profiler.record_op(op.type, ctx):
+                opdef.lower(ctx)
+        else:
+            opdef.lower(ctx)
         env.update(ctx.outputs)
         _share_lod(op, ctx, env, aux)
+        if release is not None:
+            # early release (memory_optimization_transpiler.release_memory):
+            # in interpret mode every intermediate otherwise lives for the
+            # whole step; drop vars past their last use, like the
+            # reference's delete_var ops
+            stats = release.get("stats")
+            for n in release["dead_after"].get(i, ()):
+                v = env.pop(n, None)
+                if v is not None and hasattr(v, "nbytes") \
+                        and stats is not None:
+                    stats["bytes"] += int(v.nbytes)
+                    stats["vars"] += 1
     return env
 
 
@@ -268,6 +287,8 @@ class Executor:
 
         fetches, new_state = compiled.fn(feed_arrays, ro_state, inout_state,
                                          key)
+        if _check_nan_inf_enabled(program):
+            _check_nan_inf(fetch_names, fetches, new_state)
         for n, v in new_state.items():
             scope.set_var(n, v)
         if return_numpy:
@@ -453,8 +474,11 @@ class Executor:
         feed_lods = tuple(sorted(
             (n, _freeze_lod(scope.find_lod(n))) for n in feed_arrays
             if scope.find_lod(n) is not None))
+        from paddle_tpu import profiler as _profiler
         return (id(program), program._version, block.idx, _amp_enabled(program),
                 id(scope),  # interpret-mode steps bind the scope (ScopeEnv)
+                _profiler.op_profiling_enabled(),  # forces interpret mode
+                bool(getattr(program, "_release_memory", False)),
                 tuple(sorted((n, str(a.dtype), a.shape)
                              for n, a in feed_arrays.items())),
                 feed_lods,
@@ -525,7 +549,8 @@ class Executor:
         uses_rng = True  # cheap: always thread a key; XLA drops it if unused
 
         training = not program._is_inference
-        interpret = _has_host_ops(block)
+        from paddle_tpu import profiler as _profiler
+        interpret = _has_host_ops(block) or _profiler.op_profiling_enabled()
 
         lod_map = {n: [list(level) for level in scope.find_lod(n)]
                    for n in feed_arrays
@@ -534,6 +559,23 @@ class Executor:
         amp = _amp_enabled(program)
 
         persist_names = _persistable_names(program) if interpret else None
+
+        # interpret-mode early release per the memory plan (the compiled
+        # path needs none of this: XLA buffer assignment frees dead values)
+        release_map = None
+        if interpret and getattr(program, "_release_memory", False):
+            plan = getattr(program, "_memory_plan", None)
+            if plan is not None and block.idx in plan.last_use:
+                protect = set(fetch_names) | set(inout_names) | \
+                    set(create_state) | set(persist_names or ())
+                dead_after = {}
+                for name, idx in plan.last_use[block.idx].items():
+                    if name not in protect:
+                        dead_after.setdefault(idx, []).append(name)
+                stats = {"bytes": 0, "vars": 0}
+                program._release_stats = stats  # measured drop, per run
+                release_map = {block.idx: {"dead_after": dead_after,
+                                           "stats": stats}}
 
         def step(feeds, ro_state, inout_state, rng_key):
             if interpret:
@@ -547,6 +589,10 @@ class Executor:
             aux = {"rng_counter": 0, "scope": scope,
                    "lower_block": lower_block, "lod": dict(lod_map),
                    "amp": amp, "interpret": interpret, "block": block}
+            if release_map is not None:
+                stats = release_map[block.idx]["stats"]
+                stats["bytes"] = stats["vars"] = 0  # per-run measurement
+                aux["release"] = release_map
             lower_block(block, env, rng_key, training, aux)
             fetches = [env[n] for n in self.fetch_missing_check(fetch_names, env)]
             new_state = {n: env[n] for n in inout_names + create_state
@@ -593,6 +639,41 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+def _check_nan_inf_enabled(program):
+    """check_nan_inf executor mode (reference FLAGS_check_nan_inf,
+    ``executor.cc:28,352`` CheckTensorNANOrInf): per-program flag or the
+    PADDLE_TPU_CHECK_NAN_INF env var."""
+    if getattr(program, "check_nan_inf", None) is not None:
+        return bool(program.check_nan_inf)
+    import os
+    return os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0").strip().lower() \
+        not in ("0", "", "false", "off", "no")
+
+
+def _check_nan_inf(fetch_names, fetches, new_state):
+    """Raise naming the first non-finite fetched value or state var —
+    the named-tensor diagnostic CheckTensorNANOrInf gives on the
+    reference (a device-side jax debug_nans check would lose the name)."""
+    def bad(v):
+        try:
+            a = np.asarray(v)
+        except TypeError:
+            return False
+        return np.issubdtype(a.dtype, np.floating) and \
+            not np.isfinite(a).all()
+
+    for name, v in zip(fetch_names, fetches):
+        if bad(v):
+            raise RuntimeError(
+                f"Operator output {name!r} contains NaN/Inf "
+                f"(check_nan_inf mode)")
+    for name, v in new_state.items():
+        if bad(v):
+            raise RuntimeError(
+                f"Variable {name!r} contains NaN/Inf after the step "
+                f"(check_nan_inf mode)")
 
 
 def _amp_enabled(program):

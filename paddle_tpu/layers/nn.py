@@ -17,6 +17,7 @@ __all__ = [
     "fc", "embedding", "conv2d", "conv2d_transpose", "pool2d", "batch_norm",
     "layer_norm", "dropout", "softmax", "cross_entropy",
     "softmax_with_cross_entropy", "accuracy", "auc", "square_error_cost",
+    "chunk_eval",
     "lrn", "l2_normalize", "matmul", "topk", "relu", "one_hot",
     "sigmoid_cross_entropy_with_logits", "smooth_l1", "label_smooth",
     "elementwise_add", "elementwise_sub", "elementwise_mul",
@@ -25,7 +26,9 @@ __all__ = [
     "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod",
     "concat", "cast", "split", "reshape", "transpose", "expand", "pad",
     "squeeze", "unsqueeze", "gather", "scatter", "slice", "shape",
-    "prelu", "maxout", "nce", "im2sequence", "multiplex", "row_conv", "fused_attention",
+    "prelu", "maxout", "nce", "im2sequence", "multiplex", "row_conv",
+    "conv_shift", "pool3d", "unpool", "spp", "pool2d_with_index",
+    "fused_attention",
     "autoincreased_step_counter", "cos_sim", "dot_product_attention",
     "beam_search", "beam_search_decode",
 ]
@@ -535,14 +538,146 @@ def nce(input, label, num_total_classes, sample_weight=None,
     return cost / (num_neg_samples + 1)
 
 
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """NER chunk precision/recall/F1 (reference ``nn.py:1049`` over
+    ``chunk_eval_op.h``); returns (precision, recall, f1, #infer, #label,
+    #correct)."""
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_tmp_variable(dtype="float32")
+    recall = helper.create_tmp_variable(dtype="float32")
+    f1_score = helper.create_tmp_variable(dtype="float32")
+    num_infer_chunks = helper.create_tmp_variable(dtype="int64")
+    num_label_chunks = helper.create_tmp_variable(dtype="int64")
+    num_correct_chunks = helper.create_tmp_variable(dtype="int64")
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label]},
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1_score],
+                 "NumInferChunks": [num_infer_chunks],
+                 "NumLabelChunks": [num_label_chunks],
+                 "NumCorrectChunks": [num_correct_chunks]},
+        attrs={"num_chunk_types": num_chunk_types,
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    return (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
+            num_correct_chunks)
+
+
 def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
-    raise NotImplementedError(
-        "im2sequence lands with the sequence-op group (build-plan step 6)")
+    """Extract image patches as a LoD sequence (reference ``nn.py``
+    im2sequence over ``im2sequence_op.h``)."""
+    def _quad(v):
+        if isinstance(v, int):
+            return [v, v, v, v]
+        if len(v) == 2:
+            return [v[0], v[1], v[0], v[1]]
+        return list(v)
+
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="im2sequence", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"kernels": _pair(filter_size),
+                            "strides": _pair(stride),
+                            "paddings": _quad(padding)})
+    return out
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None):
-    raise NotImplementedError(
-        "row_conv lands with the sequence-op group (build-plan step 6)")
+    """Lookahead row convolution (reference ``nn.py`` row_conv over
+    ``row_conv_op.cc``; DeepSpeech2-style streaming context)."""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    dtype = input.dtype
+    filter_shape = [future_context_size + 1, input.shape[1]]
+    filter_param = helper.create_parameter(helper.param_attr,
+                                           shape=filter_shape, dtype=dtype)
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [filter_param]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def pool2d_with_index(input, pool_size, pool_stride=1, pool_padding=0,
+                      global_pooling=False, name=None):
+    """Max pooling that also returns the argmax mask (reference
+    ``pool_with_index_op.cc``); the mask feeds ``unpool``."""
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    helper = LayerHelper("pool2d_with_index", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    mask = helper.create_tmp_variable("int64", stop_gradient=True)
+    helper.append_op(type="pool2d_with_index", inputs={"X": [input]},
+                     outputs={"Out": [out], "Mask": [mask]},
+                     attrs={"ksize": _pair(pool_size),
+                            "strides": _pair(pool_stride),
+                            "paddings": _pair(pool_padding),
+                            "global_pooling": global_pooling})
+    return out, mask
+
+
+def conv_shift(x, y, name=None):
+    """Circular correlation (reference ``conv_shift_op.cc``; NTM
+    addressing)."""
+    helper = LayerHelper("conv_shift", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="conv_shift", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def pool3d(input, pool_size, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, ceil_mode=False, name=None):
+    """3-D pooling over NCDHW input (reference ``pool_op.cc`` pool3d)."""
+    def _triple(v):
+        return [v, v, v] if isinstance(v, int) else list(v)
+
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type,
+                            "ksize": _triple(pool_size),
+                            "strides": _triple(pool_stride),
+                            "paddings": _triple(pool_padding),
+                            "global_pooling": global_pooling,
+                            "ceil_mode": ceil_mode})
+    return out
+
+
+def unpool(input, indices, unpool_size, unpool_stride=None,
+           unpool_padding=0, name=None):
+    """Max unpooling from pool_with_index indices (reference
+    ``unpool_op.cc``)."""
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    helper = LayerHelper("unpool", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="unpool",
+                     inputs={"X": [input], "Indices": [indices]},
+                     outputs={"Out": [out]},
+                     attrs={"ksize": _pair(unpool_size),
+                            "strides": _pair(unpool_stride or unpool_size),
+                            "paddings": _pair(unpool_padding)})
+    return out
+
+
+def spp(input, pyramid_height, pool_type="max", name=None):
+    """Spatial pyramid pooling (reference ``spp_op.h``)."""
+    helper = LayerHelper("spp", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="spp", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pyramid_height": pyramid_height,
+                            "pooling_type": pool_type})
+    return out
 
 
 def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,

@@ -3,6 +3,8 @@ dynamic_gru, sequence_* wrappers)."""
 
 from __future__ import annotations
 
+import copy
+
 from paddle_tpu.layer_helper import LayerHelper
 from paddle_tpu.param_attr import ParamAttr
 
@@ -41,9 +43,39 @@ def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
     return hidden, cell
 
 
-def dynamic_lstmp(input, size, proj_size, **kwargs):
-    raise NotImplementedError(
-        "dynamic_lstmp: use dynamic_lstm + fc projection")
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """LSTM with recurrent projection (reference ``nn.py`` dynamic_lstmp
+    over ``lstmp_op.h``); ``input`` is [N, 4H] pre-projected, returns
+    (projection [N, P], cell [N, H])."""
+    helper = LayerHelper("lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    size = size // 4
+    weight = helper.create_parameter(helper.param_attr,
+                                     shape=[proj_size, 4 * size],
+                                     dtype=dtype)
+    proj_weight = helper.create_parameter(
+        copy.deepcopy(helper.param_attr) if helper.param_attr else None,
+        shape=[size, proj_size], dtype=dtype)
+    bias_size = [1, 7 * size] if use_peepholes else [1, 4 * size]
+    bias = helper.create_parameter(helper.bias_attr, shape=bias_size,
+                                   dtype=dtype, is_bias=True)
+    projection = helper.create_tmp_variable(dtype)
+    cell = helper.create_tmp_variable(dtype)
+    helper.append_op(
+        type="lstmp",
+        inputs={"Input": [input], "Weight": [weight],
+                "ProjWeight": [proj_weight], "Bias": [bias]},
+        outputs={"Projection": [projection], "Cell": [cell]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation})
+    return projection, cell
 
 
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,

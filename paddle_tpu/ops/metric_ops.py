@@ -102,3 +102,147 @@ def precision_recall_lower(ctx):
     ctx.set_output("AccumMetrics", jnp.concatenate([macro, micro]))
     zeros = jnp.zeros(cls, jnp.float32)
     ctx.set_output("AccumStatesInfo", jnp.stack([tp, fp, zeros, fn], axis=1))
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval — reference ``chunk_eval_op.h`` (NER chunk F1 under
+# IOB/IOE/IOBES/plain schemes).  Host op: LoD-ragged segment parsing.
+# ---------------------------------------------------------------------------
+
+_CHUNK_SCHEMES = {
+    # scheme: (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, 0),
+}
+
+
+def _chunk_segments(labels, num_chunk_types, scheme):
+    """Parse (begin, end, type) segments from one tag sequence —
+    reference GetSegments/ChunkBegin/ChunkEnd."""
+    num_tag, tag_begin, tag_inside, tag_end, tag_single = \
+        _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types
+
+    def chunk_end(ptag, ptype, tag, type_):
+        if ptype == other:
+            return False
+        if type_ == other or type_ != ptype:
+            return True
+        if ptag in (tag_begin, tag_inside):
+            return tag in (tag_begin, tag_single)
+        return ptag in (tag_end, tag_single)
+
+    def chunk_begin(ptag, ptype, tag, type_):
+        if ptype == other:
+            return type_ != other
+        if type_ == other:
+            return False
+        if type_ != ptype:
+            return True
+        if tag == tag_begin or tag == tag_single:
+            return True
+        if tag in (tag_inside, tag_end):
+            return ptag in (tag_end, tag_single)
+        return False
+
+    segments = []
+    in_chunk = False
+    start = 0
+    tag, type_ = -1, other
+    for i, lab in enumerate(labels):
+        ptag, ptype = tag, type_
+        tag = int(lab) % num_tag
+        type_ = int(lab) // num_tag
+        if in_chunk and chunk_end(ptag, ptype, tag, type_):
+            segments.append((start, i - 1, ptype))
+            in_chunk = False
+        if chunk_begin(ptag, ptype, tag, type_):
+            start = i
+            in_chunk = True
+    if in_chunk:
+        segments.append((start, len(labels) - 1, type_))
+    return segments
+
+
+@register_op("chunk_eval", no_gradient=True, host=True)
+def chunk_eval_lower(ctx):
+    import numpy as np
+    inference = np.asarray(ctx.input("Inference")).reshape(-1)
+    label = np.asarray(ctx.input("Label")).reshape(-1)
+    lod = ctx.input_lod("Inference") or ctx.input_lod("Label")
+    splits = lod[0] if lod is not None else [0, len(label)]
+    num_chunk_types = int(ctx.attr("num_chunk_types"))
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    excluded = set(ctx.attr("excluded_chunk_types", []) or [])
+
+    num_infer = num_label = num_correct = 0
+    for i in range(len(splits) - 1):
+        lo, hi = int(splits[i]), int(splits[i + 1])
+        inf_seg = [s for s in _chunk_segments(inference[lo:hi],
+                                              num_chunk_types, scheme)
+                   if s[2] not in excluded]
+        lab_seg = [s for s in _chunk_segments(label[lo:hi],
+                                              num_chunk_types, scheme)
+                   if s[2] not in excluded]
+        num_infer += len(inf_seg)
+        num_label += len(lab_seg)
+        num_correct += len(set(inf_seg) & set(lab_seg))
+
+    precision = num_correct / num_infer if num_infer else 0.0
+    recall = num_correct / num_label if num_label else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if num_correct else 0.0)
+    ctx.set_output("Precision", jnp.asarray([precision], jnp.float32))
+    ctx.set_output("Recall", jnp.asarray([recall], jnp.float32))
+    ctx.set_output("F1-Score", jnp.asarray([f1], jnp.float32))
+    ctx.set_output("NumInferChunks", jnp.asarray([num_infer], jnp.int64))
+    ctx.set_output("NumLabelChunks", jnp.asarray([num_label], jnp.int64))
+    ctx.set_output("NumCorrectChunks", jnp.asarray([num_correct], jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# positive_negative_pair — reference ``positive_negative_pair_op.h``:
+# per-query ranking pair statistics (LTR models).
+# ---------------------------------------------------------------------------
+
+@register_op("positive_negative_pair", no_gradient=True, host=True)
+def positive_negative_pair_lower(ctx):
+    import numpy as np
+    score = np.asarray(ctx.input("Score")).reshape(-1)
+    label = np.asarray(ctx.input("Label")).reshape(-1)
+    qid = np.asarray(ctx.input("QueryID")).reshape(-1)
+    weight = ctx.input("Weight")
+    w = np.asarray(weight).reshape(-1) if weight is not None else None
+    pos = neg = neu = 0.0
+    for q in np.unique(qid):
+        idx = np.where(qid == q)[0]
+        for a in range(len(idx)):
+            for b in range(a + 1, len(idx)):
+                i, j = idx[a], idx[b]
+                if label[i] == label[j]:
+                    continue
+                pair_w = 1.0 if w is None else (w[i] + w[j]) / 2.0
+                hi, lo = (i, j) if label[i] > label[j] else (j, i)
+                if score[hi] > score[lo]:
+                    pos += pair_w
+                elif score[hi] == score[lo]:
+                    neu += pair_w
+                else:
+                    neg += pair_w
+    # accumulate previous state if wired
+    for slot, add in (("AccumulatePositivePair", pos),
+                      ("AccumulateNegativePair", neg),
+                      ("AccumulateNeutralPair", neu)):
+        prev = ctx.input(slot)
+        if prev is not None:
+            if slot.endswith("PositivePair"):
+                pos = add + float(np.asarray(prev).reshape(-1)[0])
+            elif slot.endswith("NegativePair"):
+                neg = add + float(np.asarray(prev).reshape(-1)[0])
+            else:
+                neu = add + float(np.asarray(prev).reshape(-1)[0])
+    ctx.set_output("PositivePair", jnp.asarray([pos], jnp.float32))
+    ctx.set_output("NegativePair", jnp.asarray([neg], jnp.float32))
+    ctx.set_output("NeutralPair", jnp.asarray([neu], jnp.float32))

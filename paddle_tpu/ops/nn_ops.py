@@ -454,3 +454,180 @@ def label_smooth_lower(ctx):
     else:
         out = (1.0 - eps) * x + eps / k
     ctx.set_output("Out", out)
+
+
+# ---------------------------------------------------------------------------
+# pool3d — reference ``pool_op.cc`` 3-D variant (NCDHW).
+# ---------------------------------------------------------------------------
+
+def _infer_pool3d(op, block):
+    x = block.var(op.input("X")[0])
+    if x.shape is None:
+        raise ShapeInferenceSkip()
+    n, c, d, h, w = x.shape
+    k = list(op.attr("ksize"))
+    s = list(op.attr("strides", [1, 1, 1]))
+    p = list(op.attr("paddings", [0, 0, 0, 0]))[:3] + [0, 0, 0]
+    if op.attr("global_pooling", False):
+        k, s, p = [d, h, w], [1, 1, 1], [0, 0, 0]
+    ceil = op.attr("ceil_mode", False)
+    dims = []
+    for i, size in enumerate((d, h, w)):
+        num = size - k[i] + 2 * p[i]
+        dims.append((num + s[i] - 1) // s[i] + 1 if ceil
+                    else num // s[i] + 1)
+    out = block.var(op.output("Out")[0])
+    out.shape = (n, c) + tuple(dims)
+    out.dtype = x.dtype
+
+
+@register_op("pool3d", infer_shape=_infer_pool3d)
+def pool3d_lower(ctx):
+    x = ctx.input("X")                   # [N, C, D, H, W]
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = list(ctx.attr("ksize"))
+    strides = list(ctx.attr("strides", [1, 1, 1]))
+    paddings = list(ctx.attr("paddings", [0, 0, 0]))
+    if ctx.attr("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3], x.shape[4]]
+        strides = [1, 1, 1]
+        paddings = [0, 0, 0]
+    window = (1, 1) + tuple(ksize)
+    strides5 = (1, 1) + tuple(strides)
+    pad5 = [(0, 0), (0, 0)] + [(p, p) for p in paddings[:3]]
+    if ctx.attr("ceil_mode", False):
+        # extend trailing padding so the last partial window is included
+        # (same recipe as pool2d above)
+        for i, size in enumerate((x.shape[2], x.shape[3], x.shape[4])):
+            k_, s_, p_ = ksize[i], strides[i], paddings[i]
+            out_dim = (size - k_ + 2 * p_ + s_ - 1) // s_ + 1
+            needed = (out_dim - 1) * s_ + k_ - size - p_
+            pad5[2 + i] = (p_, max(needed, p_))
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                    strides5, pad5)
+    else:
+        ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides5,
+                                     pad5)
+        if ctx.attr("exclusive", True):
+            counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
+                                           jax.lax.add, window, strides5,
+                                           pad5)
+            out = ssum / counts
+        else:
+            out = ssum / (ksize[0] * ksize[1] * ksize[2])
+    ctx.set_output("Out", out)
+
+
+# ---------------------------------------------------------------------------
+# unpool — reference ``unpool_op.cc``: max-unpool via the flat indices from
+# max_pool2d_with_index.
+# ---------------------------------------------------------------------------
+
+def _infer_unpool(op, block):
+    x = block.var(op.input("X")[0])
+    if x.shape is None:
+        raise ShapeInferenceSkip()
+    n, c, h, w = x.shape
+    k = list(op.attr("ksize"))
+    s = list(op.attr("strides", [2, 2]))
+    p = list(op.attr("paddings", [0, 0]))
+    oh = (h - 1) * s[0] - 2 * p[0] + k[0]
+    ow = (w - 1) * s[1] - 2 * p[1] + k[1]
+    out = block.var(op.output("Out")[0])
+    out.shape = (n, c, oh, ow)
+    out.dtype = x.dtype
+
+
+@register_op("unpool", infer_shape=_infer_unpool,
+             no_grad_inputs=("Indices",))
+def unpool_lower(ctx):
+    x = ctx.input("X")                   # [N, C, h, w] pooled values
+    indices = ctx.input("Indices")       # [N, C, h, w] flat out positions
+    n, c, h, w = x.shape
+    k = list(ctx.attr("ksize"))
+    s = list(ctx.attr("strides", [2, 2]))
+    p = list(ctx.attr("paddings", [0, 0]))
+    oh = (h - 1) * s[0] - 2 * p[0] + k[0]
+    ow = (w - 1) * s[1] - 2 * p[1] + k[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    ni = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    idx = indices.reshape(n, c, h * w).astype(jnp.int32)
+    flat = flat.at[jnp.broadcast_to(ni, idx.shape).reshape(-1),
+                   jnp.broadcast_to(ci, idx.shape).reshape(-1),
+                   idx.reshape(-1)].add(x.reshape(-1))
+    ctx.set_output("Out", flat.reshape(n, c, oh, ow))
+
+
+# ---------------------------------------------------------------------------
+# spp — reference ``spp_op.h``: spatial pyramid pooling, levels 0..H-1 of
+# 2^l x 2^l adaptive pooling, flattened and concatenated.
+# ---------------------------------------------------------------------------
+
+def _infer_spp(op, block):
+    x = block.var(op.input("X")[0])
+    if x.shape is None:
+        raise ShapeInferenceSkip()
+    n, c = x.shape[0], x.shape[1]
+    ph = op.attr("pyramid_height")
+    feats = sum(c * (2 ** l) * (2 ** l) for l in range(ph))
+    out = block.var(op.output("Out")[0])
+    out.shape = (n, feats)
+    out.dtype = x.dtype
+
+
+@register_op("spp", infer_shape=_infer_spp)
+def spp_lower(ctx):
+    import math as _math
+    x = ctx.input("X")                   # [N, C, H, W]
+    n, c, h, w = x.shape
+    ph = int(ctx.attr("pyramid_height"))
+    ptype = ctx.attr("pooling_type", "max")
+    parts = []
+    for level in range(ph):
+        bins = 2 ** level
+        kh = int(_math.ceil(h / bins))
+        kw = int(_math.ceil(w / bins))
+        pad_h = (kh * bins - h + 1) // 2
+        pad_w = (kw * bins - w + 1) // 2
+        window = (1, 1, kh, kw)
+        strides = (1, 1, kh, kw)
+        pad = [(0, 0), (0, 0), (pad_h, kh * bins - h - pad_h),
+               (pad_w, kw * bins - w - pad_w)]
+        if ptype == "max":
+            o = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                      strides, pad)
+        else:
+            ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
+                                         strides, pad)
+            cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                        window, strides, pad)
+            o = ssum / cnt
+        parts.append(o.reshape(n, -1))
+    ctx.set_output("Out", jnp.concatenate(parts, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# conv_shift — reference ``conv_shift_op.cc``: circular correlation
+# out[i, j] = sum_k x[i, (j + k - M//2) mod N] * y[i, k].
+# ---------------------------------------------------------------------------
+
+def _infer_conv_shift(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape = x.shape
+    out.dtype = x.dtype
+
+
+@register_op("conv_shift", infer_shape=_infer_conv_shift)
+def conv_shift_lower(ctx):
+    x = ctx.input("X")                   # [B, N]
+    y = ctx.input("Y")                   # [B, M], M odd, M <= N
+    n = x.shape[1]
+    m = y.shape[1]
+    half = m // 2
+    out = jnp.zeros_like(x)
+    for k in range(m):
+        out = out + jnp.roll(x, half - k, axis=1) * y[:, k:k + 1]
+    ctx.set_output("Out", out)

@@ -257,3 +257,22 @@ def proximal_adagrad_lower(ctx):
         / (1.0 + lr_t * l2)
     ctx.set_output("ParamOut", new_p)
     ctx.set_output("MomentOut", m_new)
+
+
+@register_op("average_accumulates", infer_shape=_infer_param_out,
+             no_gradient=True, stateful_outputs=("SumOut", "CountOut"))
+def average_accumulates_lower(ctx):
+    """ModelAverage accumulator (reference ``average_accumulates_op.cc``,
+    simplified to a single running sum + count; the reference's 3-tier
+    windowed sums exist to bound memory on CPU swaps, which XLA's on-device
+    state makes unnecessary).  When the window is exceeded the accumulator
+    restarts from the current parameter (max_average_window semantics)."""
+    p = ctx.input("Param")
+    s = ctx.input("Sum")
+    c = ctx.input("Count").reshape(())
+    max_window = ctx.attr("max_average_window", 10000)
+    restart = c >= max_window
+    s_new = jnp.where(restart, p.astype(s.dtype), s + p.astype(s.dtype))
+    c_new = jnp.where(restart, 1.0, c + 1.0)
+    ctx.set_output("SumOut", s_new)
+    ctx.set_output("CountOut", c_new.reshape(1))

@@ -260,3 +260,86 @@ def gru_unit_lower(ctx: LowerContext):
     ctx.set_output("Gate", jnp.concatenate([u, r, cand], axis=-1))
     ctx.set_output("ResetHiddenPrev", reset_h)
     ctx.set_output("Hidden", h)
+
+
+# ---------------------------------------------------------------------------
+# lstmp (layer: dynamic_lstmp) — LSTM with recurrent projection
+# (reference ``lstmp_op.h``: recurrence runs over r_t = proj_act(h_t P))
+# ---------------------------------------------------------------------------
+
+def _infer_lstmp(op, block):
+    x = block.var(op.input("Input")[0])
+    pw = block.var(op.input("ProjWeight")[0])
+    if x.shape is None or pw.shape is None:
+        raise ShapeInferenceSkip()
+    h = pw.shape[0]
+    p = pw.shape[1]
+    proj = block.var(op.output("Projection")[0])
+    proj.shape = (x.shape[0], p)
+    proj.dtype = x.dtype
+    proj.lod_level = x.lod_level
+    cell = block.var(op.output("Cell")[0])
+    cell.shape = (x.shape[0], h)
+    cell.dtype = x.dtype
+    cell.lod_level = x.lod_level
+
+
+@register_op("lstmp", infer_shape=_infer_lstmp)
+def lstmp_lower(ctx: LowerContext):
+    x = ctx.input("Input")              # [N, 4H] pre-projected
+    weight = ctx.input("Weight")        # [P, 4H] recurrent weight over r
+    proj_weight = ctx.input("ProjWeight")  # [H, P]
+    bias = ctx.input("Bias")            # [1, 4H] (+3H peephole)
+    lod = ctx.input_lod("Input")
+    if lod is None:
+        raise ValueError("lstmp op requires LoD on Input")
+    H, P = proj_weight.shape
+    use_peepholes = ctx.attr("use_peepholes", False)
+    is_reverse = ctx.attr("is_reverse", False)
+    act_gate = _ACTS[ctx.attr("gate_activation", "sigmoid")]
+    act_cell = _ACTS[ctx.attr("cell_activation", "tanh")]
+    act_cand = _ACTS[ctx.attr("candidate_activation", "tanh")]
+    act_proj = _ACTS[ctx.attr("proj_activation", "tanh")]
+
+    gather, scatter, lengths, B, T = _lod_pad_tables(lod, is_reverse)
+    xp = jnp.moveaxis(_to_padded(x, gather), 1, 0)   # [T, B, 4H]
+    len_arr = jnp.asarray(lengths)
+
+    gate_bias = bias[:, :4 * H] if bias is not None else 0.0
+    if use_peepholes:
+        w_ic = bias[:, 4 * H:5 * H]
+        w_fc = bias[:, 5 * H:6 * H]
+        w_oc = bias[:, 6 * H:7 * H]
+
+    r_init = jnp.zeros((B, P), x.dtype)
+    c_init = jnp.zeros((B, H), x.dtype)
+
+    def step(carry, x_t):
+        r_prev, c_prev, t = carry
+        gates = x_t + r_prev @ weight + gate_bias
+        g_c, g_i, g_f, g_o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            g_i = g_i + c_prev * w_ic
+            g_f = g_f + c_prev * w_fc
+        i = act_gate(g_i)
+        f = act_gate(g_f)
+        cand = act_cand(g_c)
+        c = f * c_prev + i * cand
+        if use_peepholes:
+            g_o = g_o + c * w_oc
+        o = act_gate(g_o)
+        h = o * act_cell(c)
+        r = act_proj(h @ proj_weight)
+        mask = (t < len_arr).astype(x.dtype)[:, None]
+        r = mask * r + (1 - mask) * r_prev
+        c = mask * c + (1 - mask) * c_prev
+        return (r, c, t + 1), (r, c)
+
+    (_, _, _), (rs, cs) = jax.lax.scan(
+        step, (r_init, c_init, jnp.asarray(0, jnp.int32)), xp)
+    rs = jnp.moveaxis(rs, 0, 1)
+    cs = jnp.moveaxis(cs, 0, 1)
+    ctx.set_output("Projection", _to_flat(rs, scatter, B, T))
+    ctx.set_output("Cell", _to_flat(cs, scatter, B, T))
+    ctx.set_output_lod("Projection", [list(l) for l in lod])
+    ctx.set_output_lod("Cell", [list(l) for l in lod])

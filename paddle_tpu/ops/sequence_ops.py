@@ -327,3 +327,83 @@ def sequence_last_step_lower(ctx: LowerContext):
     lod = _require_lod(ctx)
     splits = np.asarray(lod[_last_level(lod)])
     ctx.set_output("Out", x[jnp.asarray(splits[1:] - 1)])
+
+
+# ---------------------------------------------------------------------------
+# im2sequence — reference ``im2sequence_op.h``: image patches as a LoD
+# sequence per image, rows ordered (oh, ow), features (C, kh, kw) (OCF).
+# ---------------------------------------------------------------------------
+
+def _im2seq_out(size, k, pad0, pad1, stride):
+    return (size + pad0 + pad1 - k) // stride + 1
+
+
+def _infer_im2sequence(op, block):
+    x = block.var(op.input("X")[0])
+    if x.shape is None:
+        raise ShapeInferenceSkip()
+    n, c, h, w = x.shape
+    k = op.attr("kernels")
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0, 0, 0])
+    oh = _im2seq_out(h, k[0], p[0], p[2], s[0])
+    ow = _im2seq_out(w, k[1], p[1], p[3], s[1])
+    out = block.var(op.output("Out")[0])
+    out.shape = (n * oh * ow, c * k[0] * k[1])
+    out.dtype = x.dtype
+    out.lod_level = 1
+
+
+@register_op("im2sequence", infer_shape=_infer_im2sequence)
+def im2sequence_lower(ctx: LowerContext):
+    x = ctx.input("X")                   # [N, C, H, W]
+    k = list(ctx.attr("kernels"))
+    s = list(ctx.attr("strides", [1, 1]))
+    p = list(ctx.attr("paddings", [0, 0, 0, 0]))
+    n, c = x.shape[0], x.shape[1]
+    # conv_general_dilated_patches: feature index = c*kh*kw + i*kw + j
+    # (channel slowest) == the reference's OCF (C, kh, kw) layout
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s,
+        padding=[(p[0], p[2]), (p[1], p[3])])   # [N, C*kh*kw, OH, OW]
+    oh, ow = patches.shape[2], patches.shape[3]
+    out = jnp.moveaxis(patches, 1, 3).reshape(n * oh * ow,
+                                              c * k[0] * k[1])
+    ctx.set_output("Out", out)
+    ctx.set_output_lod("Out", [[i * oh * ow for i in range(n + 1)]])
+
+
+# ---------------------------------------------------------------------------
+# row_conv — reference ``row_conv_op.cc``: per-sequence lookahead
+# convolution out[t] = sum_w filter[w] * x[t + w]  (w < future_context).
+# ---------------------------------------------------------------------------
+
+def _infer_row_conv(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape = x.shape
+    out.dtype = x.dtype
+    out.lod_level = x.lod_level
+
+
+@register_op("row_conv", infer_shape=_infer_row_conv)
+def row_conv_lower(ctx: LowerContext):
+    x = ctx.input("X")                   # [N, D] ragged
+    filt = ctx.input("Filter")           # [future_context, D]
+    lod = _require_lod(ctx, "X")
+    fc = filt.shape[0]
+    splits = lod[-1]
+    outs = []
+    for i in range(len(splits) - 1):
+        lo, hi = int(splits[i]), int(splits[i + 1])
+        seq = jax.lax.slice_in_dim(x, lo, hi, axis=0)   # [T, D]
+        t = hi - lo
+        acc = jnp.zeros_like(seq)
+        for w in range(min(fc, t)):
+            shifted = jnp.concatenate(
+                [seq[w:], jnp.zeros((w, seq.shape[1]), seq.dtype)], axis=0)
+            acc = acc + filt[w][None, :] * shifted
+        outs.append(acc)
+    out = jnp.concatenate(outs, axis=0)
+    ctx.set_output("Out", out)
+    ctx.set_output_lod("Out", [list(l) for l in lod])

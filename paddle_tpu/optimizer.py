@@ -6,6 +6,7 @@ accumulator creation).
 
 from __future__ import annotations
 
+import contextlib
 from collections import defaultdict
 
 from paddle_tpu import framework
@@ -423,15 +424,66 @@ class ModelAverage(Optimizer):
         self.min_average_window = min_average_window
         self.max_average_window = max_average_window
         self.params_grads = []
-        self._sums = {}
-        self._counts = None
+        # append accumulate ops for every parameter of the current main
+        # program (reference appends average_accumulates ops per param)
+        block = framework.default_main_program().global_block()
+        self._avg_names = {}
+        for param in block.all_parameters():
+            self._append_average_accumulate_op(param)
 
-    def apply(self, executor, need_restore=True):  # pragma: no cover
-        raise NotImplementedError(
-            "ModelAverage.apply lands with the aux-subsystem milestone")
+    def _append_average_accumulate_op(self, param):
+        helper = LayerHelper("model_average")
+        sum_acc = helper.create_global_variable(
+            name=param.name + "@SUM_ACC", shape=param.shape,
+            dtype=param.dtype, persistable=True)
+        cnt_acc = helper.create_global_variable(
+            name=param.name + "@CNT_ACC", shape=(1,), dtype="float32",
+            persistable=True)
+        helper.set_variable_initializer(sum_acc, init_mod.Constant(0.0))
+        helper.set_variable_initializer(cnt_acc, init_mod.Constant(0.0))
+        helper.append_op(
+            type="average_accumulates",
+            inputs={"Param": [param], "Sum": [sum_acc], "Count": [cnt_acc]},
+            outputs={"SumOut": [sum_acc], "CountOut": [cnt_acc]},
+            attrs={"max_average_window": self.max_average_window})
+        self._avg_names[param.name] = (sum_acc.name, cnt_acc.name)
 
-    def restore(self, executor):  # pragma: no cover
-        raise NotImplementedError
+    @contextlib.contextmanager
+    def apply(self, executor, need_restore=True):
+        """Swap averaged parameter values in (reference ``optimizer.py:811``
+        ModelAverage.apply context manager)."""
+        import numpy as np
+        from paddle_tpu.scope import global_scope
+        scope = global_scope()
+        backups = {}
+        for pname, (sname, cname) in self._avg_names.items():
+            p = scope.find_var(pname)
+            s = scope.find_var(sname)
+            c = scope.find_var(cname)
+            if p is None or s is None or c is None:
+                continue
+            cnt = float(np.asarray(c).reshape(-1)[0])
+            if cnt <= 0:
+                continue
+            backups[pname] = p
+            scope.set_var(pname, (np.asarray(s) / cnt).astype(
+                np.asarray(p).dtype))
+        self._backups = backups
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        """Swap the pre-average parameter values back in (reference
+        ``optimizer.py`` ModelAverage.restore); used after
+        ``apply(need_restore=False)``."""
+        from paddle_tpu.scope import global_scope
+        scope = global_scope()
+        for pname, val in getattr(self, "_backups", {}).items():
+            scope.set_var(pname, val)
+        self._backups = {}
 
 
 # naming parity with reference: both Foo and FooOptimizer exist

@@ -18,7 +18,8 @@ import time
 import jax
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler",
-           "start_profiler", "stop_profiler"]
+           "start_profiler", "stop_profiler", "enable_op_profiling",
+           "disable_op_profiling", "op_profile_table", "op_profiler"]
 
 _trace_dir = None
 _start_time = None
@@ -32,7 +33,10 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 
 def reset_profiler():
-    pass
+    """Clear collected op-level events (reference ``profiler.py``
+    reset_profiler)."""
+    global _op_events
+    _op_events = {}
 
 
 def start_profiler(state="All", profile_path="/tmp/paddle_tpu_profile"):
@@ -63,3 +67,80 @@ def profiler(state="All", sorted_key=None,
         yield
     finally:
         stop_profiler(sorted_key, profile_path)
+
+
+# ---------------------------------------------------------------------------
+# op-level aggregation table (reference EnableProfiler/DisableProfiler,
+# ``platform/profiler.h:110-115``: sorted per-op-type event tables).
+#
+# On TPU the compiled path fuses ops away, so op-level timing runs the
+# block in the executor's op-by-op interpret mode with a device sync per op
+# — the same overhead FLAGS_benchmark adds on the reference.
+# ---------------------------------------------------------------------------
+
+_op_profiling = False
+_op_events = {}
+
+
+def op_profiling_enabled():
+    return _op_profiling
+
+
+def enable_op_profiling():
+    """Start collecting per-op timings; forces interpret-mode execution."""
+    global _op_profiling, _op_events
+    _op_profiling = True
+    _op_events = {}
+
+
+def disable_op_profiling():
+    global _op_profiling
+    _op_profiling = False
+
+
+@contextlib.contextmanager
+def record_op(op_type, ctx=None):
+    t0 = time.perf_counter()
+    with jax.named_scope(op_type):
+        yield
+    # sync so the interval covers device work (reference implicit Wait)
+    if ctx is not None:
+        for v in ctx.outputs.values():
+            if hasattr(v, "block_until_ready"):
+                try:
+                    v.block_until_ready()
+                except Exception:
+                    pass
+    dt = time.perf_counter() - t0
+    ev = _op_events.setdefault(op_type, [0, 0.0, 0.0])
+    ev[0] += 1
+    ev[1] += dt
+    ev[2] = max(ev[2], dt)
+
+
+def op_profile_table(sorted_key="total"):
+    """Sorted per-op aggregation table as a string (reference
+    ``profiler.h`` PrintProfiler: Event/Calls/Total/Min/Max/Ave)."""
+    keys = {"total": 1, "calls": 0, "max": 2,
+            "ave": lambda item: item[1][1] / max(item[1][0], 1)}
+    k = keys.get(sorted_key or "total", 1)
+    rows = sorted(_op_events.items(),
+                  key=(k if callable(k) else (lambda item, i=k: item[1][i])),
+                  reverse=True)
+    lines = [f"{'Event':<28}{'Calls':>8}{'Total(ms)':>12}"
+             f"{'Ave(ms)':>12}{'Max(ms)':>12}"]
+    for op_type, (calls, total, mx) in rows:
+        lines.append(f"{op_type:<28}{calls:>8}{total * 1e3:>12.3f}"
+                     f"{total / max(calls, 1) * 1e3:>12.3f}{mx * 1e3:>12.3f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def op_profiler(sorted_key="total"):
+    """Context manager: profile per-op and print the table on exit."""
+    enable_op_profiling()
+    try:
+        yield
+    finally:
+        disable_op_profiling()
+        print(op_profile_table(sorted_key))

@@ -1,0 +1,188 @@
+"""Aux-subsystem hardening tests (SURVEY.md §5 / VERDICT item 9):
+check_nan_inf executor mode, chunk_eval + evaluator.py, graphviz dump,
+profiler op table, ModelAverage, 2-process jax.distributed smoke."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+
+
+class TestCheckNanInf:
+    def test_raises_with_var_name(self):
+        x = layers.data(name="x", shape=[2, 2], append_batch_size=False)
+        y = layers.log(x)  # log of negative -> nan
+        prog = fluid.default_main_program()
+        prog.check_nan_inf = True
+        exe = fluid.Executor()
+        with pytest.raises(RuntimeError, match="NaN/Inf"):
+            exe.run(prog, feed={"x": np.full((2, 2), -1.0, "float32")},
+                    fetch_list=[y])
+        # healthy values pass
+        out = exe.run(prog, feed={"x": np.ones((2, 2), "float32")},
+                      fetch_list=[y])
+        assert np.isfinite(np.asarray(out[0])).all()
+
+
+class TestChunkEval:
+    def test_iob_f1(self):
+        # 2 chunk types, IOB: labels 0=B-0 1=I-0 2=B-1 3=I-1 4=O
+        label = np.array([[0], [1], [4], [2], [3], [4]], np.int64)
+        inference = np.array([[0], [1], [4], [2], [4], [4]], np.int64)
+        lod = [[0, 6]]
+        inf = layers.data(name="inf", shape=[6, 1], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        lab = layers.data(name="lab", shape=[6, 1], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        metrics = layers.chunk_eval(input=inf, label=lab,
+                                    chunk_scheme="IOB", num_chunk_types=2)
+        exe = fluid.Executor()
+        prec, rec, f1, ni, nl, nc = exe.run(
+            fluid.default_main_program(),
+            feed={"inf": (inference, lod), "lab": (label, lod)},
+            fetch_list=list(metrics))
+        # label chunks: [0-1]:0, [3-4]:1 ; infer chunks: [0-1]:0, [3-3]:1
+        # correct: [0-1]:0 only
+        assert int(ni[0]) == 2 and int(nl[0]) == 2 and int(nc[0]) == 1
+        np.testing.assert_allclose(prec, [0.5])
+        np.testing.assert_allclose(rec, [0.5])
+        np.testing.assert_allclose(f1, [0.5])
+
+
+class TestChunkEvaluator:
+    def test_streaming(self):
+        inf = layers.data(name="inf", shape=[6, 1], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        lab = layers.data(name="lab", shape=[6, 1], dtype="int64",
+                          append_batch_size=False, lod_level=1)
+        ev = fluid.evaluator.ChunkEvaluator(input=inf, label=lab,
+                                            chunk_scheme="IOB",
+                                            num_chunk_types=2)
+        exe = fluid.Executor()
+        ev.reset(exe)
+        lod = [[0, 6]]
+        label = np.array([[0], [1], [4], [2], [3], [4]], np.int64)
+        inference = np.array([[0], [1], [4], [2], [4], [4]], np.int64)
+        for _ in range(3):  # 3 identical batches accumulate
+            exe.run(fluid.default_main_program(),
+                    feed={"inf": (inference, lod), "lab": (label, lod)},
+                    fetch_list=ev.metrics)
+        prec, rec, f1 = ev.eval(exe)
+        np.testing.assert_allclose(prec, [0.5])
+        np.testing.assert_allclose(f1, [0.5])
+        ev.reset(exe)
+        prec, rec, f1 = ev.eval(exe)
+        np.testing.assert_allclose(f1, [0.0])
+
+
+class TestGraphviz:
+    def test_dot_dump(self, tmp_path):
+        x = layers.data(name="x", shape=[4, 8], append_batch_size=False)
+        h = layers.fc(input=x, size=4, act="relu")
+        loss = layers.reduce_mean(h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        from paddle_tpu import debuger
+        p = str(tmp_path / "g.dot")
+        dot = debuger.draw_block_graphviz(
+            fluid.default_main_program().global_block(), path=p)
+        assert dot.startswith("digraph G {") and dot.rstrip().endswith("}")
+        assert "mul" in dot and "@GRAD" in dot and os.path.exists(p)
+        code = debuger.pprint_program_codes(fluid.default_main_program())
+        assert "mul(" in code and "sgd(" in code
+
+
+class TestOpProfiler:
+    def test_sorted_table(self):
+        x = layers.data(name="x", shape=[8, 16], append_batch_size=False)
+        h = layers.fc(input=x, size=16, act="relu")
+        loss = layers.reduce_mean(h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        fluid.profiler.enable_op_profiling()
+        try:
+            exe.run(fluid.default_main_program(),
+                    feed={"x": np.ones((8, 16), "float32")},
+                    fetch_list=[loss])
+        finally:
+            fluid.profiler.disable_op_profiling()
+        table = fluid.profiler.op_profile_table(sorted_key="total")
+        assert "Event" in table and "mul" in table and "sgd" in table
+        # sorted by total descending
+        rows = table.splitlines()[1:]
+        totals = [float(r.split()[2]) for r in rows]
+        assert totals == sorted(totals, reverse=True)
+        fluid.profiler.reset_profiler()
+        assert "mul" not in fluid.profiler.op_profile_table()
+
+
+class TestModelAverage:
+    def test_apply_restores(self):
+        x = layers.data(name="x", shape=[4, 4], append_batch_size=False)
+        h = layers.fc(input=x, size=1, param_attr="ma_w", bias_attr=False)
+        loss = layers.reduce_mean(h)
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        model_average = fluid.optimizer.ModelAverage(0.15)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        scope = fluid.global_scope()
+        vals = []
+        for _ in range(4):
+            exe.run(fluid.default_main_program(),
+                    feed={"x": np.ones((4, 4), "float32")},
+                    fetch_list=[loss])
+            vals.append(np.asarray(scope.find_var("ma_w")).copy())
+        final = np.asarray(scope.find_var("ma_w")).copy()
+        with model_average.apply(exe):
+            averaged = np.asarray(scope.find_var("ma_w")).copy()
+        restored = np.asarray(scope.find_var("ma_w"))
+        np.testing.assert_allclose(restored, final)
+        np.testing.assert_allclose(averaged, np.mean(vals, axis=0),
+                                   rtol=1e-5)
+
+
+@pytest.mark.timeout(120)
+class TestTwoProcessDistributed:
+    def test_two_process_allgather(self, tmp_path):
+        """2-process jax.distributed cluster on one host (reference spawns
+        pserver processes on localhost, test_recv_op.py); validates
+        init_parallel_env + cross-process collectives over Gloo."""
+        script = textwrap.dedent("""
+            import os, sys
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ.pop("XLA_FLAGS", None)
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            pid = int(sys.argv[1])
+            from paddle_tpu.parallel.distributed import (
+                init_parallel_env, get_rank, get_world_size)
+            init_parallel_env(coordinator_address="127.0.0.1:%d",
+                              num_processes=2, process_id=pid)
+            assert get_world_size() == 2 and get_rank() == pid
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+            x = jnp.ones((2,)) * (pid + 1)
+            g = multihost_utils.process_allgather(x)
+            assert g.shape == (2, 2)
+            assert g.tolist() == [[1.0, 1.0], [2.0, 2.0]], g.tolist()
+            print("WORKER_OK", pid)
+        """) % (39911,)
+        f = tmp_path / "worker.py"
+        f.write_text(script)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        procs = [subprocess.Popen([sys.executable, str(f), str(i)],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, env=env)
+                 for i in range(2)]
+        outs = [p.communicate(timeout=110)[0].decode() for p in procs]
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {i} failed:\n{out}"
+            assert f"WORKER_OK {i}" in out

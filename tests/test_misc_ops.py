@@ -1,0 +1,170 @@
+"""Tests for the formerly-stubbed op set: im2sequence, row_conv,
+dynamic_lstmp, conv_shift, pool3d, unpool, spp, positive_negative_pair
+(mirror reference test_im2sequence_op.py, test_row_conv_op.py,
+test_lstmp_op.py, test_conv_shift_op.py, test_pool3d_op.py,
+test_unpool_op.py, test_spp_op.py, test_positive_negative_pair_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+
+
+def _run(feed, fetch_list, startup=True):
+    exe = fluid.Executor()
+    if startup:
+        exe.run(fluid.default_startup_program())
+    return exe.run(fluid.default_main_program(), feed=feed,
+                   fetch_list=fetch_list)
+
+
+class TestIm2Sequence:
+    def test_patches(self):
+        x = np.arange(1 * 1 * 4 * 4, dtype="float32").reshape(1, 1, 4, 4)
+        xv = layers.data(name="x", shape=[1, 1, 4, 4],
+                         append_batch_size=False)
+        out = layers.im2sequence(xv, filter_size=2, stride=2)
+        (got,) = _run({"x": x}, [out], startup=False)
+        assert got.shape == (4, 4)  # 2x2 grid of 1*2*2 patches
+        np.testing.assert_allclose(got[0], [0, 1, 4, 5])
+        np.testing.assert_allclose(got[3], [10, 11, 14, 15])
+
+
+class TestRowConv:
+    def test_lookahead(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(5, 3).astype("float32")
+        lod = [[0, 3, 5]]
+        xv = layers.data(name="x", shape=[5, 3], append_batch_size=False,
+                         lod_level=1)
+        out = layers.row_conv(xv, future_context_size=1,
+                              param_attr="rc_w")
+        (got,) = _run({"x": (x, lod)}, [out])
+        w = np.asarray(fluid.global_scope().find_var("rc_w"))
+        expect = np.zeros_like(x)
+        for lo, hi in ((0, 3), (3, 5)):
+            for t in range(lo, hi):
+                for fw in range(2):
+                    if t + fw < hi:
+                        expect[t] += w[fw] * x[t + fw]
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+class TestDynamicLSTMP:
+    def test_shapes_and_training(self):
+        rng = np.random.RandomState(1)
+        H, P = 4, 3
+        x = rng.rand(6, 4 * H).astype("float32")
+        lod = [[0, 4, 6]]
+        xv = layers.data(name="x", shape=[6, 4 * H],
+                         append_batch_size=False, lod_level=1)
+        xv.stop_gradient = False
+        proj, cell = layers.dynamic_lstmp(xv, size=4 * H, proj_size=P)
+        loss = layers.reduce_mean(proj)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        losses = []
+        for _ in range(5):
+            p, c, lv = exe.run(fluid.default_main_program(),
+                               feed={"x": (x, lod)},
+                               fetch_list=[proj, cell, loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        assert p.shape == (6, P) and c.shape == (6, H)
+        assert np.isfinite(losses).all()
+        assert losses[-1] != losses[0]  # training moves the params
+
+
+class TestConvShift:
+    def test_circular(self):
+        x = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+        y = np.array([[1.0, 0.0, 2.0]], np.float32)
+        xv = layers.data(name="x", shape=[1, 4], append_batch_size=False)
+        yv = layers.data(name="y", shape=[1, 3], append_batch_size=False)
+        out = layers.conv_shift(xv, yv)
+        (got,) = _run({"x": x, "y": y}, [out], startup=False)
+        n, m = 4, 3
+        expect = np.zeros((1, n), np.float32)
+        for j in range(n):
+            for k in range(m):
+                expect[0, j] += x[0, (j + k - m // 2) % n] * y[0, k]
+        np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+class TestPool3d:
+    def test_max_avg(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(1, 2, 4, 4, 4).astype("float32")
+        xv = layers.data(name="x", shape=[1, 2, 4, 4, 4],
+                         append_batch_size=False)
+        mx = layers.pool3d(xv, pool_size=2, pool_type="max", pool_stride=2)
+        av = layers.pool3d(xv, pool_size=2, pool_type="avg", pool_stride=2)
+        got_m, got_a = _run({"x": x}, [mx, av], startup=False)
+        assert got_m.shape == (1, 2, 2, 2, 2)
+        blk = x[0, 0, :2, :2, :2]
+        np.testing.assert_allclose(got_m[0, 0, 0, 0, 0], blk.max(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(got_a[0, 0, 0, 0, 0], blk.mean(),
+                                   rtol=1e-6)
+
+
+class TestUnpool:
+    def test_roundtrip_with_pool_indices(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(1, 1, 4, 4).astype("float32")
+        xv = layers.data(name="x", shape=[1, 1, 4, 4],
+                         append_batch_size=False)
+        pooled, indices = layers.pool2d_with_index(xv, pool_size=2,
+                                                   pool_stride=2)
+        restored = layers.unpool(pooled, indices, unpool_size=2,
+                                 unpool_stride=2)
+        got_p, got_r = _run({"x": x}, [pooled, restored], startup=False)
+        assert got_r.shape == (1, 1, 4, 4)
+        # each max value returns to its original position, rest zeros
+        assert np.count_nonzero(got_r) == 4
+        for i in range(2):
+            for j in range(2):
+                blk = x[0, 0, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                pos = np.unravel_index(blk.argmax(), blk.shape)
+                np.testing.assert_allclose(
+                    got_r[0, 0, 2 * i + pos[0], 2 * j + pos[1]], blk.max())
+
+
+class TestSPP:
+    def test_feature_sizes(self):
+        rng = np.random.RandomState(4)
+        x = rng.rand(2, 3, 7, 5).astype("float32")
+        xv = layers.data(name="x", shape=[2, 3, 7, 5],
+                         append_batch_size=False)
+        out = layers.spp(xv, pyramid_height=3)
+        (got,) = _run({"x": x}, [out], startup=False)
+        assert got.shape == (2, 3 * (1 + 4 + 16))
+        # level 0 = global max per channel
+        np.testing.assert_allclose(got[:, :3],
+                                   x.max(axis=(2, 3)), rtol=1e-6)
+
+
+class TestPositiveNegativePair:
+    def test_pairs(self):
+        score = np.array([[0.9], [0.2], [0.5], [0.4]], np.float32)
+        label = np.array([[1.0], [0.0], [1.0], [0.0]], np.float32)
+        qid = np.array([[0], [0], [1], [1]], np.int64)
+        sv = layers.data(name="s", shape=[4, 1], append_batch_size=False)
+        lv = layers.data(name="l", shape=[4, 1], append_batch_size=False)
+        qv = layers.data(name="q", shape=[4, 1], append_batch_size=False,
+                         dtype="int64")
+        helper = fluid.layer_helper.LayerHelper("positive_negative_pair")
+        pos = helper.create_tmp_variable("float32")
+        neg = helper.create_tmp_variable("float32")
+        neu = helper.create_tmp_variable("float32")
+        helper.append_op(
+            type="positive_negative_pair",
+            inputs={"Score": sv, "Label": lv, "QueryID": qv},
+            outputs={"PositivePair": pos, "NegativePair": neg,
+                     "NeutralPair": neu})
+        got = _run({"s": score, "l": label, "q": qid}, [pos, neg, neu],
+                   startup=False)
+        # q0: (0.9 vs 0.2) correct; q1: (0.5 vs 0.4) correct
+        np.testing.assert_allclose(np.asarray(got[0]).reshape(-1), [2.0])
+        np.testing.assert_allclose(np.asarray(got[1]).reshape(-1), [0.0])
