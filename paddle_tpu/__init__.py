@@ -22,6 +22,8 @@ from paddle_tpu.ops.reader_ops import EOFException
 from paddle_tpu import memory_optimization_transpiler
 from paddle_tpu.memory_optimization_transpiler import (memory_optimize,
                                                        release_memory)
+from paddle_tpu import v2
+from paddle_tpu import pydataprovider2
 from paddle_tpu import concurrency
 from paddle_tpu.concurrency import (Go, Select, make_channel, channel_send,
                                     channel_recv, channel_close)

@@ -1,0 +1,7 @@
+"""``python -m paddle_tpu`` — see paddle_tpu/cli.py."""
+
+import sys
+
+from paddle_tpu.cli import main
+
+sys.exit(main())

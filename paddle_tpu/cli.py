@@ -1,0 +1,171 @@
+"""Command-line entry points.
+
+Reference L6 surface: the ``paddle_trainer`` CLI
+(``paddle/trainer/TrainerMain.cpp:32``), the ``paddle`` shell wrapper
+(``paddle/scripts/submit_local.sh.in``), the Go master binary
+(``go/cmd/master/master.go``), and the cluster launcher
+(``paddle/scripts/cluster_train/paddle.py``).
+
+Usage: ``python -m paddle_tpu <command> ...``
+
+  train   --config SCRIPT [--num-passes N]   run a training script
+  infer   --model DIR --feed name=path.npy   load + run an inference model
+  master  --files GLOB --port P              serve the task-dispatch master
+  launch  --nproc N SCRIPT [args...]         spawn an N-process cluster on
+                                             this host (jax.distributed)
+  version
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import runpy
+import subprocess
+import sys
+
+__all__ = ["main"]
+
+VERSION = "0.2.0"
+
+
+def _cmd_version(args):
+    import jax
+    try:
+        backend = jax.default_backend()
+    except Exception as e:  # backend init can fail off-accelerator hosts
+        backend = f"unavailable ({type(e).__name__})"
+    print(f"paddle_tpu {VERSION} (jax {jax.__version__}, "
+          f"backend {backend})")
+    return 0
+
+
+def _cmd_train(args):
+    """Run a training script — the ``paddle_trainer --config`` analog.
+    The script sees PADDLE_NUM_PASSES etc. like the reference's gflags."""
+    if args.num_passes is not None:
+        os.environ["PADDLE_NUM_PASSES"] = str(args.num_passes)
+    if args.use_tpu is not None:
+        os.environ["PADDLE_TPU_USE_TPU"] = str(int(args.use_tpu))
+    sys.argv = [args.config] + (args.script_args or [])
+    runpy.run_path(args.config, run_name="__main__")
+    return 0
+
+
+def _cmd_infer(args):
+    """Load a saved inference model and run it on .npy feeds
+    (the C++ ``inference::Load`` + run flow, ``inference/io.h:35``)."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    exe = fluid.Executor()
+    program, feed_names, fetch_targets = \
+        fluid.io.load_inference_model(args.model, exe)
+    feed = {}
+    for spec in args.feed or []:
+        name, path = spec.split("=", 1)
+        feed[name] = np.load(path)
+    missing = [n for n in feed_names if n not in feed]
+    if missing:
+        print(f"missing feeds: {missing}; expected {feed_names}",
+              file=sys.stderr)
+        return 2
+    outs = exe.run(program, feed=feed, fetch_list=fetch_targets)
+    for target, value in zip(fetch_targets, outs):
+        name = target.name if hasattr(target, "name") else str(target)
+        arr = np.asarray(value)
+        print(f"{name}: shape={arr.shape}")
+        if args.output:
+            np.save(os.path.join(args.output, f"{name}.npy"), arr)
+    return 0
+
+
+def _cmd_master(args):
+    """Serve the fault-tolerant task master (go master binary analog)."""
+    from paddle_tpu.parallel.master import (MasterServer, MasterService,
+                                            partition_files)
+    files = sorted(glob.glob(args.files))
+    if not files:
+        print(f"no files match {args.files!r}", file=sys.stderr)
+        return 2
+    tasks = partition_files(files, args.chunks_per_task)
+    service = MasterService(tasks, timeout=args.timeout,
+                            failure_max=args.failure_max,
+                            snapshot_path=args.snapshot)
+    server = MasterServer(service, host=args.host, port=args.port)
+    print(f"master serving {len(tasks)} tasks on "
+          f"{server.addr[0]}:{server.addr[1]}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _cmd_launch(args):
+    """Spawn an N-process jax.distributed cluster on this host (the
+    cluster_train launcher analog; each process gets the reference's
+    TRAINER_ID / TRAINERS env convention)."""
+    port = args.port
+    procs = []
+    for rank in range(args.nproc):
+        env = dict(os.environ)
+        env["PADDLE_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TRAINERS"] = str(args.nproc)
+        procs.append(subprocess.Popen(
+            [sys.executable, args.script] + (args.script_args or []),
+            env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="paddle_tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("version", help="print version info")
+    p.set_defaults(fn=_cmd_version)
+
+    p = sub.add_parser("train", help="run a training script")
+    p.add_argument("--config", required=True, help="python training script")
+    p.add_argument("--num-passes", type=int, default=None)
+    p.add_argument("--use-tpu", type=int, default=None)
+    p.add_argument("script_args", nargs="*")
+    p.set_defaults(fn=_cmd_train)
+
+    p = sub.add_parser("infer", help="run a saved inference model")
+    p.add_argument("--model", required=True, help="save_inference_model dir")
+    p.add_argument("--feed", action="append",
+                   help="name=path.npy (repeatable)")
+    p.add_argument("--output", default=None, help="dir for output .npy")
+    p.set_defaults(fn=_cmd_infer)
+
+    p = sub.add_parser("master", help="serve the data-task master")
+    p.add_argument("--files", required=True, help="glob of input files")
+    p.add_argument("--chunks-per-task", type=int, default=1)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8037)
+    p.add_argument("--timeout", type=float, default=60.0)
+    p.add_argument("--failure-max", type=int, default=3)
+    p.add_argument("--snapshot", default=None,
+                   help="snapshot file for restart recovery")
+    p.set_defaults(fn=_cmd_master)
+
+    p = sub.add_parser("launch", help="spawn a local N-process cluster")
+    p.add_argument("--nproc", type=int, required=True)
+    p.add_argument("--port", type=int, default=8357)
+    p.add_argument("script")
+    p.add_argument("script_args", nargs="*")
+    p.set_defaults(fn=_cmd_launch)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

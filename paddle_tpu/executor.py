@@ -266,6 +266,7 @@ class Executor:
                     isinstance(value[1], (list, tuple)):
                 value, lod = value
             dtype = var.dtype if var is not None else None
+            _enforce_feed(name, value, var)
             feed_arrays[name] = _as_device_array(value, dtype, device)
             # a dense feed must also CLEAR any stale lod from a previous
             # ragged feed of the same variable
@@ -550,7 +551,10 @@ class Executor:
 
         training = not program._is_inference
         from paddle_tpu import profiler as _profiler
-        interpret = _has_host_ops(block) or _profiler.op_profiling_enabled()
+        interpret = _has_host_ops(block)
+        if interpret:
+            _warn_host_op_cliff(program, block)
+        interpret = interpret or _profiler.op_profiling_enabled()
 
         lod_map = {n: [list(level) for level in scope.find_lod(n)]
                    for n in feed_arrays
@@ -641,6 +645,28 @@ class Executor:
         self._cache.clear()
 
 
+def _enforce_feed(name, value, var):
+    """PADDLE_ENFORCE-style feed validation (reference ``enforce.h`` +
+    runtime InferShape): catch shape/rank mismatches at the feed boundary
+    with a named message instead of a deep XLA trace error."""
+    if var is None or var.shape is None:
+        return
+    shape = np.shape(value)
+    want = tuple(var.shape)
+    if len(shape) != len(want):
+        raise ValueError(
+            f"feed variable {name!r}: expected rank {len(want)} "
+            f"(shape {want}), got rank {len(shape)} (shape {shape})")
+    ragged = getattr(var, "lod_level", 0) or 0
+    for i, (got_d, want_d) in enumerate(zip(shape, want)):
+        if i == 0 and ragged:
+            continue  # LoD feeds have data-dependent row counts
+        if want_d is not None and want_d >= 0 and got_d != want_d:
+            raise ValueError(
+                f"feed variable {name!r}: expected shape {want} "
+                f"(-1 = any), got {shape}")
+
+
 def _check_nan_inf_enabled(program):
     """check_nan_inf executor mode (reference FLAGS_check_nan_inf,
     ``executor.cc:28,352`` CheckTensorNANOrInf): per-program flag or the
@@ -685,6 +711,39 @@ def _amp_enabled(program):
     import os
     return os.environ.get("PADDLE_TPU_AMP", "0").strip().lower() \
         not in ("0", "", "false", "off", "no")
+
+
+_WARNED_HOST_OP_BLOCKS = set()
+
+
+def _warn_host_op_cliff(program, block):
+    """One host op anywhere switches the WHOLE block to op-by-op eager
+    execution — warn once per (program, block) naming the culprits so a
+    user adding e.g. edit_distance to a training graph learns why the
+    step got slow (VERDICT r1 'host-op cliff')."""
+    key = (id(program), block.idx)
+    if key in _WARNED_HOST_OP_BLOCKS:
+        return
+    _WARNED_HOST_OP_BLOCKS.add(key)
+    culprits = []
+
+    def scan(blk):
+        for op in blk.ops:
+            opdef = registry.lookup(op.type)
+            if opdef is not None and opdef.host:
+                culprits.append(op.type)
+            for a in op.attrs.values():
+                if isinstance(a, framework.Block):
+                    scan(a)
+
+    scan(block)
+    import warnings
+    warnings.warn(
+        f"block {block.idx} contains host op(s) "
+        f"{sorted(set(culprits))} — the whole block runs op-by-op eager "
+        f"instead of one compiled XLA computation; keep host ops "
+        f"(metrics/decoding) in a separate program to keep training "
+        f"compiled", stacklevel=3)
 
 
 def _has_host_ops(block):

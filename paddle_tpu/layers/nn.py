@@ -30,7 +30,7 @@ __all__ = [
     "conv_shift", "pool3d", "unpool", "spp", "pool2d_with_index",
     "fused_attention",
     "autoincreased_step_counter", "cos_sim", "dot_product_attention",
-    "beam_search", "beam_search_decode",
+    "beam_search", "beam_search_decode", "ring_attention",
 ]
 
 
@@ -965,6 +965,21 @@ def shape(input, name=None):
     out = helper.create_tmp_variable("int64")
     helper.append_op(type="shape", inputs={"Input": [input]},
                      outputs={"Out": [out]})
+    return out
+
+
+def ring_attention(q, k, v, causal=False, scale=None, seq_axis="seq",
+                   name=None):
+    """Sequence-parallel exact attention over [B, H, S, D] with S sharded
+    over the mesh's ``seq_axis`` (ops/attention_ops.py ring_attention;
+    single-device fallback when no sequence axis is populated)."""
+    helper = LayerHelper("ring_attention", name=name)
+    out = helper.create_tmp_variable(q.dtype)
+    helper.append_op(type="ring_attention",
+                     inputs={"Q": [q], "K": [k], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"causal": causal, "scale": scale,
+                            "seq_axis": seq_axis})
     return out
 
 

@@ -194,9 +194,19 @@ def memory_optimize(input_program=None, print_log=False, level=0):
         curve = cfg.byte_curve()
         if curve:
             peak += max(curve)
-            saved = sum(_var_bytes(cfg._safe_var(old))
-                        for _, old in pairs)
-            peak_reuse += max(max(curve) - saved, 0)
+            # with-reuse curve: a var that claims a dead buffer costs no
+            # new allocation WHILE LIVE, so subtract its bytes from every
+            # live set containing it (its donor is dead there, and donor
+            # chains are never co-live), then take the new peak
+            reused = {new for new, _ in pairs}
+            curve_reuse = []
+            for i in range(len(cfg.ops)):
+                live = cfg.live_out[i] | cfg.defs[i]
+                saved = sum(_var_bytes(cfg._safe_var(nm))
+                            for nm in live
+                            if nm in reused and cfg._optimizable(nm))
+                curve_reuse.append(max(curve[i] - saved, 0))
+            peak_reuse += max(curve_reuse)
     plan.peak_bytes = peak
     plan.peak_bytes_with_reuse = peak_reuse
 

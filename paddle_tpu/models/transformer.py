@@ -130,8 +130,15 @@ def multi_head_attention(queries, keys, values, d_key, d_value, d_model,
     import os
     flash_min_s = int(os.environ.get("PADDLE_TPU_FLASH_MIN_S", "2048"))
     use_flash = use_flash and (k.shape[2] >= flash_min_s)
+    # sequence/context parallelism: shard S over the mesh 'seq' axis and
+    # attend with the ppermute ring (parallel/ring_attention.py); only for
+    # self-attention (q and k share the sequence sharding)
+    seq_parallel = os.environ.get("PADDLE_TPU_SEQ_PARALLEL", "0") not in \
+        ("0", "", "false") and keys is queries and k_mask is None
 
-    if use_flash and not dropout_rate:
+    if seq_parallel and not dropout_rate:
+        ctx = layers.ring_attention(q, k, v, causal=causal, scale=scale)
+    elif use_flash and not dropout_rate:
         ctx = layers.fused_attention(q, k, v, k_mask=k_mask, causal=causal,
                                      scale=scale)
     else:

@@ -510,3 +510,41 @@ def sdpa_lower(ctx: LowerContext):
             return
     ctx.set_output("Out", _reference_attention(q, k, v, k_mask, causal,
                                                scale))
+
+
+# ---------------------------------------------------------------------------
+# ring_attention IR op — sequence/context parallelism (SURVEY.md §2.8:
+# the reference has none; this supersedes its LoD-ragged long-sequence
+# story).  Falls back to single-device attention when the executor's mesh
+# has no populated sequence axis, so the same program runs anywhere.
+# ---------------------------------------------------------------------------
+
+def _infer_ring_attn(op, block):
+    q = block.var(op.input("Q")[0])
+    v = block.var(op.input("V")[0])
+    out = block.var(op.output("Out")[0])
+    if q.shape is None or v.shape is None:
+        raise ShapeInferenceSkip()
+    out.shape = tuple(q.shape[:-1]) + (v.shape[-1],)
+    out.dtype = q.dtype
+
+
+@register_op("ring_attention", infer_shape=_infer_ring_attn)
+def ring_attention_lower(ctx):
+    from paddle_tpu.parallel.ring_attention import ring_attention
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
+    causal = ctx.attr("causal", False)
+    scale = ctx.attr("scale", None)
+    seq_axis = ctx.attr("seq_axis", "seq")
+    mesh = ctx.aux.get("mesh")
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape)) \
+        if mesh is not None else {}
+    if axis_sizes.get(seq_axis, 1) > 1 and \
+            q.shape[2] % axis_sizes[seq_axis] == 0:
+        out = ring_attention(q, k, v, mesh, axis=seq_axis, causal=causal,
+                             scale=scale)
+    else:
+        out = _reference_attention(q, k, v, None, causal,
+                                   scale if scale is not None
+                                   else float(q.shape[-1]) ** -0.5)
+    ctx.set_output("Out", out)

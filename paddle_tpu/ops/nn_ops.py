@@ -577,33 +577,38 @@ def _infer_spp(op, block):
     out.dtype = x.dtype
 
 
+def _adaptive_pool_axis(x, axis, bins, ptype):
+    """Adaptive pooling along one axis: bin i covers
+    [floor(i*size/bins), ceil((i+1)*size/bins)) — never empty (the
+    reference's spp bin boundaries; fixed-window padding can produce
+    all-padding windows when bins doesn't divide the size)."""
+    size = x.shape[axis]
+    rows = jnp.arange(size)
+    starts = np.floor(np.arange(bins) * size / bins).astype(int)
+    ends = np.ceil((np.arange(bins) + 1) * size / bins).astype(int)
+    mask = (rows[None, :] >= starts[:, None]) & \
+        (rows[None, :] < ends[:, None])                 # [bins, size]
+    xm = jnp.moveaxis(x, axis, -1)                      # [..., size]
+    if ptype == "max":
+        vals = jnp.where(mask, xm[..., None, :], -jnp.inf)  # [...,bins,size]
+        out = jnp.max(vals, axis=-1)
+    else:
+        vals = jnp.where(mask, xm[..., None, :], 0.0)
+        out = jnp.sum(vals, axis=-1) / jnp.sum(mask, axis=-1)
+    return jnp.moveaxis(out, -1, axis)
+
+
 @register_op("spp", infer_shape=_infer_spp)
 def spp_lower(ctx):
-    import math as _math
     x = ctx.input("X")                   # [N, C, H, W]
-    n, c, h, w = x.shape
+    n = x.shape[0]
     ph = int(ctx.attr("pyramid_height"))
     ptype = ctx.attr("pooling_type", "max")
     parts = []
     for level in range(ph):
         bins = 2 ** level
-        kh = int(_math.ceil(h / bins))
-        kw = int(_math.ceil(w / bins))
-        pad_h = (kh * bins - h + 1) // 2
-        pad_w = (kw * bins - w + 1) // 2
-        window = (1, 1, kh, kw)
-        strides = (1, 1, kh, kw)
-        pad = [(0, 0), (0, 0), (pad_h, kh * bins - h - pad_h),
-               (pad_w, kw * bins - w - pad_w)]
-        if ptype == "max":
-            o = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
-                                      strides, pad)
-        else:
-            ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
-                                         strides, pad)
-            cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
-                                        window, strides, pad)
-            o = ssum / cnt
+        o = _adaptive_pool_axis(x, 2, bins, ptype)
+        o = _adaptive_pool_axis(o, 3, bins, ptype)
         parts.append(o.reshape(n, -1))
     ctx.set_output("Out", jnp.concatenate(parts, axis=1))
 

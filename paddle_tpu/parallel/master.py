@@ -20,7 +20,8 @@ import os
 import threading
 import time
 
-__all__ = ["Task", "MasterService", "partition_files"]
+__all__ = ["Task", "MasterService", "partition_files",
+           "MasterServer", "MasterClient"]
 
 DEFAULT_TIMEOUT = 60.0
 DEFAULT_FAILURE_MAX = 3
@@ -166,3 +167,110 @@ class MasterService:
         self.pending = {}
         self.done = [Task.from_dict(d) for d in state["done"]]
         self.failed_drop = [Task.from_dict(d) for d in state["dropped"]]
+
+
+# ---------------------------------------------------------------------------
+# network layer: the go/cmd/master binary + trainer-side client analog
+# (reference ``go/cmd/master/master.go`` serving Go net/rpc;
+# ``python/paddle/v2/master/client.py`` ctypes client).  JSON-lines over
+# TCP: {"method": ..., "params": {...}} -> {"result": ...}.
+# ---------------------------------------------------------------------------
+
+import socket
+import socketserver
+
+
+class _MasterRPCHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        svc = self.server.service
+        for line in self.rfile:
+            try:
+                req = json.loads(line)
+                method = req.get("method")
+                params = req.get("params") or {}
+                if method == "get_task":
+                    t = svc.get_task()
+                    result = t.to_dict() if t is not None else None
+                elif method == "task_finished":
+                    result = svc.task_finished(params["task_id"],
+                                               params.get("epoch"))
+                elif method == "task_failed":
+                    result = svc.task_failed(params["task_id"],
+                                             params.get("epoch"))
+                elif method == "all_done":
+                    result = svc.all_done()
+                elif method == "stats":
+                    result = svc.stats()
+                elif method == "ping":
+                    result = "pong"
+                else:
+                    raise ValueError(f"unknown method {method!r}")
+                resp = {"result": result}
+            except Exception as e:  # surface errors to the client
+                resp = {"error": str(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class MasterServer:
+    """Serve a MasterService over TCP (go master binary analog)."""
+
+    def __init__(self, service, host="127.0.0.1", port=0):
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Srv((host, port), _MasterRPCHandler)
+        self._server.service = service
+        self.addr = self._server.server_address
+
+    def serve_forever(self):
+        self._server.serve_forever()
+
+    def start_background(self):
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class MasterClient:
+    """Trainer-side client (reference ``go/pserver/client`` C ABI +
+    ``python/paddle/v2/master/client.py``)."""
+
+    def __init__(self, addr, timeout=30.0):
+        host, port = addr if isinstance(addr, tuple) else \
+            (addr.rsplit(":", 1)[0], int(addr.rsplit(":", 1)[1]))
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._rfile = self._sock.makefile("r")
+
+    def _call(self, method, **params):
+        msg = json.dumps({"method": method, "params": params}) + "\n"
+        self._sock.sendall(msg.encode())
+        resp = json.loads(self._rfile.readline())
+        if "error" in resp:
+            raise RuntimeError(f"master: {resp['error']}")
+        return resp["result"]
+
+    def get_task(self):
+        d = self._call("get_task")
+        return Task.from_dict(d) if d is not None else None
+
+    def task_finished(self, task_id, epoch=None):
+        return self._call("task_finished", task_id=task_id, epoch=epoch)
+
+    def task_failed(self, task_id, epoch=None):
+        return self._call("task_failed", task_id=task_id, epoch=epoch)
+
+    def all_done(self):
+        return self._call("all_done")
+
+    def stats(self):
+        return self._call("stats")
+
+    def close(self):
+        self._sock.close()

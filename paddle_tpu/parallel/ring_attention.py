@@ -62,7 +62,9 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", causal=False,
         l0 = jnp.zeros((B, H, Sq, 1), q_l.dtype)
         acc0 = jnp.zeros((B, H, Sq, Dv), q_l.dtype)
 
-        def body(step, carry):
+        def body(carry, step):
+            # lax.scan (not fori_loop/while) so jax.vjp can differentiate
+            # the ring — training runs through this path
             m, l, acc, k_cur, v_cur = carry
             # the shard we hold at ``step`` originated at device idx-step
             src = (idx - step) % p
@@ -80,10 +82,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", causal=False,
             perm = [(j, (j + 1) % p) for j in range(p)]
             k_next = jax.lax.ppermute(k_cur, axis, perm)
             v_next = jax.lax.ppermute(v_cur, axis, perm)
-            return new_m, l_new, acc_new, k_next, v_next
+            return (new_m, l_new, acc_new, k_next, v_next), None
 
-        m, l, acc, _, _ = jax.lax.fori_loop(
-            0, p, body, (m0, l0, acc0, k_l, v_l))
+        (m, l, acc, _, _), _ = jax.lax.scan(
+            body, (m0, l0, acc0, k_l, v_l), jnp.arange(p))
         # rows with no unmasked keys (fully-causal top rows never happen
         # since diagonal always visible) — safe divide
         return acc / jnp.maximum(l, 1e-30)

@@ -1,0 +1,211 @@
+"""PyDataProvider2: the v2 ``@provider`` data protocol.
+
+Reference: ``python/paddle/trainer/PyDataProvider2.py:365`` — a decorated
+generator yields samples whose slots are declared by ``input_types``; the
+legacy C++ DataProvider (``gserver/dataproviders/PyDataProvider2.cpp``)
+embedded CPython to drain it.  Here the decorated provider converts
+directly into a plain reader (``paddle_tpu.reader`` composes the rest),
+with the same input-type declarations and per-slot value checking.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = [
+    "provider", "dense_vector", "dense_vector_sequence", "sparse_binary_vector",
+    "sparse_binary_vector_sequence", "sparse_float_vector",
+    "sparse_float_vector_sequence", "integer_value", "integer_value_sequence",
+    "SequenceType", "DataType", "CacheType", "InputType",
+]
+
+
+class SequenceType:
+    NO_SEQUENCE = 0
+    SEQUENCE = 1
+    SUB_SEQUENCE = 2
+
+
+class DataType:
+    Dense = 0
+    SparseNonValue = 1
+    SparseValue = 2
+    Index = 3
+
+
+class CacheType:
+    NO_CACHE = 0
+    CACHE_PASS_IN_MEM = 1
+
+
+class InputType:
+    """Declares one slot: dimension, sequence nesting, and data type
+    (reference ``PyDataProvider2.py:63``)."""
+
+    __slots__ = ("dim", "seq_type", "type")
+
+    def __init__(self, dim, seq_type, tp):
+        self.dim = dim
+        self.seq_type = seq_type
+        self.type = tp
+
+    def __repr__(self):
+        return (f"InputType(dim={self.dim}, seq_type={self.seq_type}, "
+                f"type={self.type})")
+
+    def convert(self, value):
+        """Check + convert one slot value to numpy (dense realization:
+        sparse slots become dense vectors — the TPU build's SelectedRows
+        path begins at the embedding layer, not the feed)."""
+        if self.type == DataType.Index:
+            if self.seq_type == SequenceType.NO_SEQUENCE:
+                v = int(value)
+                if not 0 <= v < self.dim:
+                    raise ValueError(
+                        f"index {v} out of range [0, {self.dim})")
+                return np.asarray([v], dtype="int64")
+            return np.asarray(value, dtype="int64").reshape(-1, 1)
+        if self.type == DataType.Dense:
+            arr = np.asarray(value, dtype="float32")
+            if arr.shape[-1] != self.dim:
+                raise ValueError(
+                    f"dense slot expects dim {self.dim}, got {arr.shape}")
+            return arr
+        # sparse slots: list of ids or (id, value) pairs -> dense vector
+        def densify(ids):
+            out = np.zeros(self.dim, dtype="float32")
+            if self.type == DataType.SparseNonValue:
+                out[np.asarray(ids, dtype="int64")] = 1.0
+            else:
+                for i, v in ids:
+                    out[int(i)] = float(v)
+            return out
+
+        if self.seq_type == SequenceType.NO_SEQUENCE:
+            return densify(value)
+        return np.stack([densify(v) for v in value])
+
+
+def dense_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.Dense)
+
+
+def sparse_binary_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.SparseNonValue)
+
+
+def sparse_float_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.SparseValue)
+
+
+def integer_value(value_range, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(value_range, seq_type, DataType.Index)
+
+
+def dense_vector_sequence(dim):
+    return dense_vector(dim, SequenceType.SEQUENCE)
+
+
+def sparse_binary_vector_sequence(dim):
+    return sparse_binary_vector(dim, SequenceType.SEQUENCE)
+
+
+def sparse_float_vector_sequence(dim):
+    return sparse_float_vector(dim, SequenceType.SEQUENCE)
+
+
+def integer_value_sequence(value_range):
+    return integer_value(value_range, SequenceType.SEQUENCE)
+
+
+class DataProvider:
+    """The decorated provider object: iterate files to samples, or turn
+    into a plain reader for ``paddle.batch``/``trainer.train``."""
+
+    def __init__(self, generator, input_types, init_hook=None,
+                 cache=CacheType.NO_CACHE, should_shuffle=None,
+                 check=False, **kwargs):
+        self.generator = generator
+        self.input_types = input_types
+        self.init_hook = init_hook
+        self.cache = cache
+        self.check = check
+        self.kwargs = kwargs
+        self._cache_store = None
+        functools.update_wrapper(self, generator)
+
+    def _ordered_types(self):
+        if isinstance(self.input_types, dict):
+            return list(self.input_types.items())
+        return [(i, t) for i, t in enumerate(self.input_types)]
+
+    def _convert(self, sample):
+        items = self._ordered_types()
+        if isinstance(sample, dict):
+            values = [sample[k] for k, _ in items]
+        elif isinstance(sample, (list, tuple)) and len(items) > 1:
+            values = list(sample)
+        else:
+            values = [sample]
+        if len(values) != len(items):
+            raise ValueError(
+                f"provider yielded {len(values)} slots, expected "
+                f"{len(items)}")
+        if self.check:
+            return tuple(t.convert(v) for (_, t), v in zip(items, values))
+        return tuple(values)
+
+    def __call__(self, obj=None, filename=None):
+        """Drain one file (reference protocol: process(settings, filename));
+        returns a generator of converted samples."""
+
+        class _Settings:
+            pass
+
+        settings = _Settings()
+        settings.input_types = self.input_types
+        if self.init_hook is not None:
+            self.init_hook(settings, filename=filename, **self.kwargs)
+        for sample in self.generator(settings, filename):
+            yield self._convert(sample)
+
+    def as_reader(self, filenames):
+        """Plain reader over a list of files, honoring CACHE_PASS_IN_MEM
+        (reference CacheType semantics: first pass reads, later passes
+        serve from memory)."""
+        if isinstance(filenames, str):
+            filenames = [filenames]
+
+        def reader():
+            if self.cache == CacheType.CACHE_PASS_IN_MEM and \
+                    self._cache_store is not None:
+                yield from self._cache_store
+                return
+            store = [] if self.cache == CacheType.CACHE_PASS_IN_MEM else None
+            for fn in filenames:
+                for sample in self(None, fn):
+                    if store is not None:
+                        store.append(sample)
+                    yield sample
+            if store is not None:
+                self._cache_store = store
+
+        return reader
+
+
+def provider(input_types=None, should_shuffle=None, pool_size=-1,
+             min_pool_size=-1, can_over_batch_size=True, calc_batch_size=None,
+             cache=CacheType.NO_CACHE, check=False, check_fail_continue=False,
+             init_hook=None, **kwargs):
+    """The ``@provider`` decorator (reference ``PyDataProvider2.py:365``)."""
+    if input_types is None:
+        raise ValueError("provider requires input_types")
+
+    def deco(fn):
+        return DataProvider(fn, input_types, init_hook=init_hook,
+                            cache=cache, should_shuffle=should_shuffle,
+                            check=check, **kwargs)
+
+    return deco

@@ -1,0 +1,110 @@
+"""Image preprocessing utilities (reference ``python/paddle/v2/image.py``,
+which uses cv2; re-implemented over PIL + numpy — same function surface:
+resize_short, to_chw, center_crop, random_crop, left_right_flip,
+simple_transform, load_and_transform, batch_images)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "load_image", "resize_short", "to_chw", "center_crop", "random_crop",
+    "left_right_flip", "simple_transform", "load_and_transform",
+    "batch_images",
+]
+
+
+def load_image(file_path, is_color=True):
+    """Load an image file to an HWC uint8 array (reference load_image)."""
+    from PIL import Image
+    img = Image.open(file_path)
+    img = img.convert("RGB" if is_color else "L")
+    arr = np.asarray(img, dtype=np.uint8)
+    if not is_color:
+        arr = arr[:, :, None]
+    return arr
+
+
+def resize_short(im, size):
+    """Resize so the SHORT side equals ``size``, keeping aspect ratio
+    (reference resize_short)."""
+    from PIL import Image
+    h, w = im.shape[0], im.shape[1]
+    if h > w:
+        new_w, new_h = size, int(round(h * size / w))
+    else:
+        new_w, new_h = int(round(w * size / h)), size
+    squeeze = im.shape[2] == 1
+    pil = Image.fromarray(im[:, :, 0] if squeeze else im)
+    pil = pil.resize((new_w, new_h), Image.BILINEAR)
+    out = np.asarray(pil)
+    if squeeze:
+        out = out[:, :, None]
+    return out
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """HWC -> CHW (reference to_chw)."""
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[0], im.shape[1]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[0], im.shape[1]
+    h_start = rng.randint(0, h - size + 1)
+    w_start = rng.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im):
+    return im[:, ::-1, :]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """resize_short -> crop (random+flip when training, center otherwise)
+    -> CHW float32, optionally mean-subtracted (reference
+    simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        rng_ = rng or np.random
+        if rng_.randint(2) == 1:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype("float32")
+    if mean is not None:
+        mean = np.asarray(mean, dtype="float32")
+        if mean.ndim == 1:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images(img_reader, batch_size):
+    """Group an image reader into stacked [N, C, H, W] batches."""
+    def reader():
+        batch = []
+        for im in img_reader():
+            batch.append(im)
+            if len(batch) == batch_size:
+                yield np.stack(batch)
+                batch = []
+        if batch:
+            yield np.stack(batch)
+
+    return reader

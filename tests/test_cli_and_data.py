@@ -1,0 +1,218 @@
+"""CLI entry points, master server/client, PyDataProvider2 protocol, and
+v2 image utilities (reference: TrainerMain.cpp CLI, go/cmd/master,
+python/paddle/trainer/PyDataProvider2.py, python/paddle/v2/image.py)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.master import (MasterServer, MasterService,
+                                        MasterClient, partition_files)
+from paddle_tpu import pydataprovider2 as pdp2
+from paddle_tpu.v2 import image as v2_image
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(args, timeout=180):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    return subprocess.run([sys.executable, "-m", "paddle_tpu"] + args,
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout)
+
+
+class TestCLI:
+    def test_version(self):
+        r = _run_cli(["version"])
+        assert r.returncode == 0
+        assert "paddle_tpu" in r.stdout and "jax" in r.stdout
+
+    def test_train_and_infer(self, tmp_path):
+        script = textwrap.dedent("""
+            import os
+            import numpy as np
+            import paddle_tpu as fluid
+            import paddle_tpu.layers as layers
+
+            passes = int(os.environ.get("PADDLE_NUM_PASSES", 1))
+            x = layers.data(name="x", shape=[8, 4], append_batch_size=False)
+            y = layers.data(name="y", shape=[8, 1], append_batch_size=False)
+            pred = layers.fc(input=x, size=1)
+            loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(fluid.default_startup_program())
+            rng = np.random.RandomState(0)
+            xs = rng.rand(8, 4).astype("float32")
+            ys = (xs.sum(1, keepdims=True) * 0.5).astype("float32")
+            first = last = None
+            for p in range(passes * 10):
+                (l,) = exe.run(fluid.default_main_program(),
+                               feed={"x": xs, "y": ys}, fetch_list=[loss])
+                l = float(np.asarray(l).reshape(-1)[0])
+                first = l if first is None else first
+                last = l
+            assert last < first
+            fluid.io.save_inference_model(os.environ["MODEL_DIR"],
+                                          ["x"], [pred], exe)
+            print("TRAIN_DONE", first, last)
+        """)
+        cfg = tmp_path / "train_config.py"
+        cfg.write_text(script)
+        model_dir = tmp_path / "model"
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env["MODEL_DIR"] = str(model_dir)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu", "train", "--config",
+             str(cfg), "--num-passes", "2"],
+            capture_output=True, text=True, env=env, timeout=180)
+        assert r.returncode == 0, r.stderr
+        assert "TRAIN_DONE" in r.stdout
+
+        np.save(tmp_path / "x.npy",
+                np.random.RandomState(1).rand(8, 4).astype("float32"))
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu", "infer", "--model",
+             str(model_dir), "--feed", f"x={tmp_path / 'x.npy'}",
+             "--output", str(tmp_path)],
+            capture_output=True, text=True, env=env, timeout=180)
+        assert r.returncode == 0, r.stderr
+        assert "shape=(8, 1)" in r.stdout
+
+
+class TestMasterNetwork:
+    def test_server_client_roundtrip(self, tmp_path):
+        files = []
+        for i in range(4):
+            p = tmp_path / f"part-{i}"
+            p.write_text("x")
+            files.append(str(p))
+        svc = MasterService(partition_files(files), timeout=5.0)
+        server = MasterServer(svc, port=0)
+        server.start_background()
+        try:
+            client = MasterClient(server.addr)
+            seen = []
+            while True:
+                t = client.get_task()
+                if t is None:
+                    break
+                seen.extend(t.chunks)
+                assert client.task_finished(t.id, t.epoch)
+            assert sorted(seen) == sorted(files)
+            assert client.all_done()
+            stats = client.stats()
+            assert stats["done"] == 4 and stats["todo"] == 0
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_failed_task_requeues(self, tmp_path):
+        svc = MasterService(partition_files(["a", "b"]), timeout=60.0,
+                            failure_max=3)
+        server = MasterServer(svc, port=0)
+        server.start_background()
+        try:
+            client = MasterClient(server.addr)
+            t = client.get_task()
+            assert client.task_failed(t.id, t.epoch)
+            ids = set()
+            while True:
+                t2 = client.get_task()
+                if t2 is None:
+                    break
+                ids.add(t2.id)
+                client.task_finished(t2.id, t2.epoch)
+            assert t.id in ids  # failed task came back
+            client.close()
+        finally:
+            server.shutdown()
+
+
+class TestPyDataProvider2:
+    def test_provider_protocol(self, tmp_path):
+        data_file = tmp_path / "samples.txt"
+        data_file.write_text("1 0.5 0.25\n0 0.1 0.9\n1 0.7 0.3\n")
+
+        @pdp2.provider(input_types={"feats": pdp2.dense_vector(2),
+                                    "label": pdp2.integer_value(2)},
+                       cache=pdp2.CacheType.CACHE_PASS_IN_MEM, check=True)
+        def process(settings, filename):
+            with open(filename) as f:
+                for line in f:
+                    parts = line.split()
+                    yield {"feats": [float(parts[1]), float(parts[2])],
+                           "label": int(parts[0])}
+
+        reader = process.as_reader(str(data_file))
+        samples = list(reader())
+        assert len(samples) == 3
+        feats, label = samples[0]
+        np.testing.assert_allclose(feats, [0.5, 0.25])
+        assert label.tolist() == [1]
+        # cached second pass identical
+        again = list(reader())
+        assert len(again) == 3
+        np.testing.assert_allclose(again[0][0], samples[0][0])
+
+    def test_sparse_and_sequence_types(self):
+        t = pdp2.sparse_binary_vector(5)
+        np.testing.assert_allclose(t.convert([0, 3]), [1, 0, 0, 1, 0])
+        t = pdp2.sparse_float_vector(4)
+        np.testing.assert_allclose(t.convert([(1, 0.5), (3, 2.0)]),
+                                   [0, 0.5, 0, 2.0])
+        t = pdp2.integer_value_sequence(10)
+        np.testing.assert_array_equal(t.convert([1, 2, 3]),
+                                      [[1], [2], [3]])
+        with pytest.raises(ValueError):
+            pdp2.integer_value(3).convert(7)
+
+
+class TestV2Image:
+    def _make_img(self, tmp_path, w=32, h=24):
+        from PIL import Image
+        rng = np.random.RandomState(0)
+        arr = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+        p = str(tmp_path / "img.png")
+        Image.fromarray(arr).save(p)
+        return p, arr
+
+    def test_load_resize_crop_flip(self, tmp_path):
+        p, arr = self._make_img(tmp_path)
+        im = v2_image.load_image(p)
+        np.testing.assert_array_equal(im, arr)
+        r = v2_image.resize_short(im, 16)
+        assert min(r.shape[:2]) == 16
+        assert abs(r.shape[1] / r.shape[0] - arr.shape[1] / arr.shape[0]) \
+            < 0.15
+        c = v2_image.center_crop(r, 12)
+        assert c.shape[:2] == (12, 12)
+        f = v2_image.left_right_flip(c)
+        np.testing.assert_array_equal(f[:, 0], c[:, -1])
+
+    def test_simple_transform_pipeline(self, tmp_path):
+        p, _ = self._make_img(tmp_path, w=48, h=40)
+        out = v2_image.load_and_transform(p, resize_size=32, crop_size=24,
+                                          is_train=False,
+                                          mean=[127.0, 127.0, 127.0])
+        assert out.shape == (3, 24, 24)
+        assert out.dtype == np.float32
+        assert out.min() < 0 < out.max()  # mean-centered
+
+    def test_batch_images(self, tmp_path):
+        p, _ = self._make_img(tmp_path)
+
+        def imgs():
+            for _ in range(5):
+                yield v2_image.load_and_transform(p, 28, 24, False)
+
+        batches = list(v2_image.batch_images(imgs, 2)())
+        assert [b.shape[0] for b in batches] == [2, 2, 1]
+        assert batches[0].shape[1:] == (3, 24, 24)
