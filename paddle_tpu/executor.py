@@ -267,6 +267,19 @@ class Executor:
                 value, lod = value
             dtype = var.dtype if var is not None else None
             _enforce_feed(name, value, var)
+            if lod is not None and len(lod) == 1 and \
+                    _lod_buckets_enabled(program):
+                # bucketed ragged mode (lod.py): pad rows to a bucket and
+                # feed the row-splits as data, so the jit key is the
+                # bucket, not the exact lod
+                from paddle_tpu.lod import bucket_ragged_feed, SPLITS_SUFFIX
+                value, splits, meta = bucket_ragged_feed(
+                    name, np.asarray(value), lod)
+                feed_arrays[name] = _as_device_array(value, dtype, device)
+                feed_arrays[name + SPLITS_SUFFIX] = _as_device_array(
+                    splits, "int32", device)
+                scope.set_lod(name, meta)
+                continue
             feed_arrays[name] = _as_device_array(value, dtype, device)
             # a dense feed must also CLEAR any stale lod from a previous
             # ragged feed of the same variable
@@ -556,9 +569,16 @@ class Executor:
             _warn_host_op_cliff(program, block)
         interpret = interpret or _profiler.op_profiling_enabled()
 
-        lod_map = {n: [list(level) for level in scope.find_lod(n)]
-                   for n in feed_arrays
-                   if scope.find_lod(n) is not None}
+        from paddle_tpu.lod import DynLoD, SPLITS_SUFFIX
+        lod_map = {}
+        for n in feed_arrays:
+            lod = scope.find_lod(n)
+            if lod is None:
+                continue
+            if isinstance(lod, tuple) and lod and lod[0] == "dyn":
+                lod_map[n] = DynLoD(n + SPLITS_SUFFIX, lod[1], lod[2])
+            else:
+                lod_map[n] = [list(level) for level in lod]
 
         amp = _amp_enabled(program)
 
@@ -667,6 +687,16 @@ def _enforce_feed(name, value, var):
                 f"(-1 = any), got {shape}")
 
 
+def _lod_buckets_enabled(program):
+    """Bucketed dynamic-LoD mode (lod.py): per-program ``lod_buckets``
+    attr or the PADDLE_TPU_LOD_BUCKETS env var."""
+    if getattr(program, "lod_buckets", None) is not None:
+        return bool(program.lod_buckets)
+    import os
+    return os.environ.get("PADDLE_TPU_LOD_BUCKETS", "0").strip().lower() \
+        not in ("0", "", "false", "off", "no")
+
+
 def _check_nan_inf_enabled(program):
     """check_nan_inf executor mode (reference FLAGS_check_nan_inf,
     ``executor.cc:28,352`` CheckTensorNANOrInf): per-program flag or the
@@ -758,9 +788,13 @@ def _has_host_ops(block):
 
 
 def _freeze_lod(lod):
-    """Nested row-splits list -> hashable tuple (jit cache key component)."""
+    """Nested row-splits list -> hashable tuple (jit cache key component).
+    Bucketed-mode metas ("dyn", B, T_bucket) are already hashable — that
+    IS the point: the exact splits stay out of the key."""
     if lod is None:
         return None
+    if isinstance(lod, tuple) and lod and lod[0] == "dyn":
+        return lod
     return tuple(tuple(int(x) for x in level) for level in lod)
 
 

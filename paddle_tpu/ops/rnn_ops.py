@@ -34,6 +34,11 @@ _ACTS = {
 }
 
 
+def _dyn(lod):
+    from paddle_tpu.lod import DynLoD
+    return isinstance(lod, DynLoD)
+
+
 def _infer_skip(op, block):
     raise ShapeInferenceSkip()
 
@@ -62,9 +67,32 @@ def _infer_unit(op, block):
             v.dtype = prev.dtype
 
 
-def _lod_pad_tables(lod, is_reverse=False):
-    """Static (gather [B,T], scatter [N], lengths [B]) index tables between
-    flat ragged [N, ...] and padded [B, T, ...] layouts."""
+def _lod_pad_tables(lod, is_reverse=False, ctx=None, n_rows=None):
+    """(gather [B,T], scatter [N], lengths [B], B, T) index tables between
+    flat ragged [N, ...] and padded [B, T, ...] layouts.
+
+    Static lod: trace-time numpy tables (exact shapes per lod).
+    DynLoD (bucketed mode, lod.py): traced jnp tables with static
+    (B, T_bucket) — one executable per bucket; ``n_rows`` is the bucketed
+    row count and rows past splits[-1] scatter back as zeros."""
+    from paddle_tpu.lod import DynLoD
+    if isinstance(lod, DynLoD):
+        splits = lod.splits(ctx.env).astype(jnp.int32)   # [B+1]
+        B, T = lod.num_seqs, lod.maxlen_bucket
+        N = n_rows
+        lengths = splits[1:] - splits[:-1]               # [B]
+        t_idx = jnp.arange(T)[None, :]                   # [1, T]
+        valid = t_idx < lengths[:, None]                 # [B, T]
+        off = (lengths[:, None] - 1 - t_idx) if is_reverse else t_idx
+        src = splits[:-1, None] + off
+        gather = jnp.where(valid, src, N).astype(jnp.int32)
+        # scatter: flat row -> padded slot; padding rows -> B*T (OOB =
+        # zero row appended by _to_flat)
+        flat_slot = (jnp.arange(B)[:, None] * T + t_idx)
+        scatter = jnp.full((N,), B * T, jnp.int32).at[
+            jnp.where(valid, src, N).reshape(-1)].set(
+                flat_slot.reshape(-1).astype(jnp.int32))
+        return gather, scatter, lengths, B, T
     splits = np.asarray(lod[-1])
     lengths = (splits[1:] - splits[:-1]).astype(np.int64)
     B, T = len(lengths), int(lengths.max()) if len(lengths) else 0
@@ -88,6 +116,9 @@ def _to_padded(x, gather):
 
 def _to_flat(padded, scatter, B, T):
     flat = padded.reshape((B * T,) + padded.shape[2:])
+    # one extra zero row: dynamic-mode padding rows index B*T
+    flat = jnp.concatenate(
+        [flat, jnp.zeros((1,) + flat.shape[1:], flat.dtype)], axis=0)
     return flat[jnp.asarray(scatter)]
 
 
@@ -110,7 +141,8 @@ def lstm_lower(ctx: LowerContext):
     act_cell = _ACTS[ctx.attr("cell_activation", "tanh")]
     act_cand = _ACTS[ctx.attr("candidate_activation", "tanh")]
 
-    gather, scatter, lengths, B, T = _lod_pad_tables(lod, is_reverse)
+    gather, scatter, lengths, B, T = _lod_pad_tables(
+        lod, is_reverse, ctx=ctx, n_rows=x.shape[0])
     xp = _to_padded(x, gather)                      # [B, T, 4H]
     xp = jnp.moveaxis(xp, 1, 0)                     # [T, B, 4H]
     len_arr = jnp.asarray(lengths)
@@ -153,8 +185,9 @@ def lstm_lower(ctx: LowerContext):
     cs = jnp.moveaxis(cs, 0, 1)
     ctx.set_output("Hidden", _to_flat(hs, scatter, B, T))
     ctx.set_output("Cell", _to_flat(cs, scatter, B, T))
-    ctx.set_output_lod("Hidden", [list(l) for l in lod])
-    ctx.set_output_lod("Cell", [list(l) for l in lod])
+    out_lod = lod if _dyn(lod) else [list(l) for l in lod]
+    ctx.set_output_lod("Hidden", out_lod)
+    ctx.set_output_lod("Cell", out_lod)
 
 
 # ---------------------------------------------------------------------------
@@ -176,7 +209,8 @@ def gru_lower(ctx: LowerContext):
 
     w_ur = weight[:, :2 * H]
     w_c = weight[:, 2 * H:]
-    gather, scatter, lengths, B, T = _lod_pad_tables(lod, is_reverse)
+    gather, scatter, lengths, B, T = _lod_pad_tables(
+        lod, is_reverse, ctx=ctx, n_rows=x.shape[0])
     xp = jnp.moveaxis(_to_padded(x, gather), 1, 0)  # [T, B, 3H]
     len_arr = jnp.asarray(lengths)
 
@@ -202,7 +236,8 @@ def gru_lower(ctx: LowerContext):
     (_, _), hs = jax.lax.scan(step, (h_init, jnp.asarray(0, jnp.int32)), xp)
     hs = jnp.moveaxis(hs, 0, 1)
     ctx.set_output("Hidden", _to_flat(hs, scatter, B, T))
-    ctx.set_output_lod("Hidden", [list(l) for l in lod])
+    ctx.set_output_lod("Hidden",
+                       lod if _dyn(lod) else [list(l) for l in lod])
 
 
 # ---------------------------------------------------------------------------
@@ -301,7 +336,8 @@ def lstmp_lower(ctx: LowerContext):
     act_cand = _ACTS[ctx.attr("candidate_activation", "tanh")]
     act_proj = _ACTS[ctx.attr("proj_activation", "tanh")]
 
-    gather, scatter, lengths, B, T = _lod_pad_tables(lod, is_reverse)
+    gather, scatter, lengths, B, T = _lod_pad_tables(
+        lod, is_reverse, ctx=ctx, n_rows=x.shape[0])
     xp = jnp.moveaxis(_to_padded(x, gather), 1, 0)   # [T, B, 4H]
     len_arr = jnp.asarray(lengths)
 
@@ -341,5 +377,6 @@ def lstmp_lower(ctx: LowerContext):
     cs = jnp.moveaxis(cs, 0, 1)
     ctx.set_output("Projection", _to_flat(rs, scatter, B, T))
     ctx.set_output("Cell", _to_flat(cs, scatter, B, T))
-    ctx.set_output_lod("Projection", [list(l) for l in lod])
-    ctx.set_output_lod("Cell", [list(l) for l in lod])
+    out_lod = lod if _dyn(lod) else [list(l) for l in lod]
+    ctx.set_output_lod("Projection", out_lod)
+    ctx.set_output_lod("Cell", out_lod)

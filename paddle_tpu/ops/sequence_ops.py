@@ -56,6 +56,15 @@ def _segment_ids(lod, level=0):
 
 
 def _last_level(lod):
+    from paddle_tpu.lod import DynLoD
+    if isinstance(lod, DynLoD):
+        # ops that reach here haven't grown a dynamic branch; fail with a
+        # recipe instead of an opaque TypeError
+        raise NotImplementedError(
+            "this sequence op does not support bucketed dynamic LoD "
+            "(PADDLE_TPU_LOD_BUCKETS / program.lod_buckets) yet — run it "
+            "with exact static LoD, or keep it out of the bucketed "
+            "program")
     return len(lod) - 1
 
 
@@ -72,6 +81,33 @@ def _require_lod(ctx, slot="X"):
     return lod
 
 
+def _is_dyn(lod):
+    from paddle_tpu.lod import DynLoD
+    return isinstance(lod, DynLoD)
+
+
+def _segment_tables(ctx, lod, n_rows):
+    """(seg [N] int32, lengths [B] jnp, num_segments, splits [B+1] jnp,
+    valid [N] bool|None) — from a static lod (trace-time numpy) or a
+    DynLoD (runtime row-splits, bucketed mode — lod.py).  Padding rows get
+    segment id == num_segments, which jax segment ops DROP."""
+    if _is_dyn(lod):
+        splits = lod.splits(ctx.env).astype(jnp.int32)
+        num = lod.num_seqs
+        lengths = splits[1:] - splits[:-1]
+        rows = jnp.arange(n_rows)
+        seg = jnp.searchsorted(splits[1:], rows,
+                               side="right").astype(jnp.int32)
+        valid = rows < splits[-1]
+        seg = jnp.where(valid, seg, num)
+        return seg, lengths, num, splits, valid
+    level = _last_level(lod)
+    seg = jnp.asarray(_segment_ids(lod, level))
+    lengths_np = np.asarray(_lengths(lod, level))
+    splits = jnp.asarray(np.asarray(lod[level], dtype=np.int32))
+    return seg, jnp.asarray(lengths_np), len(lengths_np), splits, None
+
+
 # ---------------------------------------------------------------------------
 # sequence_pool (sum/average/max/min/last/first/sqrt)
 # ---------------------------------------------------------------------------
@@ -81,42 +117,42 @@ def sequence_pool_lower(ctx: LowerContext):
     x = ctx.input("X")                      # [N, D]
     lod = _require_lod(ctx)
     pooltype = ctx.attr("pooltype", "AVERAGE").upper()
-    level = _last_level(lod)
-    seg = jnp.asarray(_segment_ids(lod, level))
-    lengths = np.asarray(_lengths(lod, level))
-    num = len(lengths)
-    splits = np.asarray(lod[level])
+    seg, lengths, num, splits, _ = _segment_tables(ctx, lod, x.shape[0])
+    denom_shape = (-1,) + (1,) * (x.ndim - 1)
 
     if pooltype == "SUM":
         out = jax.ops.segment_sum(x, seg, num_segments=num)
     elif pooltype in ("AVERAGE", "MEAN"):
         s = jax.ops.segment_sum(x, seg, num_segments=num)
-        out = s / jnp.asarray(np.maximum(lengths, 1),
-                              x.dtype).reshape(-1, *([1] * (x.ndim - 1)))
+        out = s / jnp.maximum(lengths, 1).astype(x.dtype).reshape(
+            denom_shape)
     elif pooltype == "SQRT":
         s = jax.ops.segment_sum(x, seg, num_segments=num)
-        out = s / jnp.asarray(np.sqrt(np.maximum(lengths, 1)),
-                              x.dtype).reshape(-1, *([1] * (x.ndim - 1)))
+        out = s / jnp.sqrt(jnp.maximum(lengths, 1).astype(x.dtype)).reshape(
+            denom_shape)
     elif pooltype == "MAX":
         out = jax.ops.segment_max(x, seg, num_segments=num)
         # MaxIndex = per-(segment, feature) argmax row (first match), as
         # the reference MaxSeqPoolFunctor stores (math/sequence_pooling.cc)
         N = x.shape[0]
         rows = jnp.arange(N).reshape(-1, *([1] * (x.ndim - 1)))
-        is_max = x == out[seg]
+        safe_seg = jnp.minimum(seg, num - 1)  # padding rows: any gather
+        is_max = (x == out[safe_seg]) & (seg < num).reshape(
+            (-1,) + (1,) * (x.ndim - 1))
         idx = jax.ops.segment_min(
             jnp.where(is_max, rows, N), seg, num_segments=num)
         ctx.set_output("MaxIndex", idx)
     elif pooltype == "MIN":
         out = jax.ops.segment_min(x, seg, num_segments=num)
     elif pooltype == "LAST":
-        out = x[jnp.asarray(splits[1:] - 1)]
+        out = x[splits[1:] - 1]
     elif pooltype == "FIRST":
-        out = x[jnp.asarray(splits[:-1])]
+        out = x[splits[:-1]]
     else:
         raise NotImplementedError(f"sequence_pool type {pooltype}")
     ctx.set_output("Out", out)
-    if level > 0:
+    if not _is_dyn(lod) and _last_level(lod) > 0:
+        level = _last_level(lod)
         ctx.set_output_lod("Out", [list(lod[i]) for i in range(level)])
 
 
@@ -128,16 +164,20 @@ def sequence_pool_lower(ctx: LowerContext):
 def sequence_softmax_lower(ctx: LowerContext):
     x = ctx.input("X")          # [N] or [N, 1]
     lod = _require_lod(ctx)
-    level = _last_level(lod)
-    seg = jnp.asarray(_segment_ids(lod, level))
-    num = len(_lengths(lod, level))
     flat = x.reshape(-1)
+    seg, _, num, _, valid = _segment_tables(ctx, lod, flat.shape[0])
+    safe_seg = jnp.minimum(seg, num - 1)
     mx = jax.ops.segment_max(flat, seg, num_segments=num)
-    e = jnp.exp(flat - mx[seg])
+    e = jnp.exp(flat - mx[safe_seg])
+    if valid is not None:
+        e = jnp.where(valid, e, 0.0)
     denom = jax.ops.segment_sum(e, seg, num_segments=num)
-    out = (e / denom[seg]).reshape(x.shape)
+    out = (e / jnp.maximum(denom[safe_seg], 1e-30)).reshape(x.shape)
     ctx.set_output("Out", out)
-    ctx.set_output_lod("Out", [list(l) for l in lod])
+    if _is_dyn(lod):
+        ctx.set_output_lod("Out", lod)
+    else:
+        ctx.set_output_lod("Out", [list(l) for l in lod])
 
 
 # ---------------------------------------------------------------------------
@@ -288,25 +328,38 @@ def sequence_conv_lower(ctx: LowerContext):
     lod = _require_lod(ctx)
     ctx_len = ctx.attr("contextLength")
     ctx_start = ctx.attr("contextStart", -((ctx_len - 1) // 2))
-    splits = np.asarray(lod[_last_level(lod)])
     N = x.shape[0]
 
-    # static gather table: row n, window slot j -> source row (or N = pad)
-    gather = np.full((N, ctx_len), N, dtype=np.int32)
-    for i in range(len(splits) - 1):
-        for n in range(splits[i], splits[i + 1]):
-            for j in range(ctx_len):
-                src = n + ctx_start + j
-                if splits[i] <= src < splits[i + 1]:
-                    gather[n, j] = src
+    if _is_dyn(lod):
+        # runtime gather table: window slot valid iff the source row stays
+        # inside the same sequence (same segment, within valid rows)
+        seg, _, num, splits, valid = _segment_tables(ctx, lod, N)
+        rows = jnp.arange(N)[:, None]                 # [N, 1]
+        src = rows + ctx_start + jnp.arange(ctx_len)[None, :]  # [N, C]
+        in_bounds = (src >= 0) & (src < N)
+        src_c = jnp.clip(src, 0, N - 1)
+        same_seq = (seg[src_c] == seg[:, None]) & (seg[:, None] < num)
+        gather = jnp.where(in_bounds & same_seq, src_c, N)
+    else:
+        splits = np.asarray(lod[_last_level(lod)])
+        # static gather table: row n, slot j -> source row (or N = pad)
+        gather = np.full((N, ctx_len), N, dtype=np.int32)
+        for i in range(len(splits) - 1):
+            for n in range(splits[i], splits[i + 1]):
+                for j in range(ctx_len):
+                    src = n + ctx_start + j
+                    if splits[i] <= src < splits[i + 1]:
+                        gather[n, j] = src
+        gather = jnp.asarray(gather)
     padded = jnp.concatenate([x, jnp.zeros((1,) + x.shape[1:], x.dtype)])
-    windows = padded[jnp.asarray(gather)]          # [N, ctx_len, D]
+    windows = padded[gather]                       # [N, ctx_len, D]
     flat = windows.reshape(N, -1)
     out = flat @ filt
     if ctx.op.input("PaddingData"):
         pass  # trainable boundary padding unsupported; zeros used
     ctx.set_output("Out", out)
-    ctx.set_output_lod("Out", [list(s) for s in lod])
+    ctx.set_output_lod("Out",
+                       lod if _is_dyn(lod) else [list(s) for s in lod])
 
 
 # ---------------------------------------------------------------------------
@@ -317,16 +370,16 @@ def sequence_conv_lower(ctx: LowerContext):
 def sequence_first_step_lower(ctx: LowerContext):
     x = ctx.input("X")
     lod = _require_lod(ctx)
-    splits = np.asarray(lod[_last_level(lod)])
-    ctx.set_output("Out", x[jnp.asarray(splits[:-1])])
+    _, _, _, splits, _ = _segment_tables(ctx, lod, x.shape[0])
+    ctx.set_output("Out", x[splits[:-1]])
 
 
 @register_op("sequence_last_step", infer_shape=_infer_ragged)
 def sequence_last_step_lower(ctx: LowerContext):
     x = ctx.input("X")
     lod = _require_lod(ctx)
-    splits = np.asarray(lod[_last_level(lod)])
-    ctx.set_output("Out", x[jnp.asarray(splits[1:] - 1)])
+    _, _, _, splits, _ = _segment_tables(ctx, lod, x.shape[0])
+    ctx.set_output("Out", x[splits[1:] - 1])
 
 
 # ---------------------------------------------------------------------------

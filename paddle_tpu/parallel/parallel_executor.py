@@ -128,8 +128,13 @@ class ParallelExecutor(Executor):
             repl,  # rng key
         )
         training = not program._is_inference
-        lod_map = {n: [list(level) for level in lod]
-                   for n, lod in feed_lods}
+        from paddle_tpu.lod import DynLoD, SPLITS_SUFFIX
+        lod_map = {}
+        for n, lod in feed_lods:
+            if isinstance(lod, tuple) and lod and lod[0] == "dyn":
+                lod_map[n] = DynLoD(n + SPLITS_SUFFIX, lod[1], lod[2])
+            else:
+                lod_map[n] = [list(level) for level in lod]
 
         def step(feeds, ro_state, inout_state, rng_key):
             env = {}

@@ -4,8 +4,11 @@ Reference: ``python/paddle/trainer/PyDataProvider2.py:365`` — a decorated
 generator yields samples whose slots are declared by ``input_types``; the
 legacy C++ DataProvider (``gserver/dataproviders/PyDataProvider2.cpp``)
 embedded CPython to drain it.  Here the decorated provider converts
-directly into a plain reader (``paddle_tpu.reader`` composes the rest),
-with the same input-type declarations and per-slot value checking.
+directly into a plain reader (``paddle_tpu.reader`` composes the rest).
+
+The input-type declarations are the SAME objects as
+``paddle_tpu.v2.data_type`` (one definition, re-exported), so types built
+through either module work with ``@provider``.
 """
 
 from __future__ import annotations
@@ -14,98 +17,23 @@ import functools
 
 import numpy as np
 
+from paddle_tpu.v2.data_type import (  # noqa: F401  (re-exports)
+    SequenceType, DataType, InputType, dense_vector, dense_vector_sequence,
+    sparse_binary_vector, sparse_float_vector, integer_value,
+    integer_value_sequence)
+
 __all__ = [
-    "provider", "dense_vector", "dense_vector_sequence", "sparse_binary_vector",
-    "sparse_binary_vector_sequence", "sparse_float_vector",
-    "sparse_float_vector_sequence", "integer_value", "integer_value_sequence",
-    "SequenceType", "DataType", "CacheType", "InputType",
+    "provider", "dense_vector", "dense_vector_sequence",
+    "sparse_binary_vector", "sparse_binary_vector_sequence",
+    "sparse_float_vector", "sparse_float_vector_sequence", "integer_value",
+    "integer_value_sequence", "SequenceType", "DataType", "CacheType",
+    "InputType", "convert_slot",
 ]
-
-
-class SequenceType:
-    NO_SEQUENCE = 0
-    SEQUENCE = 1
-    SUB_SEQUENCE = 2
-
-
-class DataType:
-    Dense = 0
-    SparseNonValue = 1
-    SparseValue = 2
-    Index = 3
 
 
 class CacheType:
     NO_CACHE = 0
     CACHE_PASS_IN_MEM = 1
-
-
-class InputType:
-    """Declares one slot: dimension, sequence nesting, and data type
-    (reference ``PyDataProvider2.py:63``)."""
-
-    __slots__ = ("dim", "seq_type", "type")
-
-    def __init__(self, dim, seq_type, tp):
-        self.dim = dim
-        self.seq_type = seq_type
-        self.type = tp
-
-    def __repr__(self):
-        return (f"InputType(dim={self.dim}, seq_type={self.seq_type}, "
-                f"type={self.type})")
-
-    def convert(self, value):
-        """Check + convert one slot value to numpy (dense realization:
-        sparse slots become dense vectors — the TPU build's SelectedRows
-        path begins at the embedding layer, not the feed)."""
-        if self.type == DataType.Index:
-            if self.seq_type == SequenceType.NO_SEQUENCE:
-                v = int(value)
-                if not 0 <= v < self.dim:
-                    raise ValueError(
-                        f"index {v} out of range [0, {self.dim})")
-                return np.asarray([v], dtype="int64")
-            return np.asarray(value, dtype="int64").reshape(-1, 1)
-        if self.type == DataType.Dense:
-            arr = np.asarray(value, dtype="float32")
-            if arr.shape[-1] != self.dim:
-                raise ValueError(
-                    f"dense slot expects dim {self.dim}, got {arr.shape}")
-            return arr
-        # sparse slots: list of ids or (id, value) pairs -> dense vector
-        def densify(ids):
-            out = np.zeros(self.dim, dtype="float32")
-            if self.type == DataType.SparseNonValue:
-                out[np.asarray(ids, dtype="int64")] = 1.0
-            else:
-                for i, v in ids:
-                    out[int(i)] = float(v)
-            return out
-
-        if self.seq_type == SequenceType.NO_SEQUENCE:
-            return densify(value)
-        return np.stack([densify(v) for v in value])
-
-
-def dense_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
-    return InputType(dim, seq_type, DataType.Dense)
-
-
-def sparse_binary_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
-    return InputType(dim, seq_type, DataType.SparseNonValue)
-
-
-def sparse_float_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
-    return InputType(dim, seq_type, DataType.SparseValue)
-
-
-def integer_value(value_range, seq_type=SequenceType.NO_SEQUENCE):
-    return InputType(value_range, seq_type, DataType.Index)
-
-
-def dense_vector_sequence(dim):
-    return dense_vector(dim, SequenceType.SEQUENCE)
 
 
 def sparse_binary_vector_sequence(dim):
@@ -116,8 +44,42 @@ def sparse_float_vector_sequence(dim):
     return sparse_float_vector(dim, SequenceType.SEQUENCE)
 
 
-def integer_value_sequence(value_range):
-    return integer_value(value_range, SequenceType.SEQUENCE)
+def convert_slot(input_type, value, validate=False):
+    """Convert one slot value to numpy per its InputType declaration
+    (dense realization: sparse slots become dense vectors — the TPU
+    build's sparse path begins at the embedding layer, not the feed).
+    ``validate`` adds the reference's range/shape checking."""
+    t = input_type
+    if t.type == DataType.Index:
+        if t.seq_type == SequenceType.NO_SEQUENCE:
+            v = int(value)
+            if validate and not 0 <= v < t.dim:
+                raise ValueError(f"index {v} out of range [0, {t.dim})")
+            return np.asarray([v], dtype="int64")
+        arr = np.asarray(value, dtype="int64").reshape(-1, 1)
+        if validate and arr.size and not (
+                (arr >= 0) & (arr < t.dim)).all():
+            raise ValueError(f"index sequence out of range [0, {t.dim})")
+        return arr
+    if t.type == DataType.Dense:
+        arr = np.asarray(value, dtype="float32")
+        if validate and arr.shape[-1] != t.dim:
+            raise ValueError(
+                f"dense slot expects dim {t.dim}, got {arr.shape}")
+        return arr
+
+    def densify(ids):
+        out = np.zeros(t.dim, dtype="float32")
+        if t.type == DataType.SparseNonValue:
+            out[np.asarray(ids, dtype="int64")] = 1.0
+        else:
+            for i, v in ids:
+                out[int(i)] = float(v)
+        return out
+
+    if t.seq_type == SequenceType.NO_SEQUENCE:
+        return densify(value)
+    return np.stack([densify(v) for v in value])
 
 
 class DataProvider:
@@ -133,7 +95,7 @@ class DataProvider:
         self.cache = cache
         self.check = check
         self.kwargs = kwargs
-        self._cache_store = None
+        self._cache_store = {}   # filenames tuple -> drained samples
         functools.update_wrapper(self, generator)
 
     def _ordered_types(self):
@@ -153,9 +115,8 @@ class DataProvider:
             raise ValueError(
                 f"provider yielded {len(values)} slots, expected "
                 f"{len(items)}")
-        if self.check:
-            return tuple(t.convert(v) for (_, t), v in zip(items, values))
-        return tuple(values)
+        return tuple(convert_slot(t, v, validate=self.check)
+                     for (_, t), v in zip(items, values))
 
     def __call__(self, obj=None, filename=None):
         """Drain one file (reference protocol: process(settings, filename));
@@ -174,14 +135,15 @@ class DataProvider:
     def as_reader(self, filenames):
         """Plain reader over a list of files, honoring CACHE_PASS_IN_MEM
         (reference CacheType semantics: first pass reads, later passes
-        serve from memory)."""
+        serve from memory; cached per filenames tuple)."""
         if isinstance(filenames, str):
             filenames = [filenames]
+        key = tuple(filenames)
 
         def reader():
             if self.cache == CacheType.CACHE_PASS_IN_MEM and \
-                    self._cache_store is not None:
-                yield from self._cache_store
+                    key in self._cache_store:
+                yield from self._cache_store[key]
                 return
             store = [] if self.cache == CacheType.CACHE_PASS_IN_MEM else None
             for fn in filenames:
@@ -190,7 +152,7 @@ class DataProvider:
                         store.append(sample)
                     yield sample
             if store is not None:
-                self._cache_store = store
+                self._cache_store[key] = store
 
         return reader
 

@@ -163,16 +163,24 @@ class TestPyDataProvider2:
         np.testing.assert_allclose(again[0][0], samples[0][0])
 
     def test_sparse_and_sequence_types(self):
+        conv = pdp2.convert_slot
         t = pdp2.sparse_binary_vector(5)
-        np.testing.assert_allclose(t.convert([0, 3]), [1, 0, 0, 1, 0])
+        np.testing.assert_allclose(conv(t, [0, 3]), [1, 0, 0, 1, 0])
         t = pdp2.sparse_float_vector(4)
-        np.testing.assert_allclose(t.convert([(1, 0.5), (3, 2.0)]),
+        np.testing.assert_allclose(conv(t, [(1, 0.5), (3, 2.0)]),
                                    [0, 0.5, 0, 2.0])
         t = pdp2.integer_value_sequence(10)
-        np.testing.assert_array_equal(t.convert([1, 2, 3]),
+        np.testing.assert_array_equal(conv(t, [1, 2, 3]),
                                       [[1], [2], [3]])
         with pytest.raises(ValueError):
-            pdp2.integer_value(3).convert(7)
+            conv(pdp2.integer_value(3), 7, validate=True)
+        # v2.data_type objects are the SAME types — interchangeable
+        from paddle_tpu.v2 import data_type as v2dt
+        np.testing.assert_allclose(conv(v2dt.dense_vector(2), [1.0, 2.0]),
+                                   [1.0, 2.0])
+        # conversion happens regardless of check= (only validation gated)
+        np.testing.assert_array_equal(conv(pdp2.integer_value(3), 7), [[7]]
+                                      if False else [7])
 
 
 class TestV2Image:
