@@ -103,6 +103,13 @@ def _cmd_master(args):
     return 0
 
 
+def _cmd_serve(args):
+    """HTTP inference server over a saved model (L6 serving runtime)."""
+    from paddle_tpu.serving import serve
+    serve(args.model, host=args.host, port=args.port)
+    return 0
+
+
 def _cmd_launch(args):
     """Spawn an N-process jax.distributed cluster on this host (the
     cluster_train launcher analog; each process gets the reference's
@@ -155,6 +162,12 @@ def main(argv=None):
     p.add_argument("--snapshot", default=None,
                    help="snapshot file for restart recovery")
     p.set_defaults(fn=_cmd_master)
+
+    p = sub.add_parser("serve", help="HTTP inference server")
+    p.add_argument("--model", required=True, help="save_inference_model dir")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8866)
+    p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("launch", help="spawn a local N-process cluster")
     p.add_argument("--nproc", type=int, required=True)

@@ -687,14 +687,20 @@ def _enforce_feed(name, value, var):
                 f"(-1 = any), got {shape}")
 
 
+def _env_flag(name, default="0"):
+    """Shared env-var truthiness parsing for the gflags-style config
+    layer (SURVEY.md §5.6)."""
+    import os
+    return os.environ.get(name, default).strip().lower() \
+        not in ("0", "", "false", "off", "no")
+
+
 def _lod_buckets_enabled(program):
     """Bucketed dynamic-LoD mode (lod.py): per-program ``lod_buckets``
     attr or the PADDLE_TPU_LOD_BUCKETS env var."""
     if getattr(program, "lod_buckets", None) is not None:
         return bool(program.lod_buckets)
-    import os
-    return os.environ.get("PADDLE_TPU_LOD_BUCKETS", "0").strip().lower() \
-        not in ("0", "", "false", "off", "no")
+    return _env_flag("PADDLE_TPU_LOD_BUCKETS")
 
 
 def _check_nan_inf_enabled(program):
@@ -703,9 +709,7 @@ def _check_nan_inf_enabled(program):
     PADDLE_TPU_CHECK_NAN_INF env var."""
     if getattr(program, "check_nan_inf", None) is not None:
         return bool(program.check_nan_inf)
-    import os
-    return os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0").strip().lower() \
-        not in ("0", "", "false", "off", "no")
+    return _env_flag("PADDLE_TPU_CHECK_NAN_INF")
 
 
 def _check_nan_inf(fetch_names, fetches, new_state):
@@ -738,9 +742,7 @@ def _amp_enabled(program):
     SURVEY.md §5.6)."""
     if getattr(program, "amp", None) is not None:
         return bool(program.amp)
-    import os
-    return os.environ.get("PADDLE_TPU_AMP", "0").strip().lower() \
-        not in ("0", "", "false", "off", "no")
+    return _env_flag("PADDLE_TPU_AMP")
 
 
 _WARNED_HOST_OP_BLOCKS = set()

@@ -59,6 +59,24 @@ class DynLoD:
         return (f"DynLoD({self.splits_name}, B={self.num_seqs}, "
                 f"T<={self.maxlen_bucket})")
 
+    # ops without a dynamic branch treat the lod as a nested list
+    # (len/index/iterate); fail those with a recipe, not a TypeError
+    def _unsupported(self):
+        raise NotImplementedError(
+            "this sequence op does not support bucketed dynamic LoD "
+            "(PADDLE_TPU_LOD_BUCKETS / program.lod_buckets) yet — run it "
+            "with exact static LoD, or keep it out of the bucketed "
+            "program")
+
+    def __len__(self):
+        self._unsupported()
+
+    def __getitem__(self, i):
+        self._unsupported()
+
+    def __iter__(self):
+        self._unsupported()
+
 
 def bucket_ragged_feed(name, value, lod):
     """(value [N, ...], single-level lod) -> (padded value [N_b, ...],

@@ -133,10 +133,9 @@ def multi_head_attention(queries, keys, values, d_key, d_value, d_model,
     # sequence/context parallelism: shard S over the mesh 'seq' axis and
     # attend with the ppermute ring (parallel/ring_attention.py); only for
     # self-attention (q and k share the sequence sharding)
-    seq_parallel = os.environ.get(
-        "PADDLE_TPU_SEQ_PARALLEL", "0").strip().lower() not in \
-        ("0", "", "false", "off", "no") and keys is queries and \
-        k_mask is None
+    from paddle_tpu.executor import _env_flag
+    seq_parallel = _env_flag("PADDLE_TPU_SEQ_PARALLEL") and \
+        keys is queries and k_mask is None
 
     if seq_parallel and not dropout_rate:
         ctx = layers.ring_attention(q, k, v, causal=causal, scale=scale)

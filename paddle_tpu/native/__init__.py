@@ -72,3 +72,78 @@ def load():
         lib.recio_loader_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
+
+
+# ---------------------------------------------------------------------------
+# capi: the embeddable C inference ABI (capi.cpp) — built separately since
+# it links against libpython.
+# ---------------------------------------------------------------------------
+
+_CAPI_SRC = os.path.join(_DIR, "capi.cpp")
+_CAPI_LIB = os.path.join(_DIR, "libpaddletpu_capi.so")
+_capi_lib = None
+_capi_error = None
+
+
+def _python_flags():
+    import sysconfig
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    return [f"-I{inc}"], [f"-L{libdir}", f"-lpython{ver}"]
+
+
+def load_capi():
+    """Build (if needed) and load the C inference ABI; None if no
+    toolchain."""
+    global _capi_lib, _capi_error
+    with _lock:
+        if _capi_lib is not None or _capi_error is not None:
+            return _capi_lib
+        try:
+            if (not os.path.exists(_CAPI_LIB) or
+                    os.path.getmtime(_CAPI_LIB) <
+                    os.path.getmtime(_CAPI_SRC)):
+                incs, libs = _python_flags()
+                cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+                       + incs + [_CAPI_SRC, "-o", _CAPI_LIB] + libs)
+                subprocess.run(cmd, check=True, capture_output=True)
+            lib = ctypes.CDLL(_CAPI_LIB, mode=ctypes.RTLD_GLOBAL)
+        except Exception as e:  # pragma: no cover - toolchain missing
+            _capi_error = e
+            return None
+        lib.pd_tpu_init.restype = ctypes.c_int
+        lib.pd_tpu_last_error.restype = ctypes.c_char_p
+        lib.pd_tpu_create.restype = ctypes.c_void_p
+        lib.pd_tpu_create.argtypes = [ctypes.c_char_p]
+        lib.pd_tpu_num_feeds.restype = ctypes.c_int
+        lib.pd_tpu_num_feeds.argtypes = [ctypes.c_void_p]
+        lib.pd_tpu_feed_name.restype = ctypes.c_char_p
+        lib.pd_tpu_feed_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.pd_tpu_run.restype = ctypes.c_void_p
+        lib.pd_tpu_run.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_longlong)),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_char_p)]
+        lib.pd_tpu_result_count.restype = ctypes.c_int
+        lib.pd_tpu_result_count.argtypes = [ctypes.c_void_p]
+        lib.pd_tpu_result_data.restype = ctypes.c_void_p
+        lib.pd_tpu_result_data.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong)]
+        lib.pd_tpu_result_rank.restype = ctypes.c_int
+        lib.pd_tpu_result_rank.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.pd_tpu_result_dim.restype = ctypes.c_longlong
+        lib.pd_tpu_result_dim.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                          ctypes.c_int]
+        lib.pd_tpu_result_dtype.restype = ctypes.c_char_p
+        lib.pd_tpu_result_dtype.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.pd_tpu_free_result.argtypes = [ctypes.c_void_p]
+        lib.pd_tpu_destroy.argtypes = [ctypes.c_void_p]
+        _capi_lib = lib
+        return _capi_lib

@@ -87,10 +87,10 @@ def _lod_pad_tables(lod, is_reverse=False, ctx=None, n_rows=None):
         src = splits[:-1, None] + off
         gather = jnp.where(valid, src, N).astype(jnp.int32)
         # scatter: flat row -> padded slot; padding rows -> B*T (OOB =
-        # zero row appended by _to_flat)
+        # zero row appended by _to_flat's pad mode)
         flat_slot = (jnp.arange(B)[:, None] * T + t_idx)
         scatter = jnp.full((N,), B * T, jnp.int32).at[
-            jnp.where(valid, src, N).reshape(-1)].set(
+            gather.reshape(-1)].set(
                 flat_slot.reshape(-1).astype(jnp.int32))
         return gather, scatter, lengths, B, T
     splits = np.asarray(lod[-1])
@@ -114,11 +114,13 @@ def _to_padded(x, gather):
     return padded_src[jnp.asarray(gather)]          # [B, T, ...]
 
 
-def _to_flat(padded, scatter, B, T):
+def _to_flat(padded, scatter, B, T, pad=False):
     flat = padded.reshape((B * T,) + padded.shape[2:])
-    # one extra zero row: dynamic-mode padding rows index B*T
-    flat = jnp.concatenate(
-        [flat, jnp.zeros((1,) + flat.shape[1:], flat.dtype)], axis=0)
+    if pad:
+        # one extra zero row: dynamic-mode padding rows index B*T (static
+        # scatters never reach B*T — skip the copy on the hot path)
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((1,) + flat.shape[1:], flat.dtype)], axis=0)
     return flat[jnp.asarray(scatter)]
 
 
@@ -183,8 +185,8 @@ def lstm_lower(ctx: LowerContext):
         step, (h_init, c_init, jnp.asarray(0, jnp.int32)), xp)
     hs = jnp.moveaxis(hs, 0, 1)                     # [B, T, H]
     cs = jnp.moveaxis(cs, 0, 1)
-    ctx.set_output("Hidden", _to_flat(hs, scatter, B, T))
-    ctx.set_output("Cell", _to_flat(cs, scatter, B, T))
+    ctx.set_output("Hidden", _to_flat(hs, scatter, B, T, pad=_dyn(lod)))
+    ctx.set_output("Cell", _to_flat(cs, scatter, B, T, pad=_dyn(lod)))
     out_lod = lod if _dyn(lod) else [list(l) for l in lod]
     ctx.set_output_lod("Hidden", out_lod)
     ctx.set_output_lod("Cell", out_lod)
@@ -235,7 +237,7 @@ def gru_lower(ctx: LowerContext):
 
     (_, _), hs = jax.lax.scan(step, (h_init, jnp.asarray(0, jnp.int32)), xp)
     hs = jnp.moveaxis(hs, 0, 1)
-    ctx.set_output("Hidden", _to_flat(hs, scatter, B, T))
+    ctx.set_output("Hidden", _to_flat(hs, scatter, B, T, pad=_dyn(lod)))
     ctx.set_output_lod("Hidden",
                        lod if _dyn(lod) else [list(l) for l in lod])
 
@@ -375,8 +377,8 @@ def lstmp_lower(ctx: LowerContext):
         step, (r_init, c_init, jnp.asarray(0, jnp.int32)), xp)
     rs = jnp.moveaxis(rs, 0, 1)
     cs = jnp.moveaxis(cs, 0, 1)
-    ctx.set_output("Projection", _to_flat(rs, scatter, B, T))
-    ctx.set_output("Cell", _to_flat(cs, scatter, B, T))
+    ctx.set_output("Projection", _to_flat(rs, scatter, B, T, pad=_dyn(lod)))
+    ctx.set_output("Cell", _to_flat(cs, scatter, B, T, pad=_dyn(lod)))
     out_lod = lod if _dyn(lod) else [list(l) for l in lod]
     ctx.set_output_lod("Projection", out_lod)
     ctx.set_output_lod("Cell", out_lod)

@@ -56,15 +56,7 @@ def _segment_ids(lod, level=0):
 
 
 def _last_level(lod):
-    from paddle_tpu.lod import DynLoD
-    if isinstance(lod, DynLoD):
-        # ops that reach here haven't grown a dynamic branch; fail with a
-        # recipe instead of an opaque TypeError
-        raise NotImplementedError(
-            "this sequence op does not support bucketed dynamic LoD "
-            "(PADDLE_TPU_LOD_BUCKETS / program.lod_buckets) yet — run it "
-            "with exact static LoD, or keep it out of the bucketed "
-            "program")
+    # DynLoD raises its own unsupported-op error on len()
     return len(lod) - 1
 
 

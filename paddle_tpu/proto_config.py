@@ -1,0 +1,96 @@
+"""Config schema + parser: the TrainerConfig/ModelConfig analog.
+
+Reference: ``proto/TrainerConfig.proto`` / ``ModelConfig.proto`` (V16) and
+``python/paddle/trainer/config_parser.py:4398`` ``parse_config`` (W3) —
+a Python config script runs under a capture context and produces one
+serializable artifact holding the model topology + trainer settings.
+
+TPU re-design: the Program IR already serializes (``Program.to_dict``),
+so the "proto" is a versioned JSON document wrapping that dict plus the
+optimizer/data-source settings the DSL's ``settings()`` /
+``define_py_data_sources2()`` recorded.  ``build_programs`` reconstructs
+runnable main+startup programs from a parsed config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import runpy
+
+CONFIG_VERSION = 1
+
+__all__ = ["TrainerConfig", "parse_config", "build_programs"]
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    """The TrainerConfig.proto analog (model + optimizer + data)."""
+
+    model: dict                 # Program.to_dict() of the main program
+    startup: dict               # Program.to_dict() of the startup program
+    settings: dict              # learning rate / method / batch size
+    data_sources: dict          # define_py_data_sources2 record
+    outputs: list               # output variable names
+    version: int = CONFIG_VERSION
+
+    def to_json(self, path=None, indent=None):
+        doc = dataclasses.asdict(self)
+        text = json.dumps(doc, indent=indent)
+        if path:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+    @staticmethod
+    def from_json(text_or_path):
+        try:
+            doc = json.loads(text_or_path)
+        except (json.JSONDecodeError, ValueError):
+            with open(text_or_path) as f:
+                doc = json.load(f)
+        if doc.get("version") != CONFIG_VERSION:
+            raise ValueError(
+                f"config version {doc.get('version')} != {CONFIG_VERSION}")
+        return TrainerConfig(**doc)
+
+
+def parse_config(config, config_arg_str=None):
+    """Run a config script/callable under fresh programs and capture the
+    result (reference ``config_parser.py parse_config``).
+
+    ``config``: a path to a python config file, or a zero-arg callable
+    that builds the network with the trainer_config_helpers / v2 DSL and
+    returns its output variable(s).
+    """
+    import paddle_tpu as fluid
+    from paddle_tpu.trainer_config_helpers import optimizers as opt_mod
+    from paddle_tpu.trainer_config_helpers import data_sources as ds_mod
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        if callable(config):
+            result = config()
+        else:
+            ns = runpy.run_path(config)
+            result = ns.get("outputs") or ns.get("cost")
+    out_vars = result if isinstance(result, (list, tuple)) else \
+        ([result] if result is not None else [])
+    return TrainerConfig(
+        model=main.global_block().program.to_dict(),
+        startup=startup.to_dict(),
+        settings=opt_mod.current_settings(),
+        data_sources=ds_mod.current_data_sources(),
+        outputs=[v.name for v in out_vars if hasattr(v, "name")])
+
+
+def build_programs(config: TrainerConfig):
+    """Reconstruct (main, startup, output_vars) from a parsed config —
+    the Executor runs these directly (the reference ships its proto to
+    the C++ trainer the same way)."""
+    from paddle_tpu.framework import Program
+
+    main = Program.from_dict(config.model)
+    startup = Program.from_dict(config.startup)
+    outs = [main.global_block().var(n) for n in config.outputs]
+    return main, startup, outs

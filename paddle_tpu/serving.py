@@ -1,0 +1,155 @@
+"""Serving runtime: Predictor, HTTP inference server, and the C-ABI
+helpers behind ``native/capi.cpp``.
+
+Reference L6 surface: the C++ inference loader (``inference/io.h:35`` +
+``inference/tests/book``) and the embeddable pure-C ABI
+(``paddle/capi/capi.h`` ``paddle_gradient_machine_*``).  TPU re-design:
+the compute runs through XLA/PJRT either way; the native shell
+(``native/capi.cpp``) embeds CPython to drive this module — the mirror
+image of the reference, which embedded CPython in its C++ data layer
+(``PyDataProvider2.cpp``)."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+
+__all__ = ["Predictor", "serve", "InferenceServer"]
+
+
+class Predictor:
+    """Load-once, run-many inference handle over a saved inference model
+    (the ``paddle_gradient_machine`` analog)."""
+
+    def __init__(self, model_dir):
+        import paddle_tpu as fluid
+
+        self._fluid = fluid
+        self._scope = fluid.Scope()
+        self._lock = threading.Lock()  # Executor/scope are not re-entrant
+        with fluid.scope_guard(self._scope):
+            self._exe = fluid.Executor()
+            (self._program, self._feed_names,
+             self._fetch_targets) = fluid.io.load_inference_model(
+                model_dir, self._exe)
+
+    @property
+    def feed_names(self):
+        return list(self._feed_names)
+
+    @property
+    def fetch_names(self):
+        return [t.name if hasattr(t, "name") else str(t)
+                for t in self._fetch_targets]
+
+    def run(self, feed):
+        """feed: dict name -> ndarray; returns list of ndarrays."""
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"missing feeds: {missing}")
+        with self._lock, self._fluid.scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=dict(feed),
+                                 fetch_list=self._fetch_targets)
+        return [np.asarray(o) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# C-ABI bridge helpers (called from native/capi.cpp via the CPython API)
+# ---------------------------------------------------------------------------
+
+def _capi_create(model_dir):
+    return Predictor(model_dir)
+
+
+def _capi_feed_names(predictor):
+    return predictor.feed_names
+
+
+def _capi_run(predictor, names, buffers, shapes, dtypes):
+    """names: list[str]; buffers: list[memoryview of raw bytes];
+    shapes: list[tuple]; dtypes: list[str].  Returns
+    (list[bytes], list[tuple[int]], list[str]) for the outputs."""
+    feed = {}
+    for name, buf, shape, dtype in zip(names, buffers, shapes, dtypes):
+        feed[name] = np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+    outs = predictor.run(feed)
+    payloads = [np.ascontiguousarray(o).tobytes() for o in outs]
+    out_shapes = [tuple(int(d) for d in o.shape) for o in outs]
+    out_dtypes = [str(o.dtype) for o in outs]
+    return payloads, out_shapes, out_dtypes
+
+
+# ---------------------------------------------------------------------------
+# HTTP inference server (the serving-runtime gap in L6; JSON in/out)
+# ---------------------------------------------------------------------------
+
+class InferenceServer:
+    def __init__(self, model_dir, host="127.0.0.1", port=0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        predictor = Predictor(model_dir)
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._reply(200, {"status": "ok"})
+                elif self.path == "/meta":
+                    self._reply(200, {"feeds": predictor.feed_names,
+                                      "fetches": predictor.fetch_names})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    feed = {k: np.asarray(v, dtype="float32")
+                            if not isinstance(v, dict)
+                            else np.asarray(v["data"],
+                                            dtype=v.get("dtype", "float32"))
+                            for k, v in req["feeds"].items()}
+                    outs = predictor.run(feed)
+                    self._reply(200, {"outputs": [o.tolist() for o in outs],
+                                      "shapes": [list(o.shape)
+                                                 for o in outs]})
+                except Exception as e:
+                    self._reply(400, {"error": str(e)})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.addr = self._server.server_address
+        self.predictor = predictor
+
+    def serve_forever(self):
+        self._server.serve_forever()
+
+    def start_background(self):
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def serve(model_dir, host="127.0.0.1", port=8866):
+    server = InferenceServer(model_dir, host, port)
+    print(f"serving {model_dir} on {server.addr[0]}:{server.addr[1]}",
+          flush=True)
+    server.serve_forever()

@@ -1,0 +1,17 @@
+"""trainer_config_helpers: the legacy layer-config DSL surface.
+
+Reference: ``python/paddle/trainer_config_helpers/`` (layers.py 7,610 LoC,
+plus activations/attrs/optimizers/poolings/networks/evaluators).  The DSL's
+``*_layer`` functions configure the same graphs the v2 API builds, so this
+package maps the legacy names onto the v2 shim (``paddle_tpu/v2``), which
+emits the Program IR directly — the path the reference takes through
+``config_parser.py:4398`` is replaced by ``proto_config.parse_config``.
+"""
+
+from paddle_tpu.trainer_config_helpers.layers import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.activations import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.attrs import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.poolings import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.networks import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.data_sources import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.optimizers import *  # noqa: F401,F403

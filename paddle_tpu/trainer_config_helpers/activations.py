@@ -1,0 +1,20 @@
+"""Activation declarations (reference
+``trainer_config_helpers/activations.py``): legacy ``FooActivation``
+names aliased onto the v2 activation classes (same objects)."""
+
+from paddle_tpu.v2 import activation as _act
+from paddle_tpu.v2.activation import BaseActivation  # noqa: F401
+
+__all__ = ["BaseActivation"]
+
+for _name in dir(_act):
+    _cls = getattr(_act, _name)
+    if isinstance(_cls, type) and issubclass(_cls, BaseActivation) and \
+            _cls is not BaseActivation:
+        _legacy = f"{_name}Activation"
+        globals()[_legacy] = _cls
+        __all__.append(_legacy)
+# the reference also names identity "IdentityActivation"
+if "LinearActivation" in globals():
+    globals()["IdentityActivation"] = globals()["LinearActivation"]
+    __all__.append("IdentityActivation")

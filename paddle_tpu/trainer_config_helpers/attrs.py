@@ -1,0 +1,9 @@
+"""Attribute declarations (reference ``trainer_config_helpers/attrs.py``)."""
+
+from paddle_tpu.v2.attr import ParamAttr, ExtraAttr  # noqa: F401
+
+ParameterAttribute = ParamAttr
+ExtraLayerAttribute = ExtraAttr
+
+__all__ = ["ParamAttr", "ExtraAttr", "ParameterAttribute",
+           "ExtraLayerAttribute"]

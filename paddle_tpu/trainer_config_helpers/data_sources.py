@@ -1,0 +1,20 @@
+"""define_py_data_sources2 (reference
+``trainer_config_helpers/data_sources.py``): records the data-provider
+module/object for the parsed config."""
+
+from __future__ import annotations
+
+__all__ = ["define_py_data_sources2", "current_data_sources"]
+
+_current = {}
+
+
+def current_data_sources():
+    return dict(_current)
+
+
+def define_py_data_sources2(train_list, test_list, module, obj, args=None):
+    global _current
+    _current = {"train_list": train_list, "test_list": test_list,
+                "module": module, "obj": obj, "args": args or {}}
+    return _current

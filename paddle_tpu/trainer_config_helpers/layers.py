@@ -1,0 +1,102 @@
+"""Legacy ``*_layer`` DSL names over the v2 shim (reference
+``trainer_config_helpers/layers.py``; each legacy function name keeps its
+signature shape, the body emits Program IR through ``paddle_tpu.v2``)."""
+
+from __future__ import annotations
+
+from paddle_tpu.v2 import layer as _v2
+
+__all__ = [
+    "data_layer", "fc_layer", "embedding_layer", "img_conv_layer",
+    "img_pool_layer", "batch_norm_layer", "dropout_layer", "concat_layer",
+    "lstmemory", "grumemory", "pooling_layer", "last_seq", "first_seq",
+    "classification_cost", "cross_entropy", "square_error_cost",
+    "regression_cost", "mse_cost", "LayerOutput",
+]
+
+# In the reference every DSL call returns a LayerOutput handle; here the
+# IR Variable plays that role directly.
+LayerOutput = object
+
+
+def data_layer(name, size, height=None, width=None, type=None):
+    from paddle_tpu.v2 import data_type as dt
+    input_type = type if type is not None else dt.dense_vector(size)
+    return _v2.data(name=name, type=input_type, height=height, width=width)
+
+
+def fc_layer(input, size, act=None, param_attr=None, bias_attr=None,
+             name=None, layer_attr=None):
+    return _v2.fc(input=input, size=size, act=act, param_attr=param_attr,
+                  bias_attr=bias_attr, name=name)
+
+
+def embedding_layer(input, size, param_attr=None):
+    return _v2.embedding(input=input, size=size, param_attr=param_attr)
+
+
+def img_conv_layer(input, filter_size, num_filters, num_channel=None,
+                   act=None, padding=0, stride=1, bias_attr=None,
+                   param_attr=None, name=None, **kwargs):
+    return _v2.img_conv(input=input, filter_size=filter_size,
+                        num_filters=num_filters, num_channel=num_channel,
+                        act=act, padding=padding, stride=stride,
+                        bias_attr=bias_attr, param_attr=param_attr)
+
+
+def img_pool_layer(input, pool_size, name=None, num_channels=None,
+                   pool_type=None, stride=None, padding=0, **kwargs):
+    return _v2.img_pool(input=input, pool_size=pool_size,
+                        pool_type=pool_type, stride=stride, padding=padding)
+
+
+def batch_norm_layer(input, act=None, name=None, **kwargs):
+    return _v2.batch_norm(input=input, act=act, **kwargs)
+
+
+def dropout_layer(input, dropout_rate, name=None):
+    return _v2.dropout(input=input, dropout_rate=dropout_rate)
+
+
+def concat_layer(input, act=None, name=None):
+    return _v2.concat(input=input, name=name)
+
+
+def lstmemory(input, size=None, reverse=False, act=None, name=None,
+              **kwargs):
+    return _v2.lstmemory(input=input, size=size, reverse=reverse, act=act,
+                         **kwargs)
+
+
+def grumemory(input, size=None, reverse=False, act=None, name=None,
+              **kwargs):
+    return _v2.gru(input=input, size=size, reverse=reverse, act=act,
+                   **kwargs)
+
+
+def pooling_layer(input, pooling_type=None, name=None, **kwargs):
+    return _v2.pooling(input=input, pooling_type=pooling_type, name=name)
+
+
+def last_seq(input, name=None, **kwargs):
+    return _v2.last_seq(input=input, name=name)
+
+
+def first_seq(input, name=None, **kwargs):
+    return _v2.first_seq(input=input, name=name)
+
+
+def classification_cost(input, label, name=None, **kwargs):
+    return _v2.classification_cost(input=input, label=label, name=name)
+
+
+def cross_entropy(input, label, name=None, **kwargs):
+    return _v2.cross_entropy_cost(input=input, label=label, name=name)
+
+
+def square_error_cost(input, label, name=None, **kwargs):
+    return _v2.square_error_cost(input=input, label=label, name=name)
+
+
+regression_cost = square_error_cost
+mse_cost = square_error_cost

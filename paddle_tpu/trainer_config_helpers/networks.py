@@ -1,0 +1,9 @@
+"""Composite network helpers (reference
+``trainer_config_helpers/networks.py``)."""
+
+from paddle_tpu.v2.networks import (  # noqa: F401
+    simple_img_conv_pool, img_conv_group, sequence_conv_pool, simple_lstm,
+    simple_gru, bidirectional_lstm)
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "simple_lstm", "simple_gru", "bidirectional_lstm"]

@@ -1,0 +1,45 @@
+"""Optimizer settings DSL (reference
+``trainer_config_helpers/optimizers.py`` settings()): records the chosen
+optimizer into the active parse context (proto_config.parse_config)."""
+
+from __future__ import annotations
+
+__all__ = ["settings", "AdamOptimizer", "MomentumOptimizer", "current_settings"]
+
+_current = {}
+
+
+def current_settings():
+    return dict(_current)
+
+
+class _OptSpec:
+    def __init__(self, name, **kwargs):
+        self.name = name
+        self.kwargs = kwargs
+
+    def to_dict(self):
+        return {"type": self.name, **self.kwargs}
+
+
+def AdamOptimizer(beta1=0.9, beta2=0.999, epsilon=1e-8):
+    return _OptSpec("adam", beta1=beta1, beta2=beta2, epsilon=epsilon)
+
+
+def MomentumOptimizer(momentum=0.9):
+    return _OptSpec("momentum", momentum=momentum)
+
+
+def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
+             regularization=None, **kwargs):
+    """reference settings(): global trainer config for the parsed model."""
+    global _current
+    _current = {
+        "batch_size": batch_size,
+        "learning_rate": learning_rate,
+        "learning_method": (learning_method.to_dict()
+                            if learning_method is not None
+                            else {"type": "sgd"}),
+    }
+    _current.update(kwargs)
+    return _current

@@ -1,0 +1,10 @@
+"""Pooling type declarations (reference
+``trainer_config_helpers/poolings.py``)."""
+
+from paddle_tpu.v2.layer import Max, Avg, Sum  # noqa: F401
+
+MaxPooling = Max
+AvgPooling = Avg
+SumPooling = Sum
+
+__all__ = ["MaxPooling", "AvgPooling", "SumPooling", "Max", "Avg", "Sum"]

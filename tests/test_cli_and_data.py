@@ -179,8 +179,7 @@ class TestPyDataProvider2:
         np.testing.assert_allclose(conv(v2dt.dense_vector(2), [1.0, 2.0]),
                                    [1.0, 2.0])
         # conversion happens regardless of check= (only validation gated)
-        np.testing.assert_array_equal(conv(pdp2.integer_value(3), 7), [[7]]
-                                      if False else [7])
+        np.testing.assert_array_equal(conv(pdp2.integer_value(3), 7), [7])
 
 
 class TestV2Image:

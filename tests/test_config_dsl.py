@@ -1,0 +1,74 @@
+"""trainer_config_helpers DSL + config schema tests (reference
+trainer_config_helpers/tests + test config round-trips through
+config_parser; here the schema is proto_config.TrainerConfig)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import proto_config
+from paddle_tpu.trainer_config_helpers import (
+    data_layer, fc_layer, classification_cost, settings, AdamOptimizer,
+    SoftmaxActivation, ReluActivation)
+from paddle_tpu.v2 import data_type as dt
+
+
+def _mnist_config():
+    settings(batch_size=16, learning_rate=0.01,
+             learning_method=AdamOptimizer())
+    img = data_layer(name="pixel", size=64)
+    hidden = fc_layer(input=img, size=32, act=ReluActivation())
+    pred = fc_layer(input=hidden, size=10, act=SoftmaxActivation())
+    lbl = data_layer(name="label", size=10, type=dt.integer_value(10))
+    cost = classification_cost(input=pred, label=lbl)
+    return cost
+
+
+class TestLegacyDSL:
+    def test_builds_and_trains(self):
+        rng = np.random.RandomState(0)
+        cfg = proto_config.parse_config(_mnist_config)
+        assert cfg.settings["learning_method"]["type"] == "adam"
+        assert cfg.settings["batch_size"] == 16
+        assert len(cfg.outputs) == 1
+
+        main, startup, (cost,) = proto_config.build_programs(cfg)
+        with fluid.program_guard(main, startup):
+            fluid.optimizer.Adam(
+                learning_rate=cfg.settings["learning_rate"]).minimize(cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+        xs = rng.rand(16, 64).astype("float32")
+        ys = rng.randint(0, 10, (16, 1)).astype("int64")
+        losses = []
+        for _ in range(15):
+            (lv,) = exe.run(main, feed={"pixel": xs, "label": ys},
+                            fetch_list=[cost])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+
+class TestConfigRoundTrip:
+    def test_json_roundtrip(self, tmp_path):
+        cfg = proto_config.parse_config(_mnist_config)
+        p = str(tmp_path / "trainer.json")
+        cfg.to_json(path=p, indent=1)
+        cfg2 = proto_config.TrainerConfig.from_json(p)
+        assert cfg2.settings == cfg.settings
+        assert cfg2.outputs == cfg.outputs
+
+        # reconstructed program computes the same forward
+        rng = np.random.RandomState(1)
+        xs = rng.rand(4, 64).astype("float32")
+        ys = rng.randint(0, 10, (4, 1)).astype("int64")
+        vals = []
+        for c in (cfg, cfg2):
+            main, startup, (cost,) = proto_config.build_programs(c)
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor()
+                main.random_seed = startup.random_seed = 7
+                exe.run(startup)
+                (lv,) = exe.run(main, feed={"pixel": xs, "label": ys},
+                                fetch_list=[cost])
+            vals.append(float(np.asarray(lv).reshape(-1)[0]))
+        np.testing.assert_allclose(vals[0], vals[1], rtol=1e-6)

@@ -1,0 +1,115 @@
+"""Serving runtime tests: Predictor, HTTP server, and the embeddable C
+inference ABI (reference ``paddle/capi`` + ``inference/tests/book``)."""
+
+import ctypes
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from paddle_tpu.serving import Predictor, InferenceServer
+
+
+@pytest.fixture()
+def model_dir(tmp_path):
+    """Train a tiny regression and save an inference model."""
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 4).astype("float32")
+    ys = (xs @ np.array([[1.0], [2.0], [3.0], [4.0]], "float32"))
+    x = layers.data(name="x", shape=[8, 4], append_batch_size=False)
+    y = layers.data(name="y", shape=[8, 1], append_batch_size=False)
+    pred = layers.fc(input=x, size=1)
+    loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for _ in range(60):
+        exe.run(fluid.default_main_program(), feed={"x": xs, "y": ys},
+                fetch_list=[loss])
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+    # reference predictions for the test inputs
+    test_x = rng.rand(8, 4).astype("float32")
+    (want,) = exe.run(fluid.io.get_inference_program([pred]),
+                      feed={"x": test_x}, fetch_list=[pred])
+    return d, test_x, np.asarray(want)
+
+
+class TestPredictor:
+    def test_run(self, model_dir):
+        d, test_x, want = model_dir
+        p = Predictor(d)
+        assert p.feed_names == ["x"]
+        (got,) = p.run({"x": test_x})
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestHTTPServer:
+    def test_predict_roundtrip(self, model_dir):
+        d, test_x, want = model_dir
+        server = InferenceServer(d, port=0)
+        server.start_background()
+        try:
+            host, port = server.addr
+            meta = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/meta", timeout=30).read())
+            assert meta["feeds"] == ["x"]
+            req = urllib.request.Request(
+                f"http://{host}:{port}/predict",
+                data=json.dumps(
+                    {"feeds": {"x": test_x.tolist()}}).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = json.loads(urllib.request.urlopen(
+                req, timeout=60).read())
+            got = np.asarray(resp["outputs"][0], "float32")
+            np.testing.assert_allclose(got, want, rtol=1e-4)
+            health = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/health", timeout=30).read())
+            assert health["status"] == "ok"
+        finally:
+            server.shutdown()
+
+
+class TestCAPI:
+    def test_c_abi_inference(self, model_dir):
+        from paddle_tpu import native
+        lib = native.load_capi()
+        assert lib is not None, "native toolchain expected in image"
+        d, test_x, want = model_dir
+        assert lib.pd_tpu_init() == 0, lib.pd_tpu_last_error()
+        h = lib.pd_tpu_create(d.encode())
+        assert h, lib.pd_tpu_last_error()
+        try:
+            assert lib.pd_tpu_num_feeds(h) == 1
+            assert lib.pd_tpu_feed_name(h, 0) == b"x"
+
+            data = np.ascontiguousarray(test_x)
+            names = (ctypes.c_char_p * 1)(b"x")
+            bufs = (ctypes.c_void_p * 1)(
+                data.ctypes.data_as(ctypes.c_void_p))
+            lens = (ctypes.c_longlong * 1)(data.nbytes)
+            shape = (ctypes.c_longlong * 2)(*data.shape)
+            shapes = (ctypes.POINTER(ctypes.c_longlong) * 1)(shape)
+            ranks = (ctypes.c_int * 1)(2)
+            dtypes = (ctypes.c_char_p * 1)(b"float32")
+            res = lib.pd_tpu_run(h, 1, names, bufs, lens, shapes, ranks,
+                                 dtypes)
+            assert res, lib.pd_tpu_last_error()
+            try:
+                assert lib.pd_tpu_result_count(res) == 1
+                rank = lib.pd_tpu_result_rank(res, 0)
+                out_shape = tuple(lib.pd_tpu_result_dim(res, 0, i)
+                                  for i in range(rank))
+                assert lib.pd_tpu_result_dtype(res, 0) == b"float32"
+                blen = ctypes.c_longlong()
+                ptr = lib.pd_tpu_result_data(res, 0, ctypes.byref(blen))
+                raw = ctypes.string_at(ptr, blen.value)
+                got = np.frombuffer(raw, "float32").reshape(out_shape)
+                np.testing.assert_allclose(got, want, rtol=1e-5)
+            finally:
+                lib.pd_tpu_free_result(res)
+        finally:
+            lib.pd_tpu_destroy(h)
