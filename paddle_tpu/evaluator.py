@@ -14,7 +14,8 @@ from paddle_tpu import layers
 from paddle_tpu.framework import Program, program_guard, unique_name
 from paddle_tpu.layer_helper import LayerHelper
 
-__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance", "DetectionMAP"]
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance", "DetectionMAP",
+           "CTCErrorEvaluator"]
 
 
 def _clone_var(block, var):
@@ -194,3 +195,39 @@ class DetectionMAP(Evaluator):
         for var in self.states[1:]:
             shape = [0 if d is None else max(d, 0) for d in var.shape]
             scope.set_var(var.name, np.zeros(shape, var.dtype))
+
+
+class CTCErrorEvaluator(Evaluator):
+    """Streaming CTC sequence error: ctc_align the network output, then
+    edit-distance against the label, normalized per sequence (reference
+    ``gserver/evaluators/CTCErrorEvaluator.cpp``)."""
+
+    def __init__(self, input, label, blank=0):
+        super().__init__("ctc_error")
+        helper = self.helper
+        self.total_distance = self.create_state(
+            "total_distance", "float32", (1,))
+        self.seq_num = self.create_state("seq_num", "int64", (1,))
+        aligned = helper.create_tmp_variable("int64")
+        helper.append_op(type="ctc_align", inputs={"Input": [input]},
+                         outputs={"Output": [aligned]},
+                         attrs={"blank": blank, "merge_repeated": True})
+        dist = helper.create_tmp_variable("float32")
+        seq_num = helper.create_tmp_variable("int64")
+        helper.append_op(type="edit_distance",
+                         inputs={"Hyps": [aligned], "Refs": [label]},
+                         outputs={"Out": [dist], "SequenceNum": [seq_num]})
+        batch_dist = layers.reduce_sum(dist)
+        layers.sums(input=[self.total_distance, batch_dist],
+                    out=self.total_distance)
+        layers.sums(input=[self.seq_num, seq_num], out=self.seq_num)
+        self.metrics.append(batch_dist)
+
+    def eval(self, executor, eval_program=None):
+        from paddle_tpu.scope import global_scope
+        scope = global_scope()
+        total = float(np.asarray(scope.find_var(
+            self.total_distance.name)).reshape(-1)[0])
+        n = float(np.asarray(scope.find_var(
+            self.seq_num.name)).reshape(-1)[0])
+        return np.array([total / n if n else 0.0])

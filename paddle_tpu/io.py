@@ -23,7 +23,7 @@ from paddle_tpu.scope import global_scope
 __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
-    "load_inference_model", "get_inference_program",
+    "load_inference_model", "save_checkpoint", "load_checkpoint", "get_inference_program",
 ]
 
 
@@ -148,3 +148,77 @@ def load_inference_model(dirname, executor, model_filename=None,
     fetch_vars = [program.global_block().var(n)
                   for n in model["fetch_var_names"]]
     return program, model["feed_var_names"], fetch_vars
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume: sharded (TP-aware) training-state checkpoints
+# (SURVEY.md §5.4).  The reference checkpoints via save_op/load_op files
+# + the Go pserver's CRC'd state (go/pserver/service.go:346); on TPU the
+# state is a pytree of (possibly mesh-sharded) arrays, saved through orbax
+# — each host writes only its addressable shards, so checkpoints scale to
+# multi-host meshes without gathering.
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(executor, dirname, main_program=None, step=0,
+                    scope=None):
+    """Save ALL persistable state (params + optimizer accumulators) plus
+    metadata; sharded arrays are written shard-by-shard (orbax)."""
+    import orbax.checkpoint as ocp
+    import jax
+
+    from paddle_tpu.framework import default_main_program
+    from paddle_tpu.scope import global_scope
+
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    state = {}
+    for var in main_program.global_block().vars.values():
+        if not is_persistable(var):
+            continue
+        v = scope.find_var(var.name)
+        if v is None or not hasattr(v, "dtype"):
+            continue
+        state[var.name] = v
+    path = os.path.abspath(os.path.join(dirname, f"ckpt-{int(step)}"))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
+    with open(os.path.join(dirname, "latest"), "w") as f:
+        f.write(str(int(step)))
+    return path
+
+
+def load_checkpoint(executor, dirname, main_program=None, step=None,
+                    scope=None, shardings=None):
+    """Restore a checkpoint into the scope.  ``shardings``: optional map
+    name -> jax.sharding.Sharding to restore arrays SHARDED onto a mesh
+    (TP-aware resume); unlisted arrays load replicated/host-local."""
+    import orbax.checkpoint as ocp
+    import jax
+
+    from paddle_tpu.framework import default_main_program
+    from paddle_tpu.scope import global_scope
+
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if step is None:
+        with open(os.path.join(dirname, "latest")) as f:
+            step = int(f.read().strip())
+    path = os.path.abspath(os.path.join(dirname, f"ckpt-{int(step)}"))
+    ckptr = ocp.StandardCheckpointer()
+    if shardings:
+        meta = dict(ckptr.metadata(path).item_metadata.tree)
+        targets = {}
+        for name, m in meta.items():
+            sh = shardings.get(name)
+            if sh is not None:
+                targets[name] = jax.ShapeDtypeStruct(m.shape, m.dtype,
+                                                     sharding=sh)
+            else:
+                targets[name] = jax.ShapeDtypeStruct(m.shape, m.dtype)
+        state = ckptr.restore(path, targets)
+    else:
+        state = ckptr.restore(path)
+    for name, value in state.items():
+        scope.set_var(name, value)
+    return int(step)

@@ -61,8 +61,10 @@ extern "C" {
 // Initialize the embedded interpreter (no-op when hosted inside an
 // already-running Python, e.g. when loaded via ctypes).  Returns 0 on ok.
 int pd_tpu_init() {
+  bool we_initialized = false;
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
+    we_initialized = true;
   }
   PyGILState_STATE g = PyGILState_Ensure();
   PyObject* m = serving_module();
@@ -74,6 +76,12 @@ int pd_tpu_init() {
     Py_DECREF(m);
   }
   PyGILState_Release(g);
+  if (we_initialized) {
+    // Py_InitializeEx leaves THIS thread holding the GIL; release it so
+    // any host thread can PyGILState_Ensure in pd_tpu_create/run (the
+    // saved thread state is intentionally kept for the process lifetime).
+    (void)PyEval_SaveThread();
+  }
   return rc;
 }
 

@@ -121,6 +121,19 @@ class MasterService:
             self._requeue_timeouts()
             return not self.todo and not self.pending
 
+    def reset_pass(self):
+        """Re-seed the queue for a new data pass: finished tasks go back
+        to todo (reference master restarts passes the same way when the
+        dataset drains).  Call only when all_done() — a coordinator (e.g.
+        cloud_reader's pass loop) drives this."""
+        with self._lock:
+            if self.todo or self.pending:
+                return False
+            self.todo = self.done
+            self.done = []
+            self._snapshot()
+            return True
+
     def stats(self):
         with self._lock:
             return {"todo": len(self.todo), "pending": len(self.pending),
@@ -199,6 +212,8 @@ class _MasterRPCHandler(socketserver.StreamRequestHandler):
                                              params.get("epoch"))
                 elif method == "all_done":
                     result = svc.all_done()
+                elif method == "reset_pass":
+                    result = svc.reset_pass()
                 elif method == "stats":
                     result = svc.stats()
                 elif method == "ping":
@@ -268,6 +283,9 @@ class MasterClient:
 
     def all_done(self):
         return self._call("all_done")
+
+    def reset_pass(self):
+        return self._call("reset_pass")
 
     def stats(self):
         return self._call("stats")

@@ -67,6 +67,9 @@ def parse_config(config, config_arg_str=None):
     from paddle_tpu.trainer_config_helpers import optimizers as opt_mod
     from paddle_tpu.trainer_config_helpers import data_sources as ds_mod
 
+    # fresh capture context: a previous parse's settings must not leak
+    opt_mod._current = {}
+    ds_mod._current = {}
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         if callable(config):
@@ -77,7 +80,7 @@ def parse_config(config, config_arg_str=None):
     out_vars = result if isinstance(result, (list, tuple)) else \
         ([result] if result is not None else [])
     return TrainerConfig(
-        model=main.global_block().program.to_dict(),
+        model=main.to_dict(),
         startup=startup.to_dict(),
         settings=opt_mod.current_settings(),
         data_sources=ds_mod.current_data_sources(),

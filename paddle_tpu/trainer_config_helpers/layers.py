@@ -59,7 +59,12 @@ def dropout_layer(input, dropout_rate, name=None):
 
 
 def concat_layer(input, act=None, name=None):
-    return _v2.concat(input=input, name=name)
+    out = _v2.concat(input=input, name=name)
+    act_name = _v2._act_name(act)
+    if act_name and act_name not in ("linear", "identity"):
+        from paddle_tpu import layers as F
+        out = getattr(F, act_name)(out)
+    return out
 
 
 def lstmemory(input, size=None, reverse=False, act=None, name=None,
@@ -70,6 +75,9 @@ def lstmemory(input, size=None, reverse=False, act=None, name=None,
 
 def grumemory(input, size=None, reverse=False, act=None, name=None,
               **kwargs):
+    if size is None:
+        # reference DSL infers the hidden size from the [N, 3H] input
+        size = input.shape[-1] // 3
     return _v2.gru(input=input, size=size, reverse=reverse, act=act,
                    **kwargs)
 

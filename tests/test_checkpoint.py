@@ -1,0 +1,92 @@
+"""Sharded checkpoint/resume tests (SURVEY.md §5.4; reference
+save_load_combine_op_test.cc + go/pserver checkpoint semantics):
+full training state round-trips, including optimizer accumulators, and
+TP-sharded params restore with their shardings on the mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from paddle_tpu.parallel import ParallelExecutor
+from paddle_tpu.parallel.mesh import make_mesh
+
+
+def _model():
+    x = layers.data(name="x", shape=[8, 16], append_batch_size=False)
+    y = layers.data(name="y", shape=[8, 1], append_batch_size=False)
+    h = layers.fc(input=x, size=32, act="relu", param_attr="ck_w1")
+    pred = layers.fc(input=h, size=1, param_attr="ck_w2")
+    loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    return loss
+
+
+def _feed(seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.rand(8, 16).astype("float32")
+    return {"x": xs, "y": (xs.sum(1, keepdims=True) * 0.1).astype("float32")}
+
+
+class TestCheckpointResume:
+    def test_full_state_roundtrip(self, tmp_path):
+        loss = _model()
+        main = fluid.default_main_program()
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        for _ in range(5):
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+        step = fluid.io.save_checkpoint(exe, str(tmp_path), main, step=5)
+        assert step.endswith("ckpt-5")
+
+        # continue training 3 more steps from the checkpointed state
+        ref = []
+        for _ in range(3):
+            (lv,) = exe.run(main, feed=_feed(), fetch_list=[loss])
+            ref.append(float(np.asarray(lv).reshape(-1)[0]))
+
+        # fresh scope: restore and repeat the same 3 steps — identical
+        # losses require params AND adam moments to round-trip
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe2 = fluid.Executor()
+            exe2.run(fluid.default_startup_program())
+            got_step = fluid.io.load_checkpoint(exe2, str(tmp_path), main)
+            assert got_step == 5
+            got = []
+            for _ in range(3):
+                (lv,) = exe2.run(main, feed=_feed(), fetch_list=[loss])
+                got.append(float(np.asarray(lv).reshape(-1)[0]))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_sharded_restore_on_mesh(self, tmp_path):
+        loss = _model()
+        main = fluid.default_main_program()
+        mesh = make_mesh((2, 4), ("data", "model"))
+        pexe = ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                                param_shardings=[("ck_w1", P(None, "model")),
+                                                 ("ck_w2", P("model", None))])
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        for _ in range(3):
+            pexe.run(feed=_feed(), fetch_list=[loss])
+        w1_before = np.asarray(fluid.global_scope().find_var("ck_w1"))
+        fluid.io.save_checkpoint(exe, str(tmp_path), main, step=3)
+
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe2 = fluid.Executor()
+            exe2.run(fluid.default_startup_program())
+            shardings = {
+                "ck_w1": NamedSharding(mesh, P(None, "model")),
+                "ck_w2": NamedSharding(mesh, P("model", None)),
+            }
+            fluid.io.load_checkpoint(exe2, str(tmp_path), main,
+                                     shardings=shardings)
+            w1 = scope.find_var("ck_w1")
+            # restored value matches and carries the requested sharding
+            np.testing.assert_allclose(np.asarray(w1), w1_before, rtol=1e-6)
+            assert w1.sharding.spec == P(None, "model"), w1.sharding

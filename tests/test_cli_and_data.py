@@ -223,3 +223,65 @@ class TestV2Image:
         batches = list(v2_image.batch_images(imgs, 2)())
         assert [b.shape[0] for b in batches] == [2, 2, 1]
         assert batches[0].shape[1:] == (3, 24, 24)
+
+
+class TestCloudReader:
+    def test_reads_all_tasks_via_master(self, tmp_path):
+        """cloud_reader drains record files leased from the master service
+        (reference v2 cloud_reader over the etcd master client)."""
+        from paddle_tpu.parallel.master import (MasterServer, MasterService,
+                                                partition_files)
+        from paddle_tpu.recordio_writer import convert_reader_to_recordio_file
+        from paddle_tpu.reader.creator import cloud_reader
+
+        all_samples = set()
+        paths = []
+        for i in range(3):
+            p = str(tmp_path / f"shard-{i}.recordio")
+
+            def samples(i=i):
+                for j in range(5):
+                    yield (f"s{i}-{j}",)
+
+            convert_reader_to_recordio_file(p, samples)
+            all_samples.update(f"s{i}-{j}" for j in range(5))
+            paths.append(p)
+
+        svc = MasterService(partition_files(paths), timeout=30.0)
+        server = MasterServer(svc, port=0)
+        server.start_background()
+        try:
+            addr = f"{server.addr[0]}:{server.addr[1]}"
+            got = {s[0] for s in cloud_reader(addr)()}
+            assert got == all_samples
+            assert svc.stats()["done"] == 3
+        finally:
+            server.shutdown()
+
+
+class TestCTCErrorEvaluator:
+    def test_streaming_error_rate(self):
+        import paddle_tpu.layers as layers
+        # logits whose argmax path after ctc_align equals [1, 2]
+        inp = layers.data(name="inp", shape=[4, 1], append_batch_size=False,
+                          dtype="int64", lod_level=1)
+        lab = layers.data(name="lab", shape=[2, 1], append_batch_size=False,
+                          dtype="int64", lod_level=1)
+        ev = fluid.evaluator.CTCErrorEvaluator(input=inp, label=lab)
+        exe = fluid.Executor()
+        ev.reset(exe)
+        # ctc path: [1, 1, 0, 2] -> merge/blank-strip -> [1, 2]
+        path = np.array([[1], [1], [0], [2]], np.int64)
+        label = np.array([[1], [2]], np.int64)
+        exe.run(fluid.default_main_program(),
+                feed={"inp": (path, [[0, 4]]), "lab": (label, [[0, 2]])},
+                fetch_list=ev.metrics)
+        (avg_dist,) = ev.eval(exe)
+        np.testing.assert_allclose(avg_dist, [0.0])
+        # a wrong label now: distance 1
+        label2 = np.array([[1], [3]], np.int64)
+        exe.run(fluid.default_main_program(),
+                feed={"inp": (path, [[0, 4]]), "lab": (label2, [[0, 2]])},
+                fetch_list=ev.metrics)
+        (avg_dist,) = ev.eval(exe)
+        np.testing.assert_allclose(avg_dist, [0.5])  # (0 + 1) / 2 seqs
