@@ -105,8 +105,9 @@ class EditDistance(Evaluator):
     """Streaming average edit distance + exact-match rate (reference
     ``evaluator.py:180``)."""
 
-    def __init__(self, input, label, ignored_tokens=None):
-        super().__init__("edit_distance")
+    def __init__(self, input, label, ignored_tokens=None,
+                 normalized=False, name="edit_distance"):
+        super().__init__(name)
         self.total_distance = self.create_state(
             "total_distance", "float32", (1,))
         self.seq_num = self.create_state("seq_num", "int64", (1,))
@@ -117,7 +118,8 @@ class EditDistance(Evaluator):
         seq_num = helper.create_tmp_variable("int64")
         helper.append_op(type="edit_distance",
                          inputs={"Hyps": [input], "Refs": [label]},
-                         outputs={"Out": [dist], "SequenceNum": [seq_num]})
+                         outputs={"Out": [dist], "SequenceNum": [seq_num]},
+                         attrs={"normalized": normalized})
         zero = layers.fill_constant(shape=[1], value=0.0, dtype="float32")
         erroneous = helper.create_tmp_variable("int64")
         helper.append_op(type="greater_than",
@@ -197,37 +199,20 @@ class DetectionMAP(Evaluator):
             scope.set_var(var.name, np.zeros(shape, var.dtype))
 
 
-class CTCErrorEvaluator(Evaluator):
-    """Streaming CTC sequence error: ctc_align the network output, then
-    edit-distance against the label, normalized per sequence (reference
-    ``gserver/evaluators/CTCErrorEvaluator.cpp``)."""
+class CTCErrorEvaluator(EditDistance):
+    """Streaming CTC sequence error rate: ctc_align the network output,
+    then LENGTH-NORMALIZED edit distance against the label (reference
+    ``gserver/evaluators/CTCErrorEvaluator.cpp`` accumulates
+    distance/len per sequence) — composed from EditDistance."""
 
     def __init__(self, input, label, blank=0):
-        super().__init__("ctc_error")
-        helper = self.helper
-        self.total_distance = self.create_state(
-            "total_distance", "float32", (1,))
-        self.seq_num = self.create_state("seq_num", "int64", (1,))
+        helper = LayerHelper("ctc_error")
         aligned = helper.create_tmp_variable("int64")
         helper.append_op(type="ctc_align", inputs={"Input": [input]},
                          outputs={"Output": [aligned]},
                          attrs={"blank": blank, "merge_repeated": True})
-        dist = helper.create_tmp_variable("float32")
-        seq_num = helper.create_tmp_variable("int64")
-        helper.append_op(type="edit_distance",
-                         inputs={"Hyps": [aligned], "Refs": [label]},
-                         outputs={"Out": [dist], "SequenceNum": [seq_num]})
-        batch_dist = layers.reduce_sum(dist)
-        layers.sums(input=[self.total_distance, batch_dist],
-                    out=self.total_distance)
-        layers.sums(input=[self.seq_num, seq_num], out=self.seq_num)
-        self.metrics.append(batch_dist)
+        super().__init__(aligned, label, normalized=True, name="ctc_error")
 
     def eval(self, executor, eval_program=None):
-        from paddle_tpu.scope import global_scope
-        scope = global_scope()
-        total = float(np.asarray(scope.find_var(
-            self.total_distance.name)).reshape(-1)[0])
-        n = float(np.asarray(scope.find_var(
-            self.seq_num.name)).reshape(-1)[0])
-        return np.array([total / n if n else 0.0])
+        avg_rate, _ = super().eval(executor, eval_program)
+        return avg_rate

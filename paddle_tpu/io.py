@@ -183,8 +183,11 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, state, force=True)
     ckptr.wait_until_finished()
-    with open(os.path.join(dirname, "latest"), "w") as f:
+    latest = os.path.join(dirname, "latest")
+    tmp = latest + ".tmp"
+    with open(tmp, "w") as f:
         f.write(str(int(step)))
+    os.replace(tmp, latest)  # atomic: a crash mid-save keeps the old ptr
     return path
 
 

@@ -17,7 +17,7 @@ __all__ = [
     "fc", "embedding", "conv2d", "conv2d_transpose", "pool2d", "batch_norm",
     "layer_norm", "dropout", "softmax", "cross_entropy",
     "softmax_with_cross_entropy", "accuracy", "auc", "square_error_cost",
-    "chunk_eval",
+    "chunk_eval", "linear_chain_crf", "crf_decoding",
     "lrn", "l2_normalize", "matmul", "topk", "relu", "one_hot",
     "sigmoid_cross_entropy_with_logits", "smooth_l1", "label_smooth",
     "elementwise_add", "elementwise_sub", "elementwise_mul",
@@ -536,6 +536,56 @@ def nce(input, label, num_total_classes, sample_weight=None,
         attrs={"num_total_classes": int(num_total_classes),
                "num_neg_samples": num_neg_samples})
     return cost / (num_neg_samples + 1)
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """Linear-chain CRF negative log-likelihood (reference ``nn.py``
+    linear_chain_crf over ``linear_chain_crf_op.cc``); creates the
+    [K+2, K] transition parameter (rows 0/1 = start/stop)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=input.dtype)
+    log_likelihood = helper.create_tmp_variable(dtype=input.dtype)
+    alpha = helper.create_tmp_variable(dtype=input.dtype,
+                                       stop_gradient=True)
+    emission_exps = helper.create_tmp_variable(dtype=input.dtype,
+                                               stop_gradient=True)
+    transition_exps = helper.create_tmp_variable(dtype=input.dtype,
+                                                 stop_gradient=True)
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Transition": [transition],
+                "Label": [label]},
+        outputs={"LogLikelihood": [log_likelihood], "Alpha": [alpha],
+                 "EmissionExps": [emission_exps],
+                 "TransitionExps": [transition_exps]})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr=None, label=None):
+    """Viterbi decode with the CRF transition parameter (reference
+    ``nn.py`` crf_decoding over ``crf_decoding_op.cc``)."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    transition = helper.param_attr
+    # reuse the trained transition parameter by name
+    from paddle_tpu.framework import default_main_program
+    block = default_main_program().global_block()
+    trans_var = block.var(transition.name) if transition and \
+        transition.name and block.has_var(transition.name) else None
+    if trans_var is None:
+        size = input.shape[-1]
+        trans_var = helper.create_parameter(
+            attr=helper.param_attr, shape=[size + 2, size],
+            dtype=input.dtype)
+    viterbi_path = helper.create_tmp_variable(dtype="int32",
+                                              stop_gradient=True)
+    inputs = {"Emission": [input], "Transition": [trans_var]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [viterbi_path]})
+    return viterbi_path
 
 
 def chunk_eval(input, label, chunk_scheme, num_chunk_types,

@@ -99,6 +99,7 @@ class MasterService:
             if epoch is not None and epoch != task.epoch:
                 self.pending[task_id] = entry  # stale lease report
                 return False
+            task.failures = 0  # reference: NumFailure resets on success
             self.done.append(task)
             self._snapshot()
             return True
@@ -129,8 +130,12 @@ class MasterService:
         with self._lock:
             if self.todo or self.pending:
                 return False
-            self.todo = self.done
+            # reference service.go: Todo = Done + Failed for the new pass
+            for t in self.failed_drop:
+                t.failures = 0
+            self.todo = self.done + self.failed_drop
             self.done = []
+            self.failed_drop = []
             self._snapshot()
             return True
 

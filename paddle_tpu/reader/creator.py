@@ -75,9 +75,13 @@ def cloud_reader(master_addr, pass_num=1, timeout=30.0):
                         for path in task.chunks:
                             for rec in RecordIOScanner(path):
                                 yield pickle.loads(rec)
-                    except Exception:
-                        client.task_failed(task.id, task.epoch)
+                    except GeneratorExit:
                         raise
+                    except Exception:
+                        # report + continue: the master re-leases the task
+                        # (up to failure_max) to this or another trainer
+                        client.task_failed(task.id, task.epoch)
+                        continue
                     client.task_finished(task.id, task.epoch)
         finally:
             client.close()

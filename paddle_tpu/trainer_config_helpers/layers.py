@@ -75,9 +75,6 @@ def lstmemory(input, size=None, reverse=False, act=None, name=None,
 
 def grumemory(input, size=None, reverse=False, act=None, name=None,
               **kwargs):
-    if size is None:
-        # reference DSL infers the hidden size from the [N, 3H] input
-        size = input.shape[-1] // 3
     return _v2.gru(input=input, size=size, reverse=reverse, act=act,
                    **kwargs)
 

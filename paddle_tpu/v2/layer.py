@@ -111,7 +111,9 @@ def lstmemory(input, size=None, reverse=False, act=None,
     return hidden
 
 
-def gru(input, size, reverse=False, act=None, gate_act=None, **kwargs):
+def gru(input, size=None, reverse=False, act=None, gate_act=None, **kwargs):
+    if size is None:
+        size = input.shape[-1] // 3  # reference DSL infers from [N, 3H]
     return F.dynamic_gru(
         input=input, size=size, is_reverse=reverse,
         gate_activation=_act_name(gate_act) or "sigmoid",

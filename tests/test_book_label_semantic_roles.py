@@ -1,0 +1,139 @@
+"""Book test: semantic role labeling — db_lstm + linear-chain CRF + crf
+decoding + streaming chunk evaluation (reference
+``python/paddle/fluid/tests/book/test_label_semantic_roles.py``, scaled
+down: 2 stacked bidirectional LSTM layers instead of 8, small dims)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from paddle_tpu.dataset import conll05
+
+WORD_DICT = 200     # scaled-down vocab (synthetic data remapped mod this)
+PRED_DICT = 50
+LABEL_DICT = 12
+MARK_DICT = 2
+WORD_DIM = 16
+MARK_DIM = 4
+HIDDEN = 16
+BATCH = 4
+CLIP_LEN = 10       # fixed length => one executable
+
+
+def _db_lstm(word, predicate, ctx_n1, ctx_p1, mark):
+    """Scaled db_lstm: 5 features -> summed projections -> 2 stacked
+    LSTMs with direction flips -> per-token feature logits."""
+    pred_emb = layers.embedding(predicate, size=[PRED_DICT, WORD_DIM],
+                                param_attr="vemb")
+    mark_emb = layers.embedding(mark, size=[MARK_DICT, MARK_DIM])
+    word_embs = [layers.embedding(x, size=[WORD_DICT, WORD_DIM],
+                                  param_attr="srl_emb")
+                 for x in (word, ctx_n1, ctx_p1)]
+    embs = word_embs + [pred_emb, mark_emb]
+    hidden_0 = layers.sums(
+        input=[layers.fc(input=e, size=HIDDEN * 4) for e in embs])
+    lstm_0, _ = layers.dynamic_lstm(
+        hidden_0, size=HIDDEN * 4, candidate_activation="relu",
+        gate_activation="sigmoid", cell_activation="sigmoid")
+
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(1, 2):
+        mix = layers.sums(input=[
+            layers.fc(input=input_tmp[0], size=HIDDEN * 4),
+            layers.fc(input=input_tmp[1], size=HIDDEN * 4)])
+        lstm, _ = layers.dynamic_lstm(
+            mix, size=HIDDEN * 4, candidate_activation="relu",
+            gate_activation="sigmoid", cell_activation="sigmoid",
+            is_reverse=(i % 2) == 1)
+        input_tmp = [mix, lstm]
+
+    feature_out = layers.sums(input=[
+        layers.fc(input=input_tmp[0], size=LABEL_DICT),
+        layers.fc(input=input_tmp[1], size=LABEL_DICT)])
+    return feature_out
+
+
+def _batches(n):
+    reader = conll05.train()
+    got = 0
+    for sample in reader():
+        words, _, ctx_n1, ctx_0, ctx_p1, _, verb, mark, labels = sample
+        if len(words) < CLIP_LEN:
+            continue
+
+        def clip(xs, mod):
+            return [int(v) % mod for v in xs[:CLIP_LEN]]
+
+        yield (clip(words, WORD_DICT), clip(ctx_n1, WORD_DICT),
+               clip(ctx_p1, WORD_DICT), clip(verb, PRED_DICT),
+               clip(mark, MARK_DICT), clip(labels, LABEL_DICT))
+        got += 1
+        if got >= n:
+            return
+
+
+def _stack(batch):
+    cols = list(zip(*batch))
+    lod = [list(range(0, (BATCH * CLIP_LEN) + 1, CLIP_LEN))]
+    return [(np.asarray(c, "int64").reshape(-1, 1), lod) for c in cols], lod
+
+
+class TestLabelSemanticRoles:
+    def test_crf_training_and_chunk_eval(self):
+        def seq_data(name):
+            return layers.data(name=name, shape=[BATCH * CLIP_LEN, 1],
+                               append_batch_size=False, dtype="int64",
+                               lod_level=1)
+
+        word = seq_data("word")
+        ctx_n1 = seq_data("ctx_n1")
+        ctx_p1 = seq_data("ctx_p1")
+        predicate = seq_data("verb")
+        mark = seq_data("mark")
+        target = seq_data("target")
+
+        feature_out = _db_lstm(word, predicate, ctx_n1, ctx_p1, mark)
+        crf_cost = layers.linear_chain_crf(
+            input=feature_out, label=target,
+            param_attr=fluid.ParamAttr(name="crfw"))
+        avg_cost = layers.mean(crf_cost)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+        # decode path + streaming chunk evaluator (IOB over 5 chunk types)
+        crf_decode = layers.crf_decoding(
+            input=feature_out, param_attr=fluid.ParamAttr(name="crfw"))
+        evaluator = fluid.evaluator.ChunkEvaluator(
+            input=crf_decode, label=target, chunk_scheme="IOB",
+            num_chunk_types=(LABEL_DICT - 2) // 2)
+
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        evaluator.reset(exe)
+
+        batches = [_stack(b) for b in _chunks(_batches(6 * BATCH), BATCH)]
+        losses = []
+        for epoch in range(3):
+            for cols, lod in batches:
+                feed = dict(zip(("word", "ctx_n1", "ctx_p1", "verb",
+                                 "mark", "target"), cols))
+                out = exe.run(fluid.default_main_program(), feed=feed,
+                              fetch_list=[avg_cost] + evaluator.metrics)
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        assert np.isfinite(losses).all()
+        n = len(batches)
+        assert np.mean(losses[-n:]) < np.mean(losses[:n]), (
+            np.mean(losses[:n]), np.mean(losses[-n:]))
+
+        precision, recall, f1 = evaluator.eval(exe)
+        assert 0.0 <= float(precision[0]) <= 1.0
+        assert 0.0 <= float(f1[0]) <= 1.0
+
+
+def _chunks(it, size):
+    buf = []
+    for x in it:
+        buf.append(x)
+        if len(buf) == size:
+            yield buf
+            buf = []

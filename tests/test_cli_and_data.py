@@ -284,4 +284,5 @@ class TestCTCErrorEvaluator:
                 feed={"inp": (path, [[0, 4]]), "lab": (label2, [[0, 2]])},
                 fetch_list=ev.metrics)
         (avg_dist,) = ev.eval(exe)
-        np.testing.assert_allclose(avg_dist, [0.5])  # (0 + 1) / 2 seqs
+        # length-normalized rates: (0/2 + 1/2) / 2 seqs = 0.25
+        np.testing.assert_allclose(avg_dist, [0.25])
