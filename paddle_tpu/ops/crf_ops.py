@@ -153,5 +153,12 @@ def crf_decoding_lower(ctx: LowerContext):
     flat = tags_bt.reshape(-1)[jnp.asarray(scatter)]
     # label path correction: positions past each length hold stale tags
     # but scatter only addresses valid rows, so flat is exact
-    ctx.set_output("ViterbiPath", flat.reshape(-1, 1).astype(jnp.int32))
+    path = flat.reshape(-1, 1).astype(jnp.int32)
+    label = ctx.input("Label")
+    if label is not None:
+        # reference crf_decoding_op.h: with Label given, the output is a
+        # per-token 0/1 correctness indicator, not the tag ids
+        path = (path == label.reshape(-1, 1).astype(jnp.int32)) \
+            .astype(jnp.int32)
+    ctx.set_output("ViterbiPath", path)
     ctx.set_output_lod("ViterbiPath", [list(l) for l in lod])

@@ -16,6 +16,8 @@ __all__ = [
     "dropout", "concat", "lstmemory", "gru", "pooling", "last_seq",
     "first_seq", "classification_cost", "cross_entropy_cost",
     "square_error_cost", "mse_cost", "regression_cost",
+    "crf", "crf_decoding", "max_id", "rank_cost", "huber_cost",
+    "seq_concat", "expand", "scaling", "slope_intercept",
     "pooling_types",
 ]
 
@@ -181,3 +183,70 @@ def square_error_cost(input, label, name=None):
 
 mse_cost = square_error_cost
 regression_cost = square_error_cost
+
+
+# --- additional legacy layer types (gserver/layers parity subset) --------
+
+def crf(input, label, size=None, param_attr=None, name=None):
+    """CRF cost layer (reference v2 crf_layer over CRFLayer.cpp)."""
+    from paddle_tpu.param_attr import ParamAttr as _PA
+    return F.linear_chain_crf(input=input, label=label,
+                              param_attr=_PA.to_attr(param_attr))
+
+
+def crf_decoding(input, size=None, label=None, param_attr=None, name=None):
+    """CRF viterbi decode layer (reference v2 crf_decoding_layer)."""
+    from paddle_tpu.param_attr import ParamAttr as _PA
+    return F.crf_decoding(input=input, param_attr=_PA.to_attr(param_attr),
+                          label=label)
+
+
+def max_id(input, name=None):
+    """Argmax over the last axis (reference v2 maxid_layer)."""
+    return F.argmax(input, axis=-1)
+
+
+def rank_cost(left, right, label, name=None):
+    """Pairwise rank cost (reference v2 rank_cost over rank_loss_op)."""
+    from paddle_tpu.layer_helper import LayerHelper
+    helper = LayerHelper("rank_cost", name=name)
+    out = helper.create_tmp_variable(left.dtype)
+    helper.append_op(type="rank_loss",
+                     inputs={"Left": [left], "Right": [right],
+                             "Label": [label]},
+                     outputs={"Out": [out]})
+    return F.mean(out)
+
+
+def huber_cost(input, label, delta=1.0, name=None):
+    """Huber regression cost (reference v2 huber_cost over huber_loss_op)."""
+    from paddle_tpu.layer_helper import LayerHelper
+    helper = LayerHelper("huber_cost", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    residual = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="huber_loss",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out], "Residual": [residual]},
+                     attrs={"delta": delta})
+    return F.mean(out)
+
+
+def seq_concat(a, b, name=None):
+    """Per-sequence concatenation (reference v2 seq_concat_layer)."""
+    return F.sequence_concat(input=[a, b])
+
+
+def expand(input, expand_as, name=None):
+    """Repeat rows to match another sequence's lod (reference v2
+    expand_layer over sequence_expand)."""
+    return F.sequence_expand(x=input, y=expand_as)
+
+
+def scaling(input, weight, name=None):
+    """Per-row scaling (reference v2 scaling_layer)."""
+    return F.elementwise_mul(input, weight, axis=0)
+
+
+def slope_intercept(input, slope=1.0, intercept=0.0, name=None):
+    """y = slope*x + intercept (reference v2 slope_intercept_layer)."""
+    return F.scale(input, scale=slope, bias=intercept)

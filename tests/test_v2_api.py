@@ -5,6 +5,7 @@ import io
 
 import numpy as np
 
+import paddle_tpu as fluid
 import paddle_tpu.v2 as paddle
 
 
@@ -107,3 +108,76 @@ def test_v2_sequence_model():
                   event_handler=handler,
                   feeding={"words": 0, "label": 1})
     assert costs[-1] < costs[0], (costs[0], costs[-1])
+
+
+class TestV2ExtendedLayers:
+    """Legacy gserver layer-type subset added for V4 parity: crf, max_id,
+    rank_cost, huber_cost, scaling, slope_intercept."""
+
+    def test_crf_tagging_path(self):
+        import paddle_tpu.layers as F
+        from paddle_tpu.v2 import layer as v2l
+        em = F.data(name="em", shape=[6, 4], append_batch_size=False,
+                    lod_level=1)
+        lab = F.data(name="lab", shape=[6, 1], append_batch_size=False,
+                     dtype="int64", lod_level=1)
+        cost = v2l.crf(input=em, label=lab,
+                       param_attr=fluid.ParamAttr(name="v2crfw"))
+        decoded = v2l.crf_decoding(input=em,
+                                   param_attr=fluid.ParamAttr(name="v2crfw"))
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        lod = [[0, 3, 6]]
+        nll, path = exe.run(
+            fluid.default_main_program(),
+            feed={"em": (rng.rand(6, 4).astype("float32"), lod),
+                  "lab": (rng.randint(0, 4, (6, 1)).astype("int64"), lod)},
+            fetch_list=[cost, decoded])
+        assert np.isfinite(np.asarray(nll)).all()
+        assert np.asarray(path).shape == (6, 1) or \
+            np.asarray(path).size == 6
+
+    def test_misc_layers(self):
+        import paddle_tpu.layers as F
+        from paddle_tpu.v2 import layer as v2l
+        x = F.data(name="x", shape=[4, 5], append_batch_size=False)
+        mid = v2l.max_id(v2l.fc(input=x, size=3, act="softmax"))
+        si = v2l.slope_intercept(x, slope=2.0, intercept=1.0)
+        w = F.data(name="w", shape=[4, 1], append_batch_size=False)
+        sc = v2l.scaling(x, w)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(1)
+        xv = rng.rand(4, 5).astype("float32")
+        wv = rng.rand(4, 1).astype("float32")
+        mv, sv, scv = exe.run(fluid.default_main_program(),
+                              feed={"x": xv, "w": wv},
+                              fetch_list=[mid, si, sc])
+        assert np.asarray(mv).shape[0] == 4
+        np.testing.assert_allclose(np.asarray(sv), xv * 2.0 + 1.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(scv), xv * wv, rtol=1e-6)
+
+    def test_cost_layers(self):
+        import paddle_tpu.layers as F
+        from paddle_tpu.v2 import layer as v2l
+        left = F.data(name="l", shape=[4, 1], append_batch_size=False)
+        right = F.data(name="r", shape=[4, 1], append_batch_size=False)
+        lab = F.data(name="lb", shape=[4, 1], append_batch_size=False)
+        rc = v2l.rank_cost(left, right, lab)
+        x = F.data(name="hx", shape=[4, 1], append_batch_size=False)
+        y = F.data(name="hy", shape=[4, 1], append_batch_size=False)
+        hc = v2l.huber_cost(x, y)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(2)
+        out = exe.run(fluid.default_main_program(),
+                      feed={"l": rng.rand(4, 1).astype("float32"),
+                            "r": rng.rand(4, 1).astype("float32"),
+                            "lb": (rng.rand(4, 1) > 0.5).astype("float32"),
+                            "hx": rng.rand(4, 1).astype("float32"),
+                            "hy": rng.rand(4, 1).astype("float32")},
+                      fetch_list=[rc, hc])
+        for v in out:
+            assert np.isfinite(np.asarray(v)).all()
