@@ -18,6 +18,7 @@ __all__ = [
     "layer_norm", "dropout", "softmax", "cross_entropy",
     "softmax_with_cross_entropy", "accuracy", "auc", "square_error_cost",
     "chunk_eval", "linear_chain_crf", "crf_decoding",
+    "rank_loss", "huber_loss",
     "lrn", "l2_normalize", "matmul", "topk", "relu", "one_hot",
     "sigmoid_cross_entropy_with_logits", "smooth_l1", "label_smooth",
     "elementwise_add", "elementwise_sub", "elementwise_mul",
@@ -536,6 +537,29 @@ def nce(input, label, num_total_classes, sample_weight=None,
         attrs={"num_total_classes": int(num_total_classes),
                "num_neg_samples": num_neg_samples})
     return cost / (num_neg_samples + 1)
+
+
+def rank_loss(left, right, label, name=None):
+    """Pairwise rank loss (reference ``rank_loss_op.cc``)."""
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_tmp_variable(left.dtype)
+    helper.append_op(type="rank_loss",
+                     inputs={"Left": [left], "Right": [right],
+                             "Label": [label]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def huber_loss(input, label, delta=1.0, name=None):
+    """Huber regression loss (reference ``huber_loss_op.cc``)."""
+    helper = LayerHelper("huber_loss", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    residual = helper.create_tmp_variable(input.dtype, stop_gradient=True)
+    helper.append_op(type="huber_loss",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out], "Residual": [residual]},
+                     attrs={"delta": delta})
+    return out
 
 
 def linear_chain_crf(input, label, param_attr=None):

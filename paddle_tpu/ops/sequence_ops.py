@@ -181,6 +181,23 @@ def sequence_expand_lower(ctx: LowerContext):
     x = ctx.input("X")
     x_lod = ctx.input_lod("X")
     y_lod = _require_lod(ctx, "Y")
+    if _is_dyn(y_lod):
+        # bucketed mode, dense-x case (the attention-context pattern:
+        # one row per sequence broadcast back over its tokens); ragged-x
+        # sub-sequence expansion has data-dependent output rows
+        if x_lod is not None:
+            raise NotImplementedError(
+                "sequence_expand with a ragged X is not supported in "
+                "bucketed dynamic-LoD mode")
+        y_arr = ctx.input("Y")
+        n = y_arr.shape[0]
+        seg, _, num, _, valid = _segment_tables(ctx, y_lod, n)
+        safe = jnp.minimum(seg, num - 1)
+        out = jnp.where(valid[(...,) + (None,) * (x.ndim - 1)],
+                        x[safe], 0)
+        ctx.set_output("Out", out)
+        ctx.set_output_lod("Out", y_lod)
+        return
     ref_level = ctx.attr("ref_level", -1)
     if ref_level == -1:
         ref_level = len(y_lod) - 1
@@ -239,12 +256,33 @@ def sequence_concat_lower(ctx: LowerContext):
     ctx.set_output_lod("Out", [new_splits])
 
 
-@register_op("sequence_reshape", infer_shape=_infer_skip)
+def _infer_seq_reshape(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape = (-1, op.attr("new_dim"))
+    out.dtype = x.dtype
+    out.lod_level = x.lod_level
+
+
+@register_op("sequence_reshape", infer_shape=_infer_seq_reshape)
 def sequence_reshape_lower(ctx: LowerContext):
     x = ctx.input("X")
     lod = _require_lod(ctx)
     new_dim = ctx.attr("new_dim")
     out = x.reshape(-1, new_dim)
+    if _is_dyn(lod):
+        # runtime splits scale by the same static ratio; padding rows
+        # stay at the tail (zeros reshaped are zeros)
+        from paddle_tpu.lod import DynLoD
+        ratio_num, ratio_den = x.shape[1], new_dim
+        splits = lod.splits(ctx.env) * ratio_num // ratio_den
+        scaled_name = ctx.op.output("Out")[0] + "@lod0"
+        ctx.outputs[scaled_name] = splits.astype(jnp.int32)
+        ctx.set_output_lod(
+            "Out", DynLoD(scaled_name, lod.num_seqs,
+                          lod.maxlen_bucket * ratio_num // ratio_den))
+        ctx.set_output("Out", out)
+        return
     ratio = x.shape[1] / new_dim
     splits = [int(s * ratio) for s in lod[0]]
     ctx.set_output("Out", out)

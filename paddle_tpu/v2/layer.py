@@ -188,10 +188,11 @@ regression_cost = square_error_cost
 # --- additional legacy layer types (gserver/layers parity subset) --------
 
 def crf(input, label, size=None, param_attr=None, name=None):
-    """CRF cost layer (reference v2 crf_layer over CRFLayer.cpp)."""
+    """CRF cost layer (reference v2 crf_layer over CRFLayer.cpp); like
+    every v2 cost layer, returns the scalar mean cost."""
     from paddle_tpu.param_attr import ParamAttr as _PA
-    return F.linear_chain_crf(input=input, label=label,
-                              param_attr=_PA.to_attr(param_attr))
+    return F.mean(F.linear_chain_crf(input=input, label=label,
+                                     param_attr=_PA.to_attr(param_attr)))
 
 
 def crf_decoding(input, size=None, label=None, param_attr=None, name=None):
@@ -208,27 +209,12 @@ def max_id(input, name=None):
 
 def rank_cost(left, right, label, name=None):
     """Pairwise rank cost (reference v2 rank_cost over rank_loss_op)."""
-    from paddle_tpu.layer_helper import LayerHelper
-    helper = LayerHelper("rank_cost", name=name)
-    out = helper.create_tmp_variable(left.dtype)
-    helper.append_op(type="rank_loss",
-                     inputs={"Left": [left], "Right": [right],
-                             "Label": [label]},
-                     outputs={"Out": [out]})
-    return F.mean(out)
+    return F.mean(F.rank_loss(left, right, label, name=name))
 
 
 def huber_cost(input, label, delta=1.0, name=None):
     """Huber regression cost (reference v2 huber_cost over huber_loss_op)."""
-    from paddle_tpu.layer_helper import LayerHelper
-    helper = LayerHelper("huber_cost", name=name)
-    out = helper.create_tmp_variable(input.dtype)
-    residual = helper.create_tmp_variable(input.dtype)
-    helper.append_op(type="huber_loss",
-                     inputs={"X": [input], "Y": [label]},
-                     outputs={"Out": [out], "Residual": [residual]},
-                     attrs={"delta": delta})
-    return F.mean(out)
+    return F.mean(F.huber_loss(input, label, delta=delta, name=name))
 
 
 def seq_concat(a, b, name=None):

@@ -45,6 +45,16 @@ def _build_seq_model(kind, n_rows_hint=64, dim=8):
                                     bias_attr="b_gru")
         out = layers.fc(input=layers.sequence_pool(hidden, "max"), size=1,
                         param_attr="w_out")
+    elif kind == "expand":
+        # pool -> expand back over tokens -> residual mix (the
+        # attention-context pattern) -> pool
+        pooled = layers.sequence_pool(x, "average")
+        ctx_feat = layers.fc(input=pooled, size=dim, param_attr="w_ctx")
+        expanded = layers.sequence_expand(x=ctx_feat, y=x)
+        mixed = layers.elementwise_add(x, expanded)
+        reshaped = layers.sequence_reshape(mixed, new_dim=dim // 2)
+        out = layers.fc(input=layers.sequence_pool(reshaped, "sum"),
+                        size=1, param_attr="w_out")
     elif kind == "conv":
         h = layers.sequence_conv(x, num_filters=6, filter_size=3,
                                  param_attr="w_sc", bias_attr="b_sc")
@@ -55,7 +65,7 @@ def _build_seq_model(kind, n_rows_hint=64, dim=8):
 
 
 class TestBucketedEqualsStatic:
-    @pytest.mark.parametrize("kind", ["pool_chain", "lstm", "gru", "conv"])
+    @pytest.mark.parametrize("kind", ["pool_chain", "lstm", "gru", "conv", "expand"])
     def test_forward_parity(self, kind):
         rng = np.random.RandomState(0)
         batch, dim = 4, 8
