@@ -5,8 +5,13 @@ Prints ONE JSON line:
    "unit": "tokens/sec", "vs_baseline": R}
 
 ``vs_baseline`` is achieved MFU / 0.45 — the BASELINE.json north-star target
-(Transformer-base >=45% MFU).  MFU uses the dense-transformer estimate
-6*params + attention FLOPs per token against the chip's peak.
+(Transformer-base >=45% MFU).  MFU uses 6*matmul_params + attention FLOPs
+per token against the chip's peak, where matmul_params excludes the input
+embeddings (gather, not matmul) and layernorm scale/bias — see
+``models.transformer.matmul_param_count``.  Timing is the median of
+``PADDLE_TPU_BENCH_TRIALS`` (default 5) measured trials after warmup; when
+the trial spread exceeds 3x (a transient hit the chip) a second round is
+run and merged before taking the median.
 """
 
 from __future__ import annotations
@@ -76,7 +81,9 @@ def main():
         recordio_path = os.path.join(tempfile.mkdtemp(), "bench.recordio")
 
         def _samples():
-            # one record per STEP batch, repeated for warmup+measure calls
+            # one record per STEP batch; the file holds warmup_calls+1
+            # passes and the reader's pass_num=10**6 REWINDS it, which is
+            # what keeps measured trials 2..N supplied with data
             for _ in range(warmup_calls + 1):
                 for b in batches:
                     yield tuple(b[k] for k in keys)
@@ -122,21 +129,52 @@ def main():
             stacked = {k: jax.device_put(np.stack([b[k] for b in batches]))
                        for k in batches[0]}
         for _ in range(warmup_calls):
-            losses = exe.run_steps(main_prog, feed=stacked,
-                                   fetch_list=[avg_cost.name], steps=steps)
-        t0 = time.perf_counter()
-        losses = exe.run_steps(main_prog, feed=stacked,
-                               fetch_list=[avg_cost.name], steps=steps)
-        dt = time.perf_counter() - t0
-        loss = np.asarray(losses[0])[-1]
+            exe.run_steps(main_prog, feed=stacked,
+                          fetch_list=[avg_cost.name], steps=steps)
+        # Robustness: a single-trial measurement on a shared chip can be
+        # poisoned by transient contention (a 19x-slow wall clock was
+        # observed once with bit-identical numerics).  Run several trials
+        # and report the median; print per-trial stats to stderr.
+        n_trials = int(os.environ.get("PADDLE_TPU_BENCH_TRIALS", "5"))
+        last_losses = [None]
+
+        def measure_round():
+            dts = []
+            for _ in range(max(1, n_trials)):
+                t0 = time.perf_counter()
+                # run_steps returns numpy (return_numpy=True), which blocks
+                # on the device — no extra sync needed before the clock.
+                last_losses[0] = exe.run_steps(
+                    main_prog, feed=stacked,
+                    fetch_list=[avg_cost.name], steps=steps)
+                dts.append(time.perf_counter() - t0)
+            return dts
+
+        trial_dts = measure_round()
+        # If spread is wild (a transient hit several trials), run a second
+        # round and merge before taking the median.
+        if len(trial_dts) >= 3 and max(trial_dts) > 3 * min(trial_dts):
+            trial_dts += measure_round()
+        dt = float(np.median(trial_dts))
+        loss = np.asarray(last_losses[0][0])[-1]
 
     tokens = batch * seq * steps  # target-side tokens, the NMT convention
     tokens_per_sec = tokens / dt
 
-    # FLOPs/token: 6*params (fwd+bwd matmuls) + self/cross attention terms
+    # FLOPs/token (honest accounting):
+    #  * 6*N_matmul — fwd (2N) + bwd (4N) for every parameter that is a
+    #    matmul operand.  Input embeddings are EXCLUDED (gather/scatter,
+    #    not matmul); the output projection is included.  With
+    #    src_len == trg_len, each counted (target) token pairs with one
+    #    source token, so encoder work per counted token is the full
+    #    encoder stack — 6*N over enc+dec params is exact.
+    #  * attention: 3 modules/layer (enc-self per src token, dec-self and
+    #    cross per trg token).  Each is QK^T + AV = 2 matmuls of
+    #    2*S*d_model FLOPs/token fwd; bwd is 2x fwd => 12*S*d per module.
     n_params = T.param_count(hp)
-    attn_flops = 12 * hp.n_layer * 2 * seq * hp.d_model  # QK^T + AV, f+b
-    flops_per_token = 6 * n_params + attn_flops
+    n_matmul = T.matmul_param_count(hp)
+    attn_flops = 12 * seq * hp.d_model * (3 * hp.n_layer)
+    flops_per_token = 6 * n_matmul + attn_flops
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
 
     print(json.dumps({
@@ -145,9 +183,12 @@ def main():
         "unit": "tokens/sec",
         "vs_baseline": round(mfu / 0.45, 4),
     }))
+    step_mss = ", ".join(f"{t / steps * 1e3:.1f}" for t in trial_dts)
     print(f"# loss={float(np.asarray(loss).reshape(()))}"
           f" mfu={mfu:.3f} params={n_params / 1e6:.1f}M"
-          f" step_ms={dt / steps * 1e3:.1f}", file=sys.stderr)
+          f" matmul_params={n_matmul / 1e6:.1f}M"
+          f" step_ms_median={dt / steps * 1e3:.1f}"
+          f" trials=[{step_mss}]", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -335,14 +335,30 @@ def fake_batch(batch_size, src_len, trg_len, hp: ModelHyperParams = None,
 
 
 def param_count(hp: ModelHyperParams = None):
-    """Approximate dense parameter count (for MFU estimates)."""
+    """Approximate dense parameter count: the matmul params plus the
+    embedding tables and the per-layer layernorm scale/bias terms
+    (2 layernorms/encoder layer, 3/decoder layer, 2 params each of
+    width d)."""
+    hp = hp or ModelHyperParams()
+    d = hp.d_model
+    emb = (hp.src_vocab_size + hp.trg_vocab_size) * d
+    layernorm = hp.n_layer * (4 * d + 6 * d)
+    return matmul_param_count(hp) + emb + layernorm
+
+
+def matmul_param_count(hp: ModelHyperParams = None):
+    """Parameters that participate in matmuls — the honest basis for the
+    6N-FLOPs/token MFU estimate.  Excludes the input embedding tables
+    (their forward is a gather, not a matmul; their backward is a
+    scatter-add) and the layernorm scale/bias terms (elementwise), but
+    includes the output projection, which IS a matmul.
+    """
     hp = hp or ModelHyperParams()
     d, dff = hp.d_model, hp.d_inner_hid
-    per_enc = 4 * d * d + 2 * d * dff + 4 * d
-    per_dec = 8 * d * d + 2 * d * dff + 6 * d
-    emb = (hp.src_vocab_size + hp.trg_vocab_size) * d
+    per_enc = 4 * d * d + 2 * d * dff
+    per_dec = 8 * d * d + 2 * d * dff
     proj = d * hp.trg_vocab_size
-    return hp.n_layer * (per_enc + per_dec) + emb + proj
+    return hp.n_layer * (per_enc + per_dec) + proj
 
 
 def tp_shardings():
