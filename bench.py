@@ -40,8 +40,37 @@ def peak_flops_per_chip():
     return 1e12  # CPU fallback; MFU number will be meaningless but finite
 
 
+def measure_trials(run_once, n_trials=None):
+    """Robust wall-clock measurement shared by all benchmarks: time
+    ``n_trials`` calls of ``run_once`` (default from PADDLE_TPU_BENCH_TRIALS,
+    5); when the spread exceeds 3x (a transient hit the shared chip), run
+    one more round and merge before taking the median.  ``run_once`` must
+    block until device completion.  Returns (median_seconds, all_trials).
+    """
+    import os
+    if n_trials is None:
+        n_trials = int(os.environ.get("PADDLE_TPU_BENCH_TRIALS", "5"))
+
+    def one_round():
+        dts = []
+        for _ in range(max(1, n_trials)):
+            t0 = time.perf_counter()
+            run_once()
+            dts.append(time.perf_counter() - t0)
+        return dts
+
+    trial_dts = one_round()
+    if len(trial_dts) >= 2 and max(trial_dts) > 3 * min(trial_dts):
+        trial_dts += one_round()
+    return float(np.median(trial_dts)), trial_dts
+
+
 def main():
     import os
+    if os.environ.get("PADDLE_TPU_BENCH_MODEL", "transformer") == "resnet":
+        import bench_resnet
+        bench_resnet.main()
+        return
     import jax
     # optional precision override (measured per-chip; f32 already uses the
     # MXU via bf16 passes on TPU)
@@ -135,27 +164,16 @@ def main():
         # poisoned by transient contention (a 19x-slow wall clock was
         # observed once with bit-identical numerics).  Run several trials
         # and report the median; print per-trial stats to stderr.
-        n_trials = int(os.environ.get("PADDLE_TPU_BENCH_TRIALS", "5"))
         last_losses = [None]
 
-        def measure_round():
-            dts = []
-            for _ in range(max(1, n_trials)):
-                t0 = time.perf_counter()
-                # run_steps returns numpy (return_numpy=True), which blocks
-                # on the device — no extra sync needed before the clock.
-                last_losses[0] = exe.run_steps(
-                    main_prog, feed=stacked,
-                    fetch_list=[avg_cost.name], steps=steps)
-                dts.append(time.perf_counter() - t0)
-            return dts
+        def run_once():
+            # run_steps returns numpy (return_numpy=True), which blocks
+            # on the device — no extra sync needed before the clock.
+            last_losses[0] = exe.run_steps(
+                main_prog, feed=stacked,
+                fetch_list=[avg_cost.name], steps=steps)
 
-        trial_dts = measure_round()
-        # If spread is wild (a transient hit several trials), run a second
-        # round and merge before taking the median.
-        if len(trial_dts) >= 3 and max(trial_dts) > 3 * min(trial_dts):
-            trial_dts += measure_round()
-        dt = float(np.median(trial_dts))
+        dt, trial_dts = measure_trials(run_once)
         loss = np.asarray(last_losses[0][0])[-1]
 
     tokens = batch * seq * steps  # target-side tokens, the NMT convention

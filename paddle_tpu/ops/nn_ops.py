@@ -55,11 +55,14 @@ def _conv2d_lower_impl(ctx, depthwise=False):
     if depthwise:
         groups = x.shape[1]
     pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    # NOTE: no preferred_element_type=f32 here — the TPU MXU accumulates
+    # bf16 convs in f32 regardless, and requesting an f32 output makes the
+    # conv's transpose rule pair an f32 cotangent with a bf16 operand
+    # (dtype-mismatch TypeError under AMP training).
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad,
         rhs_dilation=dilations, feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
     ctx.set_output("Output", out.astype(x.dtype))
 
 

@@ -1,0 +1,58 @@
+"""ResNet-50 benchmark harness tests (reference ``benchmark/fluid/resnet.py``
++ ``run.sh``): the analytic FLOP walker and an AMP training smoke of the
+bench's exact program shape (tiny config, CPU)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+# repo root (for the bench modules), independent of checkout location
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_program_matmul_flops_counts_conv_and_fc():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from bench_resnet import program_matmul_flops
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 3, 8, 8], dtype="float32",
+                        append_batch_size=False)
+        y = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                          bias_attr=False)  # out (2,4,8,8)
+        flat = layers.reshape(y, shape=[2, 4 * 8 * 8])
+        out = layers.fc(flat, size=5)
+    flops = program_matmul_flops(main.global_block())
+    conv = 2 * 2 * 8 * 8 * 4 * 3 * 3 * 3       # 2*N*Ho*Wo*Co*Ci*kh*kw
+    fc = 2 * 2 * (4 * 8 * 8) * 5               # 2*M*K*N
+    assert flops == conv + fc, (flops, conv, fc)
+
+
+def test_resnet_amp_train_step_runs_and_learns():
+    # the exact bench program (resnet_train_program + Momentum + amp) at
+    # the bench's own CPU smoke config; guards the conv AMP path whose
+    # preferred_element_type transpose mismatch broke bf16 training
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet as R
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cost, acc, feeds = R.resnet_train_program(
+            4, class_dim=10, depth=18, image_shape=(3, 32, 32))
+        fluid.optimizer.Momentum(learning_rate=0.01,
+                                 momentum=0.9).minimize(cost)
+    main.amp = True  # bf16 compute path even on CPU
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.rand(4, 3, 32, 32).astype("float32"),
+            "label": rng.randint(0, 10, size=(4, 1)).astype("int64")}
+    losses = []
+    for _ in range(6):
+        (l,) = exe.run(main, feed=feed, fetch_list=[cost.name])
+        losses.append(float(np.asarray(l).reshape(())))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
