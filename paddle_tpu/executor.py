@@ -191,7 +191,13 @@ def lower_block(block, env, rng_key, training, aux):
             with _profiler.record_op(op.type, ctx):
                 opdef.lower(ctx)
         else:
-            opdef.lower(ctx)
+            # named_scope is trace-time-only: XLA carries it into every
+            # emitted HLO op's metadata, so XProf traces of the COMPILED
+            # step attribute device time back to IR ops (reference
+            # platform/profiler.h RecordEvent — here the attribution
+            # survives jit; see profiler.compiled_op_table)
+            with jax.named_scope(_profiler.op_scope_name(op)):
+                opdef.lower(ctx)
         env.update(ctx.outputs)
         _share_lod(op, ctx, env, aux)
         if release is not None:
@@ -564,7 +570,8 @@ class Executor:
 
         training = not program._is_inference
         from paddle_tpu import profiler as _profiler
-        interpret = _has_host_ops(block)
+        interpret = _has_host_ops(
+            block, dyn=_lod_buckets_enabled(program))
         if interpret:
             _warn_host_op_cliff(program, block)
         interpret = interpret or _profiler.op_profiling_enabled()
@@ -778,13 +785,16 @@ def _warn_host_op_cliff(program, block):
         f"compiled", stacklevel=3)
 
 
-def _has_host_ops(block):
+def _has_host_ops(block, dyn=False):
+    """``dyn=True`` (bucketed dynamic-LoD mode): ops whose bucketed
+    branch is fully traced (``host_dyn_ok``) do not force interpret."""
     for op in block.ops:
         opdef = registry.lookup(op.type)
-        if opdef is not None and opdef.host:
+        if opdef is not None and opdef.host and \
+                not (dyn and opdef.host_dyn_ok):
             return True
         for a in op.attrs.values():
-            if isinstance(a, framework.Block) and _has_host_ops(a):
+            if isinstance(a, framework.Block) and _has_host_ops(a, dyn):
                 return True
     return False
 

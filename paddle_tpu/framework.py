@@ -97,6 +97,24 @@ def unique_name(key):
     return _name_generator(key)
 
 
+@contextlib.contextmanager
+def unique_name_scope(prefix):
+    """Deterministic name scope: inside the guard, generated names restart
+    from zero under ``prefix`` — so re-running the same layer-building code
+    in the guard reproduces IDENTICAL parameter names, which is how
+    unrolled decode loops (legacy ``beam_search``) share weights across
+    timesteps.  Distinct prefixes keep scopes from colliding with the
+    outer program's names."""
+    global _name_generator
+    saved = _name_generator
+    fresh = _UniqueNameGenerator()
+    _name_generator = lambda key: fresh(f"{prefix}{key}")
+    try:
+        yield
+    finally:
+        _name_generator = saved
+
+
 GRAD_SUFFIX = "@GRAD"
 
 

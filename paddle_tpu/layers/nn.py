@@ -32,6 +32,7 @@ __all__ = [
     "fused_attention",
     "autoincreased_step_counter", "cos_sim", "dot_product_attention",
     "beam_search", "beam_search_decode", "ring_attention",
+    "conv3d", "warpctc", "ctc_greedy_decoder", "image_resize",
 ]
 
 
@@ -1075,4 +1076,76 @@ def fused_attention(q, k, v, k_mask=None, causal=False, scale=1.0,
                      outputs={"Out": [out], "Lse": [lse]},
                      attrs={"causal": causal, "scale": float(scale),
                             "use_flash": use_flash})
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           act=None, name=None):
+    """3-D convolution, NCDHW (reference ``nn.py`` conv3d over
+    ``conv3d_op``; same MXU lowering family as conv2d)."""
+    helper = LayerHelper("conv3d", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    groups = groups or 1
+
+    def _triple(x):
+        return [x, x, x] if isinstance(x, int) else list(x)
+
+    filter_size = _triple(filter_size)
+    stride = _triple(stride)
+    padding = _triple(padding)
+    dilation = _triple(dilation)
+    filter_shape = [num_filters, num_channels // groups] + filter_size
+    fan_in = num_channels * int(np.prod(filter_size))
+    w = helper.create_parameter(
+        helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=init_mod.Normal(0.0, (2.0 / fan_in) ** 0.5))
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op(
+        type="conv3d", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={"strides": stride, "paddings": padding,
+               "dilations": dilation, "groups": groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss over ragged logits/labels (reference ``nn.py`` warpctc
+    over ``warpctc_op.cc``); returns [B, 1] per-sequence losses."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(
+        type="warpctc",
+        inputs={"Logits": [input], "Label": [label]},
+        outputs={"Loss": [loss]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank=0):
+    """Greedy CTC decode: per-row argmax, merge repeats, drop blanks
+    (reference ``nn.py`` ctc_greedy_decoder over ``ctc_align_op``)."""
+    from paddle_tpu.layers.tensor import argmax
+    helper = LayerHelper("ctc_align")
+    ids = argmax(input, axis=-1)
+    out = helper.create_tmp_variable(dtype="int32", stop_gradient=True)
+    helper.append_op(type="ctc_align", inputs={"Input": [ids]},
+                     outputs={"Output": [out]}, attrs={"blank": blank})
+    return out
+
+
+def image_resize(input, out_shape, method="bilinear", name=None):
+    """Resize NCHW feature maps to ``out_shape`` = (H, W) by bilinear or
+    nearest interpolation (reference gserver BilinearInterpLayer.cpp /
+    UpsampleLayer.cpp; lowered to jax.image.resize)."""
+    helper = LayerHelper("image_resize", name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(
+        type="image_resize", inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"out_h": int(out_shape[0]), "out_w": int(out_shape[1]),
+               "method": method})
     return out

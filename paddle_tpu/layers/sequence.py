@@ -13,6 +13,7 @@ __all__ = [
     "sequence_conv", "sequence_pool", "sequence_softmax",
     "sequence_first_step", "sequence_last_step", "sequence_expand",
     "sequence_reshape", "sequence_concat", "lod_reset",
+    "sequence_reverse", "sequence_slice", "sequence_erase",
 ]
 
 
@@ -211,4 +212,37 @@ def lod_reset(x, y=None, target_lod=None):
                          attrs={"target_lod": [int(v) for v in target_lod]})
     else:
         raise ValueError("lod_reset needs y or target_lod")
+    return out
+
+
+def sequence_reverse(x, name=None):
+    """Reverse rows within each sequence (reference
+    ``sequence_reverse_op.h``); LoD is preserved."""
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(type="sequence_reverse", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence subsequence extraction (reference
+    ``sequence_slice_op.cc``): ``offset``/``length`` are [B]-shaped."""
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    """Remove the listed token ids from each sequence (reference
+    ``sequence_erase_op.cc``)."""
+    helper = LayerHelper("sequence_erase", name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="sequence_erase", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"tokens": list(tokens)})
     return out

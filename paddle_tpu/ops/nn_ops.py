@@ -639,3 +639,31 @@ def conv_shift_lower(ctx):
     for k in range(m):
         out = out + jnp.roll(x, half - k, axis=1) * y[:, k:k + 1]
     ctx.set_output("Out", out)
+
+
+# ---------------------------------------------------------------------------
+# image_resize — spatial up/down-sampling of NCHW feature maps (reference
+# BilinearInterpLayer.cpp / UpsampleLayer.cpp in paddle/gserver/layers).
+# Lowered to jax.image.resize, which is differentiable.
+# ---------------------------------------------------------------------------
+
+def _infer_image_resize(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    if x.shape is None:
+        raise ShapeInferenceSkip()
+    n, c = x.shape[0], x.shape[1]
+    out.shape = (n, c, op.attr("out_h"), op.attr("out_w"))
+    out.dtype = x.dtype
+
+
+@register_op("image_resize", infer_shape=_infer_image_resize)
+def image_resize_lower(ctx):
+    x = ctx.input("X")                   # [N, C, H, W]
+    method = ctx.attr("method", "bilinear")
+    out_h, out_w = ctx.attr("out_h"), ctx.attr("out_w")
+    jmethod = {"bilinear": "linear", "nearest": "nearest"}[method]
+    out = jax.image.resize(
+        x.astype(jnp.float32), (x.shape[0], x.shape[1], out_h, out_w),
+        method=jmethod)
+    ctx.set_output("Out", out.astype(x.dtype))

@@ -44,7 +44,8 @@ class OpDef:
     def __init__(self, type, lower=None, infer_shape=None, grad_maker=None,
                  grad_lower=None, no_grad_inputs=(), stop_gradient_outputs=(),
                  uses_rng=False, stateful_outputs=(), host=False,
-                 amp_cast=(), amp_upcast=(), selected_rows_inputs=()):
+                 host_dyn_ok=False, amp_cast=(), amp_upcast=(),
+                 selected_rows_inputs=()):
         self.type = type
         self.lower = lower
         self.infer_shape = infer_shape
@@ -77,15 +78,19 @@ class OpDef:
         self.stateful_outputs = frozenset(stateful_outputs)
         # host ops need CONCRETE values (data-dependent output shapes /
         # numpy DP) — a block containing one runs in op-by-op interpret
-        # mode, like the reference's CPU-only kernels
+        # mode, like the reference's CPU-only kernels.  host_dyn_ok marks
+        # ops whose BUCKETED-dynamic-LoD branch is fully traced (lod.py),
+        # so in bucketed mode they do not force interpret mode.
         self.host = host
+        self.host_dyn_ok = host_dyn_ok
         self.has_grad = True  # flipped by register_op(no_gradient=True)
 
 
 def register_op(type, *, infer_shape=None, grad_maker=None, grad_lower=None,
                 no_grad_inputs=(), stop_gradient_outputs=(), uses_rng=False,
                 no_gradient=False, stateful_outputs=(), host=False,
-                amp_cast=(), amp_upcast=(), selected_rows_inputs=()):
+                host_dyn_ok=False, amp_cast=(), amp_upcast=(),
+                selected_rows_inputs=()):
     """Decorator: register ``fn(ctx)`` as the lowering for op ``type``."""
 
     def deco(fn):
@@ -94,7 +99,8 @@ def register_op(type, *, infer_shape=None, grad_maker=None, grad_lower=None,
                       no_grad_inputs=no_grad_inputs,
                       stop_gradient_outputs=stop_gradient_outputs,
                       uses_rng=uses_rng, stateful_outputs=stateful_outputs,
-                      host=host, amp_cast=amp_cast, amp_upcast=amp_upcast,
+                      host=host, host_dyn_ok=host_dyn_ok,
+                      amp_cast=amp_cast, amp_upcast=amp_upcast,
                       selected_rows_inputs=selected_rows_inputs)
         opdef.has_grad = not no_gradient
         _REGISTRY[type] = opdef

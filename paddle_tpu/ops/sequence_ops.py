@@ -238,6 +238,53 @@ def sequence_concat_lower(ctx: LowerContext):
     if any(l is None for l in lods):
         ctx.set_output("Out", jnp.concatenate(xs, axis=0))
         return
+    if any(_is_dyn(l) for l in lods):
+        # bucketed mode: interleave per-sequence with a RUNTIME gather
+        # table — out seq i = concat_k (input k's seq i); K is static so
+        # the per-input membership test unrolls into where-chains.
+        from paddle_tpu.lod import DynLoD, SPLITS_SUFFIX
+        num = next(l for l in lods if _is_dyn(l)).num_seqs
+        n_out = sum(int(x.shape[0]) for x in xs)
+        offsets = np.cumsum([0] + [int(x.shape[0]) for x in xs])
+        splits_k, lengths_k = [], []
+        for k, l in enumerate(lods):
+            if _is_dyn(l):
+                sp = l.splits(ctx.env).astype(jnp.int32)
+            else:
+                sp = jnp.asarray(np.asarray(l[0], np.int32))
+            splits_k.append(sp)
+            lengths_k.append(sp[1:] - sp[:-1])
+        out_lengths = sum(lengths_k)
+        out_splits = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(out_lengths).astype(jnp.int32)])
+        r = jnp.arange(n_out)
+        seg = jnp.searchsorted(out_splits[1:], r,
+                               side="right").astype(jnp.int32)
+        segc = jnp.clip(seg, 0, num - 1)
+        pos = r - out_splits[segc]
+        valid = r < out_splits[-1]
+        src = jnp.zeros(n_out, jnp.int32)
+        found = jnp.zeros(n_out, bool)
+        acc = jnp.zeros(n_out, jnp.int32)
+        for k in range(len(xs)):
+            lk = lengths_k[k][segc]
+            in_k = (pos >= acc) & (pos < acc + lk)
+            src_k = offsets[k] + splits_k[k][segc] + (pos - acc)
+            src = jnp.where(in_k & ~found, src_k, src)
+            found = found | in_k
+            acc = acc + lk
+        allx = jnp.concatenate(xs, axis=0)
+        gathered = allx[jnp.clip(src, 0, n_out - 1)]
+        mask = valid.reshape((-1,) + (1,) * (gathered.ndim - 1))
+        out = jnp.where(mask, gathered, 0)
+        name = ctx.op.output("Out")[0] + SPLITS_SUFFIX
+        ctx.outputs[name] = out_splits
+        ctx.set_output("Out", out)
+        maxlen = sum(l.maxlen_bucket if _is_dyn(l) else n_out
+                     for l in lods)
+        ctx.set_output_lod("Out", DynLoD(name, num, maxlen))
+        return
     # interleave per-sequence: out seq i = concat of each input's seq i
     splits = [np.asarray(l[0]) for l in lods]
     n_seq = len(splits[0]) - 1
@@ -254,6 +301,26 @@ def sequence_concat_lower(ctx: LowerContext):
     allx = jnp.concatenate(xs, axis=0)
     ctx.set_output("Out", allx[jnp.asarray(np.asarray(order, np.int32))])
     ctx.set_output_lod("Out", [new_splits])
+
+
+@register_op("sequence_reverse", infer_shape=_infer_ragged)
+def sequence_reverse_lower(ctx: LowerContext):
+    """Reverse rows within each sequence (reference
+    ``sequence_reverse_op.h``; used by the legacy DSL's
+    ``recurrent_group(reverse=True)``).  LoD splits are unchanged; the
+    gather index table is built at trace time, so gradients flow through
+    the (constant-index) gather."""
+    x = ctx.input("X")
+    lod = ctx.var_lod(ctx.op.input("X")[0])
+    if lod is None:
+        ctx.set_output("Out", x[::-1])
+        return
+    splits = np.asarray(lod[0])
+    order = []
+    for i in range(len(splits) - 1):
+        order.extend(range(int(splits[i + 1]) - 1, int(splits[i]) - 1, -1))
+    ctx.set_output("Out", x[jnp.asarray(np.asarray(order, np.int32))])
+    ctx.set_output_lod("Out", [list(map(int, splits))])
 
 
 def _infer_seq_reshape(op, block):
@@ -290,10 +357,34 @@ def sequence_reshape_lower(ctx: LowerContext):
 
 
 @register_op("sequence_slice", infer_shape=_infer_ragged,
-             no_gradient=True, host=True)
+             no_gradient=True, host=True, host_dyn_ok=True)
 def sequence_slice_lower(ctx: LowerContext):
     x = ctx.input("X")
     lod = _require_lod(ctx)
+    if _is_dyn(lod):
+        # bucketed mode: output stays padded to the input's bucket; rows
+        # move via a runtime gather built from the splits tensor
+        from paddle_tpu.lod import DynLoD, SPLITS_SUFFIX
+        offset = ctx.input("Offset").reshape(-1).astype(jnp.int32)
+        length = ctx.input("Length").reshape(-1).astype(jnp.int32)
+        splits = lod.splits(ctx.env).astype(jnp.int32)
+        n = x.shape[0]
+        out_splits = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(length).astype(jnp.int32)])
+        r = jnp.arange(n)
+        seg = jnp.searchsorted(out_splits[1:], r,
+                               side="right").astype(jnp.int32)
+        segc = jnp.clip(seg, 0, lod.num_seqs - 1)
+        valid = r < out_splits[-1]
+        src = splits[segc] + offset[segc] + (r - out_splits[segc])
+        gathered = x[jnp.clip(src, 0, n - 1)]
+        mask = valid.reshape((-1,) + (1,) * (gathered.ndim - 1))
+        name = ctx.op.output("Out")[0] + SPLITS_SUFFIX
+        ctx.outputs[name] = out_splits
+        ctx.set_output("Out", jnp.where(mask, gathered, 0))
+        ctx.set_output_lod("Out", DynLoD(name, lod.num_seqs,
+                                         lod.maxlen_bucket))
+        return
     offset = np.asarray(ctx.input("Offset")).reshape(-1)
     length = np.asarray(ctx.input("Length")).reshape(-1)
     splits = np.asarray(lod[0])
@@ -307,17 +398,44 @@ def sequence_slice_lower(ctx: LowerContext):
 
 
 @register_op("sequence_erase", infer_shape=_infer_ragged,
-             no_gradient=True, host=True)
+             no_gradient=True, host=True, host_dyn_ok=True)
 def sequence_erase_lower(ctx: LowerContext):
-    """Remove tokens in ``tokens`` attr.  Changes row count — requires
-    concrete (non-traced) input, so it runs at trace time on constants
-    (typically label preprocessing)."""
+    """Remove tokens in ``tokens`` attr.  Static mode runs at trace time
+    on concrete values (data-dependent row count); bucketed mode keeps the
+    padded row count and compacts kept rows forward with a stable
+    argsort — the new splits ride the runtime lod tensor."""
     x = ctx.input("X")
-    tokens = set(ctx.attr("tokens", []))
+    tokens = sorted(set(ctx.attr("tokens", [])))
     lod = _require_lod(ctx)
+    if _is_dyn(lod):
+        from paddle_tpu.lod import DynLoD, SPLITS_SUFFIX
+        n = x.shape[0]
+        seg, _, num, splits, valid = _segment_tables(ctx, lod, n)
+        vals = x.reshape(n, -1)[:, 0]
+        keep = valid if valid is not None else jnp.ones(n, bool)
+        for t in tokens:
+            keep = keep & (vals != t)
+        # kept rows first, original order (stable); dropped/padding last
+        order = jnp.argsort(jnp.logical_not(keep), stable=True)
+        kept_count = jnp.sum(keep.astype(jnp.int32))
+        gathered = x[order]
+        r = jnp.arange(n)
+        mask = (r < kept_count).reshape((-1,) + (1,) * (x.ndim - 1))
+        # per-sequence kept counts -> new splits
+        kept_per_seq = jax.ops.segment_sum(keep.astype(jnp.int32), seg,
+                                           num_segments=num + 1)[:num]
+        out_splits = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(kept_per_seq).astype(jnp.int32)])
+        name = ctx.op.output("Out")[0] + SPLITS_SUFFIX
+        ctx.outputs[name] = out_splits
+        ctx.set_output("Out", jnp.where(mask, gathered, 0))
+        ctx.set_output_lod("Out", DynLoD(name, num, lod.maxlen_bucket))
+        return
     vals = np.asarray(x).reshape(-1)
     splits = np.asarray(lod[0])
     keep_vals, new_splits = [], [0]
+    tokens = set(tokens)
     for i in range(len(splits) - 1):
         seq = [v for v in vals[splits[i]:splits[i + 1]]
                if int(v) not in tokens]
@@ -332,9 +450,32 @@ def sequence_erase_lower(ctx: LowerContext):
 @register_op("lod_reset", infer_shape=_infer_ragged)
 def lod_reset_lower(ctx: LowerContext):
     x = ctx.input("X")
+    x_lod = ctx.var_lod(ctx.op.input("X")[0])
+    y_lod = ctx.input_lod("Y") if ctx.op.input("Y") else None
+    if _is_dyn(x_lod) or _is_dyn(y_lod):
+        # bucketed mode: rows are unchanged; only the splits move.
+        from paddle_tpu.lod import DynLoD, SPLITS_SUFFIX
+        if _is_dyn(y_lod):
+            ctx.set_output("Out", x)
+            ctx.set_output_lod("Out", y_lod)  # share Y's runtime splits
+            return
+        target = ctx.attr("target_lod", None)
+        if ctx.op.input("Y") and y_lod is None:
+            splits = ctx.input("Y").reshape(-1).astype(jnp.int32)
+            num = splits.shape[0] - 1
+        else:
+            splits = jnp.asarray(np.asarray(target, np.int32))
+            num = len(target) - 1
+        name = ctx.op.output("Out")[0] + SPLITS_SUFFIX
+        ctx.outputs[name] = splits
+        ctx.set_output("Out", x)
+        ctx.set_output_lod(
+            "Out", DynLoD(name, num,
+                          x_lod.maxlen_bucket if _is_dyn(x_lod)
+                          else x.shape[0]))
+        return
     target = ctx.attr("target_lod", None)
     if ctx.op.input("Y"):
-        y_lod = ctx.input_lod("Y")
         if y_lod is not None:
             target = y_lod[0]
         else:
@@ -347,19 +488,11 @@ def lod_reset_lower(ctx: LowerContext):
 # sequence_conv (context_project + filter matmul)
 # ---------------------------------------------------------------------------
 
-@register_op("sequence_conv", infer_shape=_infer_seq_conv)
-def sequence_conv_lower(ctx: LowerContext):
-    """Per-sequence sliding-window projection
-    (reference ``operators/math/context_project.h``): gather the
-    [contextLength, D] window around each token (zero-padded at sequence
-    boundaries), flatten, and matmul with the filter [ctx_len*D, F]."""
-    x = ctx.input("X")          # [N, D]
-    filt = ctx.input("Filter")  # [ctx_len*D, F]
-    lod = _require_lod(ctx)
-    ctx_len = ctx.attr("contextLength")
-    ctx_start = ctx.attr("contextStart", -((ctx_len - 1) // 2))
+def _context_windows(ctx, x, lod, ctx_len, ctx_start):
+    """[N, ctx_len*D] sliding-window gather around each token, zero-padded
+    at sequence boundaries (reference ``operators/math/context_project.h``).
+    Shared by sequence_conv and the raw sequence_context op."""
     N = x.shape[0]
-
     if _is_dyn(lod):
         # runtime gather table: window slot valid iff the source row stays
         # inside the same sequence (same segment, within valid rows)
@@ -383,10 +516,50 @@ def sequence_conv_lower(ctx: LowerContext):
         gather = jnp.asarray(gather)
     padded = jnp.concatenate([x, jnp.zeros((1,) + x.shape[1:], x.dtype)])
     windows = padded[gather]                       # [N, ctx_len, D]
-    flat = windows.reshape(N, -1)
+    return windows.reshape(N, -1)
+
+
+@register_op("sequence_conv", infer_shape=_infer_seq_conv)
+def sequence_conv_lower(ctx: LowerContext):
+    """Per-sequence sliding-window projection
+    (reference ``operators/math/context_project.h``): gather the
+    [contextLength, D] window around each token (zero-padded at sequence
+    boundaries), flatten, and matmul with the filter [ctx_len*D, F]."""
+    x = ctx.input("X")          # [N, D]
+    filt = ctx.input("Filter")  # [ctx_len*D, F]
+    lod = _require_lod(ctx)
+    ctx_len = ctx.attr("contextLength")
+    ctx_start = ctx.attr("contextStart", -((ctx_len - 1) // 2))
+    flat = _context_windows(ctx, x, lod, ctx_len, ctx_start)
     out = flat @ filt
     if ctx.op.input("PaddingData"):
         pass  # trainable boundary padding unsupported; zeros used
+    ctx.set_output("Out", out)
+    ctx.set_output_lod("Out",
+                       lod if _is_dyn(lod) else [list(s) for s in lod])
+
+
+def _infer_seq_context(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    if x.shape is None:
+        raise ShapeInferenceSkip()
+    out.shape = (x.shape[0], x.shape[1] * op.attr("contextLength"))
+    out.dtype = x.dtype
+    out.lod_level = x.lod_level
+
+
+@register_op("sequence_context", infer_shape=_infer_seq_context)
+def sequence_context_lower(ctx: LowerContext):
+    """Raw context-window concatenation — the legacy DSL's
+    ``context_projection`` without trainable weights (reference
+    ``trainer_config_helpers/layers.py`` context_projection over
+    ``operators/math/context_project.h``)."""
+    x = ctx.input("X")
+    lod = _require_lod(ctx)
+    ctx_len = ctx.attr("contextLength")
+    ctx_start = ctx.attr("contextStart", -((ctx_len - 1) // 2))
+    out = _context_windows(ctx, x, lod, ctx_len, ctx_start)
     ctx.set_output("Out", out)
     ctx.set_output_lod("Out",
                        lod if _is_dyn(lod) else [list(s) for s in lod])

@@ -594,3 +594,31 @@ def _lookup_table_grad_lower(ctx):
 
 
 lookup("lookup_table").grad_lower = _lookup_table_grad_lower
+
+
+# ---------------------------------------------------------------------------
+# sampling_id — reference ``sampling_id_op.cc`` / gserver
+# SamplingIdLayer.cpp: sample one class id per row from a probability row.
+# Inverse-CDF with PER-ROW uniforms off the traced RNG key (dense ops, no
+# host round-trip).
+# ---------------------------------------------------------------------------
+
+def _infer_sampling_id(op, block):
+    x = block.var(op.input("X")[0])
+    if x.shape is None:
+        raise ShapeInferenceSkip()
+    out = block.var(op.output("Out")[0])
+    out.shape = (x.shape[0], 1)
+    out.dtype = "int64"
+
+
+@register_op("sampling_id", infer_shape=_infer_sampling_id,
+             no_gradient=True, uses_rng=True)
+def sampling_id_lower(ctx):
+    x = ctx.input("X")                       # [N, C] probabilities
+    u = jax.random.uniform(ctx.rng_key(), (x.shape[0], 1),
+                           dtype=x.dtype)
+    cdf = jnp.cumsum(x, axis=1)
+    idx = jnp.sum((cdf < u).astype(jnp.int32), axis=1, keepdims=True)
+    ctx.set_output("Out", jnp.clip(idx, 0, x.shape[1] - 1)
+                   .astype(jnp.int32))

@@ -144,3 +144,105 @@ def op_profiler(sorted_key="total"):
     finally:
         disable_op_profiling()
         print(op_profile_table(sorted_key))
+
+
+# ---------------------------------------------------------------------------
+# compiled-path per-op attribution (round 3; reference platform/profiler.h
+# RecordEvent:110 attributes real run time to ops — here the executor
+# wraps every op lowering in jax.named_scope, XLA carries the scope into
+# each HLO instruction's op_name metadata, and a trace of the COMPILED
+# step is aggregated back to IR op names)
+# ---------------------------------------------------------------------------
+
+_SCOPE_PREFIX = "ptop_"
+
+
+def op_scope_name(op):
+    """named_scope label for an IR op: ptop_<type>__<primary output>.
+    Dots/slashes are scope separators in XLA metadata, so sanitize."""
+    outs = op.output_arg_names
+    tag = outs[0] if outs else ""
+    return _SCOPE_PREFIX + f"{op.type}__{tag}".replace(".", "_") \
+        .replace("/", "_")
+
+
+def parse_op_scope(hlo_op_name):
+    """Deepest ptop_ scope component of an HLO op_name path, as
+    (op_type, output_tag), or None."""
+    hit = None
+    for part in str(hlo_op_name).split("/"):
+        if part.startswith(_SCOPE_PREFIX):
+            hit = part[len(_SCOPE_PREFIX):]
+    if hit is None:
+        return None
+    op_type, _, tag = hit.partition("__")
+    return op_type, tag
+
+
+def compiled_op_table(trace_dir, sorted_key="total"):
+    """Aggregate a jax.profiler trace (xplane protos under ``trace_dir``)
+    into per-IR-op device time, keyed by the named_scope labels the
+    executor emitted.  Returns (table_string, rows) where rows =
+    [(op_type, calls, total_seconds)] sorted descending."""
+    import collections
+    import glob as _glob
+
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError:  # pragma: no cover
+        from tsl.profiler.protobuf import xplane_pb2  # type: ignore
+
+    agg = collections.Counter()
+    calls = collections.Counter()
+    paths = _glob.glob(str(trace_dir) + "/**/*.xplane.pb", recursive=True)
+    for path in paths:
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            statmeta = plane.stat_metadata
+            evmeta = plane.event_metadata
+            for line in plane.lines:
+                for ev in line.events:
+                    m = evmeta[ev.metadata_id]
+                    # scope appears either in the event name or in the
+                    # tf_op/long_name stat (backend-dependent)
+                    cands = [m.name, getattr(m, "display_name", "")]
+                    for st in list(ev.stats) + list(m.stats):
+                        sname = statmeta[st.metadata_id].name
+                        if sname in ("tf_op", "long_name", "name"):
+                            if st.str_value:
+                                cands.append(st.str_value)
+                            elif st.ref_value:
+                                cands.append(
+                                    statmeta[st.ref_value].name)
+                    for c in cands:
+                        parsed = parse_op_scope(c)
+                        if parsed is not None:
+                            agg[parsed[0]] += ev.duration_ps / 1e12
+                            calls[parsed[0]] += 1
+                            break
+    rows = sorted(((t, calls[t], s) for t, s in agg.items()),
+                  key=lambda r: r[1 if sorted_key == "calls" else 2],
+                  reverse=True)
+    lines = [f"{'Event':<28}{'Calls':>8}{'Total(ms)':>12}{'Ave(ms)':>12}"]
+    for op_type, n, total in rows:
+        lines.append(f"{op_type:<28}{n:>8}{total * 1e3:>12.3f}"
+                     f"{total / max(n, 1) * 1e3:>12.3f}")
+    return "\n".join(lines), rows
+
+
+@contextlib.contextmanager
+def compiled_profiler(trace_dir=None, sorted_key="total"):
+    """Trace compiled execution inside the block and print the per-IR-op
+    device-time table on exit (the compiled-path counterpart of
+    ``op_profiler``, which times interpret mode)."""
+    import tempfile
+    d = trace_dir or tempfile.mkdtemp(prefix="ptprof_")
+    jax.profiler.start_trace(d)
+    try:
+        yield d
+    finally:
+        jax.profiler.stop_trace()
+        table, _ = compiled_op_table(d, sorted_key)
+        print(table)

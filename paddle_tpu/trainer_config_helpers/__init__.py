@@ -15,3 +15,4 @@ from paddle_tpu.trainer_config_helpers.poolings import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.networks import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.data_sources import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.optimizers import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.evaluators import *  # noqa: F401,F403

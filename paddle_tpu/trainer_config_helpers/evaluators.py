@@ -1,0 +1,184 @@
+"""Evaluator DSL (reference ``trainer_config_helpers/evaluators.py``,
+813 LoC).  Each ``*_evaluator`` call emits the metric ops into the current
+program and registers the fetchable outputs on the program
+(``program._evaluators``) so trainers/tests can fetch them by name —
+replacing the reference's Evaluator protobuf + C++ evaluator objects
+(``paddle/gserver/evaluators/``)."""
+
+from __future__ import annotations
+
+import paddle_tpu.layers as F
+from paddle_tpu.framework import default_main_program
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "classification_error_evaluator", "auc_evaluator", "pnpair_evaluator",
+    "precision_recall_evaluator", "ctc_error_evaluator", "chunk_evaluator",
+    "sum_evaluator", "column_sum_evaluator", "detection_map_evaluator",
+    "value_printer_evaluator", "gradient_printer_evaluator",
+    "maxid_printer_evaluator", "maxframe_printer_evaluator",
+    "seqtext_printer_evaluator", "classification_error_printer_evaluator",
+]
+
+
+def _register(name, outputs):
+    """Attach {metric_name: Variable} to the program's evaluator table;
+    returns the primary Variable (reference evaluator_base semantics:
+    evaluators are config-side objects polled by the trainer loop)."""
+    prog = default_main_program()
+    if not hasattr(prog, "_evaluators"):
+        prog._evaluators = {}
+    prog._evaluators[name] = outputs
+    return next(iter(outputs.values()))
+
+
+def evaluators_of(program=None):
+    """All evaluators registered while building ``program``."""
+    prog = program or default_main_program()
+    return getattr(prog, "_evaluators", {})
+
+
+def classification_error_evaluator(input, label, name=None, weight=None,
+                                   top_k=1, **kwargs):
+    """Error rate = 1 - accuracy@k (reference ``evaluators.py:220`` over
+    gserver ClassificationErrorEvaluator)."""
+    acc = F.accuracy(input=input, label=label, k=top_k)
+    err = F.scale(acc, scale=-1.0, bias=1.0)
+    return _register(name or "classification_error_evaluator",
+                     {"error": err})
+
+
+def auc_evaluator(input, label, name=None, weight=None, **kwargs):
+    """ROC AUC (reference ``evaluators.py:272`` over AucEvaluator)."""
+    auc = F.auc(input=input, label=label)
+    return _register(name or "auc_evaluator", {"auc": auc})
+
+
+def pnpair_evaluator(input, label, query_id, weight=None, name=None,
+                     **kwargs):
+    """Positive-negative pair ratio per query (reference
+    ``evaluators.py:306`` over PnpairEvaluator)."""
+    helper = LayerHelper("positive_negative_pair")
+    pos = helper.create_tmp_variable("float32")
+    neg = helper.create_tmp_variable("float32")
+    ratio = helper.create_tmp_variable("float32")
+    helper.append_op(
+        type="positive_negative_pair",
+        inputs={"Score": [input], "Label": [label], "QueryID": [query_id]},
+        outputs={"PositivePair": [pos], "NegativePair": [neg],
+                 "NeutralPair": [ratio]})
+    return _register(name or "pnpair_evaluator",
+                     {"pos": pos, "neg": neg, "neutral": ratio})
+
+
+def precision_recall_evaluator(input, label, positive_label=None,
+                               weight=None, name=None, **kwargs):
+    """Per-class precision/recall/F1 (reference ``evaluators.py:353`` over
+    PrecisionRecallEvaluator)."""
+    helper = LayerHelper("precision_recall")
+    cls = input.shape[-1]
+    metrics = helper.create_tmp_variable("float32")
+    states = helper.create_tmp_variable("float32")
+    helper.append_op(
+        type="precision_recall",
+        inputs={"MaxProbs": [F.reduce_max(input, dim=1, keep_dim=True)],
+                "Indices": [F.argmax(input, axis=-1)], "Labels": [label]},
+        outputs={"BatchMetrics": [metrics], "AccumMetrics": [states]},
+        attrs={"class_number": cls})
+    return _register(name or "precision_recall_evaluator",
+                     {"metrics": metrics})
+
+
+def ctc_error_evaluator(input, label, name=None, **kwargs):
+    """Sequence edit-distance after CTC greedy decode (reference
+    ``evaluators.py:398`` over CTCErrorEvaluator)."""
+    decoded = F.ctc_greedy_decoder(input)
+    helper = LayerHelper("edit_distance")
+    dist = helper.create_tmp_variable("float32")
+    seq_num = helper.create_tmp_variable("int64")
+    helper.append_op(type="edit_distance",
+                     inputs={"Hyps": [decoded], "Refs": [label]},
+                     outputs={"Out": [dist], "SequenceNum": [seq_num]},
+                     attrs={"normalized": True})
+    return _register(name or "ctc_error_evaluator",
+                     {"edit_distance": dist, "seq_num": seq_num})
+
+
+def chunk_evaluator(input, label, chunk_scheme, num_chunk_types,
+                    name=None, excluded_chunk_types=None, **kwargs):
+    """Chunk precision/recall/F1 (reference ``evaluators.py:425`` over
+    ChunkEvaluator)."""
+    precision, recall, f1, n_infer, n_label, n_correct = F.chunk_eval(
+        input=input, label=label, chunk_scheme=chunk_scheme,
+        num_chunk_types=num_chunk_types,
+        excluded_chunk_types=excluded_chunk_types)
+    return _register(name or "chunk_evaluator",
+                     {"precision": precision, "recall": recall, "f1": f1,
+                      "num_infer": n_infer, "num_label": n_label,
+                      "num_correct": n_correct})
+
+
+def sum_evaluator(input, name=None, weight=None, **kwargs):
+    """Sum of the input over the batch (reference ``evaluators.py:532``)."""
+    return _register(name or "sum_evaluator",
+                     {"sum": F.reduce_sum(input)})
+
+
+def column_sum_evaluator(input, name=None, weight=None, **kwargs):
+    """Per-column sums (reference ``evaluators.py:558``)."""
+    return _register(name or "column_sum_evaluator",
+                     {"column_sum": F.reduce_sum(input, dim=0)})
+
+
+def detection_map_evaluator(input, label, overlap_threshold=0.5,
+                            background_id=0, evaluate_difficult=False,
+                            ap_type="11point", name=None, class_num=None,
+                            **kwargs):
+    """Detection mAP (reference ``evaluators.py:170`` over
+    DetectionMAPEvaluator)."""
+    from paddle_tpu.layers import detection as det
+    m = det.detection_map(input, label, class_num=class_num or 21,
+                          background_label=background_id,
+                          overlap_threshold=overlap_threshold,
+                          evaluate_difficult=evaluate_difficult,
+                          ap_version=ap_type)
+    return _register(name or "detection_map_evaluator", {"map": m})
+
+
+# --- printer evaluators (reference ``evaluators.py:588-831``): each is a
+# Print op on the relevant tensor, the TPU-side analog of the gserver
+# printer evaluators which write to the trainer log ---------------------
+
+def value_printer_evaluator(input, name=None, **kwargs):
+    F.Print(input, message=name or "value_printer")
+    return input
+
+
+def gradient_printer_evaluator(input, name=None, **kwargs):
+    from paddle_tpu.framework import grad_var_name
+    F.Print(input, message=(name or "gradient_printer") +
+            f" (grad of {input.name}: fetch {grad_var_name(input.name)})")
+    return input
+
+
+def maxid_printer_evaluator(input, name=None, **kwargs):
+    F.Print(F.argmax(input, axis=-1), message=name or "maxid_printer")
+    return input
+
+
+def maxframe_printer_evaluator(input, name=None, **kwargs):
+    F.Print(F.reduce_max(input, dim=-1), message=name or "maxframe_printer")
+    return input
+
+
+def seqtext_printer_evaluator(input, result_file=None, name=None, **kwargs):
+    F.Print(input, message=name or "seqtext_printer")
+    return input
+
+
+def classification_error_printer_evaluator(input, label, name=None,
+                                           **kwargs):
+    acc = F.accuracy(input=input, label=label)
+    F.Print(F.scale(acc, scale=-1.0, bias=1.0),
+            message=name or "classification_error_printer")
+    return input

@@ -1,23 +1,712 @@
-"""Legacy ``*_layer`` DSL names over the v2 shim (reference
-``trainer_config_helpers/layers.py``; each legacy function name keeps its
-signature shape, the body emits Program IR through ``paddle_tpu.v2``)."""
+"""Legacy ``*_layer`` DSL (reference ``trainer_config_helpers/layers.py``,
+7,610 LoC over ``paddle/gserver/layers/`` ~110 layer types).
+
+Each legacy function keeps its reference signature shape; the body emits
+Program IR through the fluid layer set (``paddle_tpu.layers``) — the path
+the reference takes through config_parser + gserver C++ Layer subclasses
+is replaced by IR ops lowered to XLA.  Projections/operators are deferred
+graph fragments summed by ``mixed_layer`` (reference MixedLayer.cpp);
+``recurrent_group`` maps onto ``DynamicRNN`` (one masked
+``lax.while_loop``); the generation-side ``beam_search`` unrolls under a
+deterministic name scope so timesteps share weights.
+"""
 
 from __future__ import annotations
 
+import numpy as np
+
+import paddle_tpu.layers as F
+from paddle_tpu.framework import unique_name_scope
+from paddle_tpu.param_attr import ParamAttr as _ParamAttr
 from paddle_tpu.v2 import layer as _v2
+from paddle_tpu.v2.layer import _act_name
 
 __all__ = [
+    # projections / operators / mixed
+    "full_matrix_projection", "trans_full_matrix_projection",
+    "table_projection", "identity_projection", "slice_projection",
+    "scaling_projection", "dotmul_projection", "context_projection",
+    "conv_projection", "dotmul_operator", "conv_operator", "mixed_layer",
+    # io / basic
     "data_layer", "fc_layer", "embedding_layer", "img_conv_layer",
-    "img_pool_layer", "batch_norm_layer", "dropout_layer", "concat_layer",
-    "lstmemory", "grumemory", "pooling_layer", "last_seq", "first_seq",
+    "img_conv3d_layer", "img_pool_layer", "img_pool3d_layer",
+    "batch_norm_layer", "dropout_layer", "concat_layer", "seq_concat_layer",
+    "printer_layer",
+    # recurrent
+    "lstmemory", "grumemory", "memory", "recurrent_group",
+    "recurrent_layer", "lstm_step_layer", "gru_step_layer",
+    "gru_step_naive_layer", "get_output_layer", "StaticInput",
+    "SubsequenceInput", "GeneratedInput", "beam_search",
+    # sequence
+    "pooling_layer", "last_seq", "first_seq", "expand_layer",
+    "repeat_layer", "seq_reshape_layer", "seq_slice_layer",
+    "sub_seq_layer", "kmax_seq_score_layer", "ctc_layer", "warp_ctc_layer",
+    # elementwise / math
+    "addto_layer", "interpolation_layer", "bilinear_interp_layer",
+    "power_layer", "scaling_layer", "slope_intercept_layer", "trans_layer",
+    "rotate_layer", "cos_sim", "l2_distance_layer", "dot_prod_layer",
+    "out_prod_layer", "linear_comb_layer", "tensor_layer",
+    "selective_fc_layer", "sampling_id_layer", "maxid_layer", "eos_layer",
+    "pad_layer", "conv_shift_layer", "block_expand_layer", "maxout_layer",
+    "multiplex_layer", "prelu_layer", "gated_unit_layer",
+    "switch_order_layer", "crop_layer", "clip_layer", "resize_layer",
+    "scale_shift_layer", "factorization_machine", "upsample_layer",
+    # norm
+    "sum_to_one_norm_layer", "row_l2_norm_layer", "img_cmrnorm_layer",
+    "cross_channel_norm_layer", "spp_layer",
+    # costs
     "classification_cost", "cross_entropy", "square_error_cost",
-    "regression_cost", "mse_cost", "LayerOutput",
+    "regression_cost", "mse_cost", "sum_cost", "cross_entropy_with_selfnorm",
+    "multi_binary_label_cross_entropy", "smooth_l1_cost",
+    "huber_regression_cost", "huber_classification_cost", "rank_cost",
+    "lambda_cost", "crf_layer", "crf_decoding_layer", "nce_layer",
+    "hsigmoid",
+    # detection / vision
+    "priorbox_layer", "detection_output_layer", "roi_pool_layer",
+    "multibox_loss_layer",
+    "LayerOutput",
 ]
 
 # In the reference every DSL call returns a LayerOutput handle; here the
 # IR Variable plays that role directly.
 LayerOutput = object
 
+
+def _to_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _apply_act(out, act):
+    name = _act_name(act)
+    if name and name not in ("linear", "identity"):
+        out = getattr(F, name)(out)
+    return out
+
+
+def _constant(values, dtype):
+    """Trace-time constant tensor (host numpy -> device)."""
+    return F.assign(np.asarray(values, dtype))
+
+
+# ---------------------------------------------------------------------------
+# projections & operators (reference Projection.h / Operator.h; deferred
+# fragments summed by mixed_layer / MixedLayer.cpp)
+# ---------------------------------------------------------------------------
+
+class BaseProjection:
+    """Deferred fragment: ``build(size)`` emits IR and returns the
+    [N, size] output Variable."""
+
+    def __init__(self, build_fn):
+        self._build_fn = build_fn
+
+    def build(self, size):
+        return self._build_fn(size)
+
+
+def full_matrix_projection(input, size=0, param_attr=None):
+    """x @ W (reference ``layers.py:430`` over FullMatrixProjection.cpp)."""
+    return BaseProjection(lambda sz: F.fc(
+        input=input, size=sz or size, bias_attr=False,
+        param_attr=param_attr))
+
+
+def trans_full_matrix_projection(input, size=0, param_attr=None):
+    """x @ W^T (reference ``layers.py:470``): the parameter is stored
+    [size, in_dim] and used transposed — weight sharing with a forward
+    projection of the same name."""
+    def build(sz):
+        sz = sz or size
+        in_dim = input.shape[-1]
+        w = F.create_parameter(shape=[sz, in_dim], dtype=input.dtype,
+                               attr=param_attr)
+        return F.matmul(input, w, transpose_y=True)
+    return BaseProjection(build)
+
+
+def table_projection(input, size=0, param_attr=None):
+    """Embedding-table row lookup (reference ``layers.py:506``)."""
+    def build(sz):
+        return _v2.embedding(input=input, size=sz or size,
+                             param_attr=param_attr)
+    return BaseProjection(build)
+
+
+def identity_projection(input, offset=None, size=None):
+    """Identity, or a column slice [offset, offset+size) (reference
+    ``layers.py:550``)."""
+    def build(sz):
+        if offset is None:
+            return input
+        width = size if size is not None else (sz or None)
+        if width is None:
+            raise ValueError("identity_projection with offset needs size")
+        return F.slice(input, axes=[1], starts=[offset],
+                       ends=[offset + width])
+    return BaseProjection(build)
+
+
+def slice_projection(input, slices):
+    """Concat of column slices [(s, e), ...] (reference ``layers.py:604``)."""
+    def build(sz):
+        parts = [F.slice(input, axes=[1], starts=[s], ends=[e])
+                 for s, e in slices]
+        return parts[0] if len(parts) == 1 else F.concat(parts, axis=1)
+    return BaseProjection(build)
+
+
+def scaling_projection(input, param_attr=None):
+    """w * x with a single learned scalar (reference ``layers.py:642``)."""
+    def build(sz):
+        w = F.create_parameter(shape=[1], dtype=input.dtype,
+                               attr=param_attr)
+        return F.elementwise_mul(input, w)
+    return BaseProjection(build)
+
+
+def dotmul_projection(input, param_attr=None):
+    """x .* w with a per-dimension learned vector (reference
+    ``layers.py:668`` over DotMulProjection.cpp)."""
+    def build(sz):
+        w = F.create_parameter(shape=[input.shape[-1]], dtype=input.dtype,
+                               attr=param_attr)
+        return F.elementwise_mul(input, w, axis=1)
+    return BaseProjection(build)
+
+
+def context_projection(input, context_len, context_start=None,
+                       padding_attr=False):
+    """Sliding-window concatenation within each sequence (reference
+    ``layers.py:738`` over operators/math/context_project.h); zero padding
+    at boundaries (trainable padding unsupported)."""
+    def build(sz):
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("sequence_context")
+        out = helper.create_tmp_variable(dtype=input.dtype)
+        start = context_start if context_start is not None \
+            else -(context_len // 2)
+        helper.append_op(type="sequence_context", inputs={"X": [input]},
+                         outputs={"Out": [out]},
+                         attrs={"contextLength": context_len,
+                                "contextStart": start})
+        return out
+    return BaseProjection(build)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, groups=1, param_attr=None,
+                    trans=False):
+    """Convolution as a mixed-layer fragment (reference ``layers.py:4838``);
+    output is the flattened feature map."""
+    def build(sz):
+        if trans:
+            conv = F.conv2d_transpose(input=input, num_filters=num_filters,
+                                      filter_size=filter_size,
+                                      stride=stride, padding=padding,
+                                      param_attr=param_attr,
+                                      bias_attr=False)
+        else:
+            conv = F.conv2d(input=input, num_filters=num_filters,
+                            filter_size=filter_size, stride=stride,
+                            padding=padding, groups=groups,
+                            param_attr=param_attr, bias_attr=False)
+        n, c, h, w = conv.shape
+        return F.reshape(conv, shape=[-1, c * h * w])
+    return BaseProjection(build)
+
+
+def dotmul_operator(a=None, b=None, scale=1, **kwargs):
+    """a .* b * scale (reference ``layers.py:697``; operators carry no
+    parameters)."""
+    x = a if a is not None else kwargs.get("x")
+    y = b if b is not None else kwargs.get("y")
+
+    def build(sz):
+        out = F.elementwise_mul(x, y)
+        if scale != 1:
+            out = F.scale(out, scale=float(scale))
+        return out
+    return BaseProjection(build)
+
+
+def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
+                  stride=1, padding=0, filter_size_y=None, stride_y=None,
+                  padding_y=None):
+    """Convolution whose filter comes from the graph (reference
+    ``layers.py:4749`` ConvOperator): ``filter`` is reshaped to
+    [num_filters, C, kh, kw] and correlated with ``img``; flattened
+    output."""
+    def build(sz):
+        fs_y = filter_size_y or filter_size
+        st_y = stride_y or stride
+        pd_y = padding_y if padding_y is not None else padding
+        nc = num_channels or img.shape[1]
+        fmap = F.reshape(filter, shape=[num_filters, nc, fs_y, filter_size])
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("conv2d")
+        out = helper.create_tmp_variable(dtype=img.dtype)
+        helper.append_op(
+            type="conv2d", inputs={"Input": [img], "Filter": [fmap]},
+            outputs={"Output": [out]},
+            attrs={"strides": [st_y, stride], "paddings": [pd_y, padding],
+                   "dilations": [1, 1], "groups": 1})
+        n, c, h, w = out.shape
+        return F.reshape(out, shape=[-1, c * h * w])
+    return BaseProjection(build)
+
+
+class _MixedLayerWith:
+    """``with mixed_layer(size=...) as m: m += proj`` support; after the
+    block, ``m.output`` (also ``m()``) is the summed Variable."""
+
+    def __init__(self, size, act, bias_attr, name):
+        self.size = size
+        self.act = act
+        self.bias_attr = bias_attr
+        self.name = name
+        self.projections = []
+        self.output = None
+
+    def __iadd__(self, proj):
+        self.projections.append(proj)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.output = mixed_layer(size=self.size,
+                                      input=self.projections, act=self.act,
+                                      bias_attr=self.bias_attr,
+                                      name=self.name)
+        return False
+
+    def __call__(self):
+        return self.output
+
+
+def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=False,
+                layer_attr=None):
+    """Sum of projection/operator fragments (+ bias, activation)
+    (reference ``layers.py:869`` over MixedLayer.cpp)."""
+    if input is None:
+        return _MixedLayerWith(size, act, bias_attr, name)
+    parts = []
+    for p in _to_list(input):
+        parts.append(p.build(size) if isinstance(p, BaseProjection) else p)
+    out = parts[0] if len(parts) == 1 else F.sums(parts)
+    if bias_attr is not False and bias_attr is not None:
+        b = F.create_parameter(shape=[size or out.shape[-1]],
+                               dtype=out.dtype, is_bias=True,
+                               attr=None if bias_attr is True else bias_attr)
+        out = F.elementwise_add(out, b, axis=1)
+    out = _apply_act(out, act)
+    return _named(out, name)
+
+
+# ---------------------------------------------------------------------------
+# recurrent machinery: memory / recurrent_group / step layers
+# (reference ``layers.py:3669`` memory, ``:4161`` recurrent_group over
+# RecurrentGradientMachine.cpp — here one DynamicRNN while_loop)
+# ---------------------------------------------------------------------------
+
+class _RecurrentCtx:
+    """Active recurrent_group (or generation loop) bookkeeping: memories
+    pending name-binding and layers registered under a DSL ``name``."""
+
+    def __init__(self, kind, drnn=None):
+        self.kind = kind          # "group" | "gen"
+        self.drnn = drnn
+        self.pending = {}         # memory name -> pre-state Variable
+        self.named = {}           # DSL name -> produced Variable
+        self.boots = {}           # memory name -> boot spec (gen loops)
+
+
+_ACTIVE = []
+
+
+def _named(out, name):
+    """Register ``out`` under the DSL ``name`` inside an active recurrent
+    context (the reference binds memories to same-named layers)."""
+    if name and _ACTIVE:
+        _ACTIVE[-1].named[name] = out
+    return out
+
+
+class StaticInput:
+    """Non-sequence (or whole-sequence) input visible at every step
+    (reference ``layers.py`` StaticInput)."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        self.input = input
+        self.is_seq = is_seq
+        self.size = size
+
+
+def SubsequenceInput(input):
+    """Nested-sequence step input (reference ``layers.py:4146``); the
+    TPU DynamicRNN consumes the outer level."""
+    return input
+
+
+class GeneratedInput:
+    """Generation-loop input spec (reference ``layers.py`` GeneratedInput):
+    the previous step's beam token, embedded."""
+
+    def __init__(self, size, embedding_name, embedding_size):
+        self.size = size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+class _NeedBoot(Exception):
+    def __init__(self, name):
+        self.name = name
+        super().__init__(name)
+
+
+def memory(name=None, size=None, boot_layer=None, is_seq=False,
+           boot_with_const_id=None, boot_bias=None, value=0.0):
+    """Previous-step state inside recurrent_group (reference
+    ``layers.py:3669``).  Bind by creating a layer with the same ``name``
+    in the step (fc_layer/mixed_layer/gru_step_layer/... all register
+    their ``name``)."""
+    if not _ACTIVE:
+        raise ValueError("memory() must be called inside recurrent_group "
+                         "or beam_search")
+    ctx = _ACTIVE[-1]
+    if ctx.kind == "gen":
+        # generation loop: current value if materialized, else signal the
+        # driver to create boots and re-run the step
+        if name in ctx.named:
+            return ctx.named[name]
+        ctx.boots[name] = {"size": size, "boot_layer": boot_layer,
+                           "value": value}
+        raise _NeedBoot(name)
+    mem = ctx.drnn.memory(init=boot_layer) if boot_layer is not None \
+        else ctx.drnn.memory(shape=[size], value=value)
+    if name:
+        ctx.pending[name] = mem
+    return mem
+
+
+def recurrent_group(step, input, reverse=False, name=None,
+                    targetInlink=None):
+    """Run ``step`` over each timestep of the input sequence(s)
+    (reference ``layers.py:4161`` over RecurrentGradientMachine.cpp).
+
+    TPU mapping: ONE masked ``lax.while_loop`` via DynamicRNN — ragged
+    sequences ride the LoD rank table, memories are loop carries.
+    ``reverse=True`` reverses the sequences in and the outputs back out
+    (sequence_reverse op), matching the reference's backward-time group.
+    Memories bind by name: create the new state with the same DSL
+    ``name=`` the memory was declared with.  If ``step`` returns a dict,
+    outputs keep their keys and ``get_output_layer`` selects by key.
+    """
+    inputs = _to_list(input)
+    seq_inputs = [i for i in inputs if not isinstance(i, StaticInput)]
+    if not seq_inputs:
+        raise ValueError("recurrent_group needs at least one sequence "
+                         "input")
+    if reverse:
+        seq_inputs = [F.sequence_reverse(x) for x in seq_inputs]
+
+    drnn = F.DynamicRNN()
+    ctx = _RecurrentCtx("group", drnn)
+    _ACTIVE.append(ctx)
+    names = None
+    try:
+        with drnn.block():
+            # step_input first: it builds the lod rank table that
+            # static_input reorders by
+            seq_it = iter(seq_inputs)
+            step_args = [None if isinstance(i, StaticInput)
+                         else drnn.step_input(next(seq_it)) for i in inputs]
+            for k, i in enumerate(inputs):
+                if isinstance(i, StaticInput):
+                    step_args[k] = drnn.static_input(i.input)
+            result = step(*step_args)
+            if isinstance(result, dict):
+                names = list(result)
+                outs = [result[k] for k in names]
+            else:
+                outs = _to_list(result)
+            for mem_name, pre in ctx.pending.items():
+                new = ctx.named.get(mem_name)
+                if new is None:
+                    raise ValueError(
+                        f"memory(name={mem_name!r}) was never bound: "
+                        f"create a layer with name={mem_name!r} in the "
+                        f"step function")
+                drnn.update_memory(pre, new)
+            drnn.output(*outs)
+    finally:
+        _ACTIVE.pop()
+    result = drnn()
+    result_list = _to_list(result)
+    # propagate feature shapes lost through the tensor-array round-trip
+    for res, step_out in zip(result_list, outs):
+        if res.shape is None and step_out.shape is not None:
+            res.shape = (-1,) + tuple(step_out.shape[1:])
+            res.dtype = step_out.dtype
+            res.lod_level = max(res.lod_level, 1)
+    if reverse:
+        result_list = [F.sequence_reverse(o) for o in result_list]
+    first = result_list[0]
+    if names:
+        first._rg_named_outputs = dict(zip(names, result_list))
+        return _named(first, name)
+    if len(result_list) > 1:
+        return result_list
+    return _named(first, name)
+
+
+def get_output_layer(input, arg_name, name=None, layer_attr=None):
+    """Select a non-default output of a multi-output step layer
+    (reference ``layers.py:4023``): dict-returning recurrent_group keys,
+    or an lstm_step_layer's ``'state'``."""
+    if arg_name == "state" and hasattr(input, "_lstm_state"):
+        return _named(input._lstm_state, name)
+    named = getattr(input, "_rg_named_outputs", None)
+    if named and arg_name in named:
+        return _named(named[arg_name], name)
+    raise ValueError(f"get_output_layer: {arg_name!r} is not an output "
+                     f"of this layer")
+
+
+def recurrent_layer(input, act=None, bias_attr=None, param_attr=None,
+                    name=None, reverse=False):
+    """Simple full-matrix recurrence h_t = act(x_t + h_{t-1} @ W)
+    (reference ``layers.py:4067`` over RecurrentLayer.cpp)."""
+    size = input.shape[-1]
+    mem_name = f"{name or 'recurrent'}@mem"
+
+    def step(x):
+        prev = memory(name=mem_name, size=size)
+        h = F.elementwise_add(x, F.fc(input=prev, size=size,
+                                      bias_attr=bias_attr,
+                                      param_attr=param_attr))
+        h = _apply_act(h, act or "tanh")
+        _named(h, mem_name)
+        return h
+
+    return _named(recurrent_group(step, input, reverse=reverse), name)
+
+
+def lstm_step_layer(input, state, size=None, act=None, gate_act=None,
+                    state_act=None, bias_attr=None, name=None,
+                    layer_attr=None):
+    """One LSTM cell update from a pre-projected [N, 4H] input
+    (reference ``layers.py:3765`` over LstmStepLayer.cpp; gate order
+    i, g, f, o).  Returns the hidden; the new cell rides
+    ``get_output_layer(..., arg_name='state')``."""
+    size = size or state.shape[-1]
+    i, g, f, o = F.split(input, 4, dim=-1)
+    i = _apply_act(i, gate_act or "sigmoid")
+    f = _apply_act(f, gate_act or "sigmoid")
+    o = _apply_act(o, gate_act or "sigmoid")
+    g = _apply_act(g, state_act or "tanh")
+    c = F.elementwise_add(F.elementwise_mul(f, state),
+                          F.elementwise_mul(i, g))
+    h = F.elementwise_mul(o, _apply_act(c, act or "tanh"))
+    h._lstm_state = c
+    return _named(h, name)
+
+
+def gru_step_layer(input, output_mem, size=None, act=None, gate_act=None,
+                   name=None, bias_attr=None, param_attr=None,
+                   layer_attr=None):
+    """One GRU cell update from a pre-projected [N, 3H] input; owns the
+    recurrent weights U (reference ``layers.py:3863`` over
+    GruStepLayer.cpp)."""
+    size = size or input.shape[-1] // 3
+    u_rz = F.create_parameter(shape=[size, 2 * size],
+                              dtype=input.dtype, attr=param_attr)
+    u_c = F.create_parameter(
+        shape=[size, size], dtype=input.dtype,
+        attr=_ParamAttr(name=f"{param_attr.name}.candidate")
+        if isinstance(param_attr, _ParamAttr) and param_attr.name else None)
+    x_r, x_z, x_c = F.split(input, 3, dim=-1)
+    h_rz = F.matmul(output_mem, u_rz)
+    h_r, h_z = F.split(h_rz, 2, dim=-1)
+    if bias_attr is not False and bias_attr is not None:
+        b = F.create_parameter(shape=[3 * size], dtype=input.dtype,
+                               is_bias=True,
+                               attr=None if bias_attr is True else bias_attr)
+        b_r, b_z, b_c = F.split(b, 3, dim=-1)
+        x_r = F.elementwise_add(x_r, b_r, axis=1)
+        x_z = F.elementwise_add(x_z, b_z, axis=1)
+        x_c = F.elementwise_add(x_c, b_c, axis=1)
+    r = _apply_act(F.elementwise_add(x_r, h_r), gate_act or "sigmoid")
+    z = _apply_act(F.elementwise_add(x_z, h_z), gate_act or "sigmoid")
+    c = _apply_act(
+        F.elementwise_add(x_c, F.matmul(F.elementwise_mul(r, output_mem),
+                                        u_c)),
+        act or "tanh")
+    one_minus_z = F.scale(z, scale=-1.0, bias=1.0)
+    h = F.elementwise_add(F.elementwise_mul(z, output_mem),
+                          F.elementwise_mul(one_minus_z, c))
+    return _named(h, name)
+
+
+gru_step_naive_layer = gru_step_layer
+
+
+# ---------------------------------------------------------------------------
+# generation: legacy beam_search (reference ``layers.py:4485`` over
+# RecurrentGradientMachine generation mode).  TPU mapping: unrolled dense
+# [B*K] decode under a deterministic name scope (weights shared across
+# timesteps), beam_search/beam_search_decode IR ops per step.
+# ---------------------------------------------------------------------------
+
+def beam_search(step, input, bos_id, eos_id, beam_size,
+                max_length=30, name=None, num_results_per_sample=None):
+    """Beam-search generation.  ``input`` mixes StaticInput (encoder
+    context, tiled over beams) and one GeneratedInput (previous token,
+    embedded with the trained embedding).  ``step`` is the same function
+    used for the training-time recurrent_group; memories bind by name.
+    Returns (sentence_ids [B, K, T], scores [B, K]) Variables.
+    """
+    inputs = _to_list(input)
+    gens = [i for i in inputs if isinstance(i, GeneratedInput)]
+    statics = [i for i in inputs if isinstance(i, StaticInput)]
+    if len(gens) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput")
+    if not statics:
+        raise ValueError("beam_search needs a StaticInput for the batch "
+                         "shape (encoder context)")
+    gen = gens[0]
+    K = beam_size
+
+    # tile static inputs over the beam axis: [B, D] -> [B*K, D]
+    tiled = {}
+    batch_var = statics[0].input
+    for s in statics:
+        v = s.input
+        d = v.shape[-1]
+        tiled[id(s)] = F.reshape(
+            F.expand(F.reshape(v, shape=[-1, 1, d]), expand_times=[1, K, 1]),
+            shape=[-1, d])
+
+    # initial beams: token bos, scores [0, -inf, ...] per batch row
+    ones_b = F.fill_constant_batch_size_like(input=batch_var, shape=[-1, K],
+                                             dtype="int64", value=1)
+    pre_ids = F.cast(F.scale(F.cast(ones_b, "float32"),
+                             scale=float(bos_id)), "int64")
+    zeros_b = F.fill_constant_batch_size_like(
+        input=batch_var, shape=[-1, 1], dtype="float32", value=0.0)
+    if K > 1:
+        ninf_b = F.fill_constant_batch_size_like(
+            input=batch_var, shape=[-1, K - 1], dtype="float32", value=-1e9)
+        pre_scores = F.concat([zeros_b, ninf_b], axis=1)
+    else:
+        pre_scores = zeros_b
+    # arange(B)*K per-row offset for flattening parent indices
+    row_ones = F.fill_constant_batch_size_like(
+        input=batch_var, shape=[-1, 1], dtype="float32", value=1.0)
+    arange_b = F.scale(F.cumsum(row_ones, axis=0), scale=1.0, bias=-1.0)
+    beam_offset = F.cast(
+        F.expand(F.scale(arange_b, scale=float(K)), expand_times=[1, K]),
+        "int64")
+
+    ctx = _RecurrentCtx("gen")
+    mems = {}                 # memory name -> current [B*K, D] value
+    ids_arr = par_arr = None
+    _ACTIVE.append(ctx)
+    try:
+        for t in range(max_length):
+            cur_emb = F.embedding(
+                input=F.reshape(pre_ids, shape=[-1, 1]),
+                size=[gen.size, gen.embedding_size],
+                param_attr=_ParamAttr(name=gen.embedding_name))
+            step_args = []
+            for i in inputs:
+                if isinstance(i, StaticInput):
+                    step_args.append(tiled[id(i)])
+                else:
+                    step_args.append(cur_emb)
+            # run the step; each not-yet-materialized memory() raises
+            # _NeedBoot — materialize its boot value (tiled over beams,
+            # OUTSIDE the name scope so per-t vars stay distinct) and
+            # retry until the step completes
+            probs = None
+            for _ in range(16):
+                ctx.named = dict(mems)
+                ctx.boots = {}
+                try:
+                    with unique_name_scope(f"{name or 'beam'}@step/"):
+                        probs = step(*step_args)
+                    break
+                except _NeedBoot:
+                    pass
+                for mname, spec in ctx.boots.items():
+                    if mname in mems:
+                        continue
+                    if spec["boot_layer"] is not None:
+                        bl = spec["boot_layer"]
+                        d = bl.shape[-1]
+                        mems[mname] = F.reshape(
+                            F.expand(F.reshape(bl, shape=[-1, 1, d]),
+                                     expand_times=[1, K, 1]),
+                            shape=[-1, d])
+                    else:
+                        mems[mname] = F.fill_constant_batch_size_like(
+                            input=cur_emb, shape=[-1, spec["size"]],
+                            dtype="float32", value=spec["value"])
+            if probs is None:
+                raise ValueError("beam_search step kept declaring new "
+                                 "memories (>16)")
+
+            vocab = probs.shape[-1]
+            probs3 = F.reshape(probs, shape=[-1, K, vocab])
+            topk_scores, topk_idx = F.topk(probs3, k=K)
+            acc = F.elementwise_add(
+                F.ops.log(topk_scores),
+                F.reshape(pre_scores, shape=[-1, K, 1]))
+            sel_ids, sel_scores, parent = F.beam_search(
+                pre_ids, pre_scores, topk_idx, acc, K, end_id=eos_id)
+            flat_parent = F.reshape(
+                F.elementwise_add(F.cast(parent, "int64"), beam_offset),
+                shape=[-1])
+            # reorder memories by winning parent beam
+            new_mems = {}
+            for mname in list(mems):
+                new_val = ctx.named.get(mname)
+                if new_val is None or new_val is mems[mname]:
+                    raise ValueError(
+                        f"beam_search memory {mname!r} was never updated "
+                        f"by the step function (bind a layer with "
+                        f"name={mname!r})")
+                new_mems[mname] = F.gather(new_val, flat_parent)
+            mems = new_mems
+            it = F.fill_constant(shape=[1], dtype="int64", value=t)
+            if ids_arr is None:
+                ids_arr = F.array_write(sel_ids, i=it)
+                par_arr = F.array_write(parent, i=it)
+            else:
+                F.array_write(sel_ids, i=it, array=ids_arr)
+                F.array_write(parent, i=it, array=par_arr)
+            pre_ids, pre_scores = sel_ids, sel_scores
+    finally:
+        _ACTIVE.pop()
+    sentences, scores = F.beam_search_decode(ids_arr, par_arr, pre_scores,
+                                             max_len=max_length)
+    return sentences, scores
+
+
+def eos_layer(input, eos_id, name=None, layer_attr=None):
+    """1.0 where the id equals ``eos_id`` (reference ``layers.py:4445``)."""
+    ids = F.cast(input, "int64")
+    eos = F.fill_constant_batch_size_like(input=ids, shape=[-1, 1],
+                                          dtype="int64", value=eos_id)
+    out = F.cast(F.equal(ids, eos), "float32")
+    return _named(out, name)
+
+
+# ---------------------------------------------------------------------------
+# io / basic layers
+# ---------------------------------------------------------------------------
 
 def data_layer(name, size, height=None, width=None, type=None):
     from paddle_tpu.v2 import data_type as dt
@@ -27,81 +716,738 @@ def data_layer(name, size, height=None, width=None, type=None):
 
 def fc_layer(input, size, act=None, param_attr=None, bias_attr=None,
              name=None, layer_attr=None):
-    return _v2.fc(input=input, size=size, act=act, param_attr=param_attr,
-                  bias_attr=bias_attr, name=name)
+    out = _v2.fc(input=input, size=size, act=act, param_attr=param_attr,
+                 bias_attr=bias_attr)
+    return _named(out, name)
 
 
-def embedding_layer(input, size, param_attr=None):
-    return _v2.embedding(input=input, size=size, param_attr=param_attr)
+def embedding_layer(input, size, name=None, param_attr=None,
+                    layer_attr=None):
+    return _named(_v2.embedding(input=input, size=size,
+                                param_attr=param_attr), name)
 
 
 def img_conv_layer(input, filter_size, num_filters, num_channel=None,
                    act=None, padding=0, stride=1, bias_attr=None,
-                   param_attr=None, name=None, **kwargs):
-    return _v2.img_conv(input=input, filter_size=filter_size,
-                        num_filters=num_filters, num_channel=num_channel,
-                        act=act, padding=padding, stride=stride,
-                        bias_attr=bias_attr, param_attr=param_attr)
+                   param_attr=None, name=None, groups=1, dilation=1,
+                   trans=False, **kwargs):
+    if trans:
+        out = F.conv2d_transpose(input=input, num_filters=num_filters,
+                                 filter_size=filter_size, stride=stride,
+                                 padding=padding, act=_act_name(act),
+                                 bias_attr=bias_attr, param_attr=param_attr)
+    else:
+        out = F.conv2d(input=input, num_filters=num_filters,
+                       filter_size=filter_size, stride=stride,
+                       padding=padding, dilation=dilation,
+                       groups=groups or 1, act=_act_name(act),
+                       bias_attr=bias_attr, param_attr=param_attr)
+    return _named(out, name)
+
+
+def img_conv3d_layer(input, filter_size, num_filters, num_channels=None,
+                     act=None, padding=0, stride=1, bias_attr=None,
+                     param_attr=None, name=None, groups=1, **kwargs):
+    out = F.conv3d(input=input, num_filters=num_filters,
+                   filter_size=filter_size, stride=stride, padding=padding,
+                   groups=groups or 1, act=_act_name(act),
+                   bias_attr=bias_attr, param_attr=param_attr)
+    return _named(out, name)
 
 
 def img_pool_layer(input, pool_size, name=None, num_channels=None,
                    pool_type=None, stride=None, padding=0, **kwargs):
-    return _v2.img_pool(input=input, pool_size=pool_size,
-                        pool_type=pool_type, stride=stride, padding=padding)
+    return _named(_v2.img_pool(input=input, pool_size=pool_size,
+                               pool_type=pool_type, stride=stride,
+                               padding=padding), name)
+
+
+def img_pool3d_layer(input, pool_size, name=None, num_channels=None,
+                     pool_type=None, stride=None, padding=0, **kwargs):
+    ptype = getattr(pool_type, "name", pool_type) or "max"
+    ptype = "avg" if ptype in ("average", "avg") else ptype
+    return _named(F.pool3d(input=input, pool_size=pool_size,
+                           pool_type=ptype,
+                           pool_stride=stride or pool_size,
+                           pool_padding=padding), name)
 
 
 def batch_norm_layer(input, act=None, name=None, **kwargs):
-    return _v2.batch_norm(input=input, act=act, **kwargs)
+    return _named(_v2.batch_norm(input=input, act=act), name)
 
 
 def dropout_layer(input, dropout_rate, name=None):
-    return _v2.dropout(input=input, dropout_rate=dropout_rate)
+    return _named(_v2.dropout(input=input, dropout_rate=dropout_rate), name)
 
 
-def concat_layer(input, act=None, name=None):
+def concat_layer(input, act=None, name=None, layer_attr=None,
+                 bias_attr=None):
     out = _v2.concat(input=input, name=name)
-    act_name = _v2._act_name(act)
-    if act_name and act_name not in ("linear", "identity"):
-        from paddle_tpu import layers as F
-        out = getattr(F, act_name)(out)
-    return out
+    return _named(_apply_act(out, act), name)
+
+
+def seq_concat_layer(a, b, act=None, name=None, **kwargs):
+    return _named(_apply_act(_v2.seq_concat(a, b), act), name)
+
+
+def printer_layer(input, format=None, name=None):
+    for v in _to_list(input):
+        F.Print(v, message=format or name or "printer")
+    return input
 
 
 def lstmemory(input, size=None, reverse=False, act=None, name=None,
               **kwargs):
-    return _v2.lstmemory(input=input, size=size, reverse=reverse, act=act,
-                         **kwargs)
+    return _named(_v2.lstmemory(input=input, size=size, reverse=reverse,
+                                act=act, **kwargs), name)
 
 
 def grumemory(input, size=None, reverse=False, act=None, name=None,
               **kwargs):
-    return _v2.gru(input=input, size=size, reverse=reverse, act=act,
-                   **kwargs)
+    return _named(_v2.gru(input=input, size=size, reverse=reverse, act=act,
+                          **kwargs), name)
 
+
+# ---------------------------------------------------------------------------
+# sequence layers
+# ---------------------------------------------------------------------------
 
 def pooling_layer(input, pooling_type=None, name=None, **kwargs):
-    return _v2.pooling(input=input, pooling_type=pooling_type, name=name)
+    return _named(_v2.pooling(input=input, pooling_type=pooling_type), name)
 
 
 def last_seq(input, name=None, **kwargs):
-    return _v2.last_seq(input=input, name=name)
+    return _named(_v2.last_seq(input=input), name)
 
 
 def first_seq(input, name=None, **kwargs):
-    return _v2.first_seq(input=input, name=name)
+    return _named(_v2.first_seq(input=input), name)
 
 
-def classification_cost(input, label, name=None, **kwargs):
-    return _v2.classification_cost(input=input, label=label, name=name)
+def expand_layer(input, expand_as, name=None, bias_attr=None,
+                 expand_level=None):
+    return _named(_v2.expand(input=input, expand_as=expand_as), name)
 
 
-def cross_entropy(input, label, name=None, **kwargs):
-    return _v2.cross_entropy_cost(input=input, label=label, name=name)
+def repeat_layer(input, num_repeats, as_row_vector=True, act=None,
+                 name=None, layer_attr=None):
+    """Tile each row ``num_repeats`` times along the feature axis
+    (reference ``layers.py:1916``): [a, b] x3 -> [a, b, a, b, a, b]
+    (as_row_vector) or [a, a, a, b, b, b]."""
+    if as_row_vector:
+        out = F.concat([input] * num_repeats, axis=1)
+    else:
+        d = input.shape[-1]
+        out = F.reshape(
+            F.expand(F.reshape(input, shape=[-1, d, 1]),
+                     expand_times=[1, 1, num_repeats]),
+            shape=[-1, d * num_repeats])
+    return _named(_apply_act(out, act), name)
 
 
-def square_error_cost(input, label, name=None, **kwargs):
-    return _v2.square_error_cost(input=input, label=label, name=name)
+def seq_reshape_layer(input, reshape_size, act=None, name=None,
+                      bias_attr=None, layer_attr=None):
+    return _named(_apply_act(F.sequence_reshape(input, reshape_size), act),
+                  name)
+
+
+def seq_slice_layer(input, starts, ends, name=None):
+    """Per-sequence slice [starts, ends) (reference ``layers.py:7125``);
+    starts/ends are [B]-shaped layers."""
+    if starts is None or ends is None:
+        raise ValueError("seq_slice_layer needs both starts and ends")
+    length = F.elementwise_sub(ends, starts)
+    return _named(F.sequence_slice(input, starts, length), name)
+
+
+def sub_seq_layer(input, offsets, sizes, act=None, bias_attr=None,
+                  name=None):
+    return _named(_apply_act(F.sequence_slice(input, offsets, sizes), act),
+                  name)
+
+
+def kmax_seq_score_layer(input, name=None, beam_size=1):
+    """Top scores within each sequence (reference ``layers.py:7191``);
+    k=1 == sequence max pool (the common configuration)."""
+    if beam_size == 1:
+        return _named(F.sequence_pool(input, pool_type="max"), name)
+    raise NotImplementedError(
+        "kmax_seq_score_layer beam_size>1: use the beam_search ops")
+
+
+def ctc_layer(input, label, size=None, name=None, norm_by_times=False,
+              layer_attr=None):
+    """CTC cost (reference ``layers.py:5602`` over warp-ctc); pass the
+    PRE-softmax projection — the lowering normalizes internally."""
+    return _named(F.mean(F.warpctc(input, label,
+                                   norm_by_times=norm_by_times)), name)
+
+
+warp_ctc_layer = ctc_layer
+
+
+# ---------------------------------------------------------------------------
+# elementwise / math layers
+# ---------------------------------------------------------------------------
+
+def addto_layer(input, act=None, name=None, bias_attr=None,
+                layer_attr=None):
+    """Elementwise sum of inputs (+bias, act) (reference
+    ``layers.py:3451`` over AddtoLayer.cpp)."""
+    parts = _to_list(input)
+    out = parts[0] if len(parts) == 1 else F.sums(parts)
+    if bias_attr is not False and bias_attr is not None:
+        b = F.create_parameter(shape=[out.shape[-1]], dtype=out.dtype,
+                               is_bias=True,
+                               attr=None if bias_attr is True else bias_attr)
+        out = F.elementwise_add(out, b, axis=1)
+    return _named(_apply_act(out, act), name)
+
+
+def interpolation_layer(input, weight, name=None, layer_attr=None):
+    """w*a + (1-w)*b with per-row weight [N, 1] (reference
+    ``layers.py:2036`` over InterpolationLayer.cpp)."""
+    a, b = input
+    wa = F.elementwise_mul(a, weight, axis=0)
+    one_minus = F.scale(weight, scale=-1.0, bias=1.0)
+    wb = F.elementwise_mul(b, one_minus, axis=0)
+    return _named(F.elementwise_add(wa, wb), name)
+
+
+def bilinear_interp_layer(input, out_size_x=None, out_size_y=None,
+                          name=None, layer_attr=None):
+    """Bilinear upsampling of NCHW maps (reference ``layers.py:2089`` over
+    BilinearInterpLayer.cpp)."""
+    return _named(F.image_resize(input, (out_size_y, out_size_x),
+                                 method="bilinear"), name)
+
+
+def upsample_layer(input, scale=2, upsample_size=None, name=None,
+                   **kwargs):
+    """Nearest-neighbour upsampling (reference UpsampleLayer.cpp)."""
+    h, w = input.shape[2], input.shape[3]
+    out_hw = upsample_size or (h * scale, w * scale)
+    return _named(F.image_resize(input, out_hw, method="nearest"), name)
+
+
+def power_layer(input, weight, name=None, layer_attr=None):
+    """x ** w per row, weight [N, 1] (reference ``layers.py:2144``)."""
+    return _named(F.elementwise_pow(input, weight, axis=0), name)
+
+
+def scaling_layer(input, weight, name=None, layer_attr=None):
+    return _named(_v2.scaling(input, weight), name)
+
+
+def slope_intercept_layer(input, slope=1.0, intercept=0.0, name=None,
+                          layer_attr=None):
+    return _named(_v2.slope_intercept(input, slope, intercept), name)
+
+
+def trans_layer(input, name=None, layer_attr=None):
+    """Matrix transpose (reference ``layers.py:2232`` TransLayer.cpp)."""
+    return _named(F.transpose(input, perm=[1, 0]), name)
+
+
+def rotate_layer(input, height, width, name=None, layer_attr=None):
+    """Rotate each row's [height, width] map 90° counter-clockwise
+    (reference ``layers.py:2268`` RotateLayer.cpp)."""
+    x = F.reshape(input, shape=[-1, height, width])
+    xt = F.transpose(x, perm=[0, 2, 1])            # [N, W, H]
+    rev = _constant(np.arange(width - 1, -1, -1), "int64")
+    # flip the (new) row axis: gather is axis-0, so route through
+    # transpose: [N, W, H] -> [W, N, H] -> gather -> back
+    wnh = F.transpose(xt, perm=[1, 0, 2])
+    flipped = F.gather(wnh, rev)
+    out = F.transpose(flipped, perm=[1, 0, 2])
+    return _named(F.reshape(out, shape=[-1, height * width]), name)
+
+
+def cos_sim(a, b, scale=1, size=1, name=None, layer_attr=None):
+    """Row-wise cosine similarity * scale (reference ``layers.py:2317``)."""
+    out = F.cos_sim(a, b)
+    if scale != 1:
+        out = F.scale(out, scale=float(scale))
+    return _named(out, name)
+
+
+def l2_distance_layer(x, y, name=None, layer_attr=None):
+    """Row-wise euclidean distance (reference ``layers.py:2376``)."""
+    diff = F.elementwise_sub(x, y)
+    return _named(F.sqrt(F.reduce_sum(F.square(diff), dim=1,
+                                      keep_dim=True)), name)
+
+
+def dot_prod_layer(input1, input2, name=None, layer_attr=None):
+    """Row-wise inner product (reference ``layers.py:4367``)."""
+    return _named(F.reduce_sum(F.elementwise_mul(input1, input2), dim=1,
+                               keep_dim=True), name)
+
+
+def out_prod_layer(input1, input2, name=None, layer_attr=None):
+    """Row-wise outer product, flattened (reference ``layers.py:4406``)."""
+    da, db = input1.shape[-1], input2.shape[-1]
+    a = F.reshape(input1, shape=[-1, da, 1])
+    b = F.reshape(input2, shape=[-1, 1, db])
+    return _named(F.reshape(F.matmul(a, b), shape=[-1, da * db]), name)
+
+
+def linear_comb_layer(weights, vectors, size=None, name=None,
+                      layer_attr=None):
+    """z = w . reshape(v, [M, size]) per row (reference
+    ``layers.py:5367`` LinearCombinationLayer)."""
+    m = weights.shape[-1]
+    size = size or vectors.shape[-1] // m
+    v = F.reshape(vectors, shape=[-1, m, size])
+    w = F.reshape(weights, shape=[-1, m, 1])
+    return _named(F.reshape(F.reduce_sum(F.elementwise_mul(v, w), dim=1),
+                            shape=[-1, size]), name)
+
+
+def tensor_layer(a, b, size, act=None, name=None, param_attr=None,
+                 bias_attr=None, layer_attr=None):
+    """Bilinear tensor product y_k = a W_k b (reference ``layers.py:5118``
+    over TensorLayer.cpp; lowered through bilinear_tensor_product)."""
+    from paddle_tpu.layer_helper import LayerHelper
+    helper = LayerHelper("bilinear_tensor_product", param_attr=param_attr,
+                         bias_attr=bias_attr, act=_act_name(act), name=name)
+    da, db = a.shape[-1], b.shape[-1]
+    w = helper.create_parameter(helper.param_attr, shape=[size, da, db],
+                                dtype=a.dtype)
+    out = helper.create_tmp_variable(a.dtype)
+    helper.append_op(type="bilinear_tensor_product",
+                     inputs={"X": [a], "Y": [b], "Weight": [w]},
+                     outputs={"Out": [out]})
+    pre = helper.append_bias_op(out)
+    return _named(helper.append_activation(pre), name)
+
+
+def selective_fc_layer(input, size, select=None, act=None,
+                       param_attr=None, bias_attr=None, name=None,
+                       **kwargs):
+    """FC whose output is masked by ``select`` (reference
+    ``layers.py:5188``; the dense TPU lowering computes all columns and
+    masks — MXU-friendly, no gather)."""
+    out = _v2.fc(input=input, size=size, act=act, param_attr=param_attr,
+                 bias_attr=bias_attr)
+    if select is not None:
+        out = F.elementwise_mul(out, select)
+    return _named(out, name)
+
+
+def sampling_id_layer(input, name=None, layer_attr=None):
+    """Sample one id per row from a probability row (reference
+    ``layers.py:5291`` over SamplingIdLayer.cpp): inverse-CDF with
+    PER-ROW uniforms drawn from the traced RNG key (sampling_id op)."""
+    from paddle_tpu.layer_helper import LayerHelper
+    helper = LayerHelper("sampling_id", name=name)
+    out = helper.create_tmp_variable(dtype="int64")
+    out.stop_gradient = True
+    helper.append_op(type="sampling_id", inputs={"X": [input]},
+                     outputs={"Out": [out]})
+    return _named(out, name)
+
+
+def maxid_layer(input, name=None, layer_attr=None):
+    return _named(_v2.max_id(input), name)
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None,
+              layer_attr=None):
+    """Zero-pad NCHW maps per axis (reference ``layers.py:4961``)."""
+    pads = [0, 0] + list(pad_c or [0, 0]) + list(pad_h or [0, 0]) + \
+        list(pad_w or [0, 0])
+    return _named(F.pad(input, paddings=pads), name)
+
+
+def conv_shift_layer(a, b, name=None, layer_attr=None):
+    return _named(F.conv_shift(a, b), name)
+
+
+def block_expand_layer(input, block_x=1, block_y=1, stride_x=1, stride_y=1,
+                       padding_x=0, padding_y=0, num_channels=None,
+                       name=None, layer_attr=None):
+    """Image -> sequence of patches (reference ``layers.py:5437`` over
+    BlockExpandLayer.cpp == fluid im2sequence)."""
+    return _named(F.im2sequence(input, filter_size=[block_y, block_x],
+                                stride=[stride_y, stride_x],
+                                padding=[padding_y, padding_x]), name)
+
+
+def maxout_layer(input, groups, num_channels=None, name=None,
+                 layer_attr=None):
+    return _named(F.maxout(input, groups), name)
+
+
+def multiplex_layer(input, name=None, layer_attr=None):
+    """Row-wise select among inputs[1:] by index column inputs[0]
+    (reference ``layers.py:6606``)."""
+    index = input[0]
+    return _named(F.multiplex(list(input[1:]), index), name)
+
+
+def prelu_layer(input, name=None, partial_sum=1, param_attr=None,
+                layer_attr=None):
+    return _named(F.prelu(input, mode="all", param_attr=param_attr), name)
+
+
+def gated_unit_layer(input, size, act=None, name=None, gate_attr=None,
+                     gate_param_attr=None, gate_bias_attr=None,
+                     inproj_attr=None, inproj_param_attr=None,
+                     inproj_bias_attr=None, layer_attr=None):
+    """GLU: fc(x) * sigmoid(fc_g(x)) (reference ``layers.py:6852``)."""
+    proj = _v2.fc(input=input, size=size, act=act,
+                  param_attr=inproj_param_attr, bias_attr=inproj_bias_attr)
+    gate = _v2.fc(input=input, size=size, act="sigmoid",
+                  param_attr=gate_param_attr, bias_attr=gate_bias_attr)
+    return _named(F.elementwise_mul(proj, gate), name)
+
+
+def switch_order_layer(input, name=None, reshape_to=None, **kwargs):
+    """Permute axis order, e.g. NCHW <-> NHWC (reference
+    ``layers.py:6945``); ``reshape_to`` lists axis groups, flattened to
+    the permutation."""
+    if not reshape_to:
+        raise ValueError("switch_order_layer needs reshape_to, e.g. "
+                         "[[0], [2, 3, 1]]")
+    perm = [a for grp in reshape_to for a in grp]
+    return _named(F.transpose(input, perm=perm), name)
+
+
+def crop_layer(input, offset, axis=2, shape=None, name=None,
+               layer_attr=None):
+    """Static crop along trailing axes (reference ``layers.py:6994``)."""
+    if shape is None:
+        raise ValueError("crop_layer needs the target shape")
+    sizes = shape[axis:axis + len(offset)] if len(shape) > len(offset) \
+        else shape
+    axes = list(range(axis, axis + len(offset)))
+    starts = list(offset)
+    ends = [o + s for o, s in zip(offset, sizes)]
+    return _named(F.slice(input, axes=axes, starts=starts, ends=ends), name)
+
+
+def clip_layer(input, min, max, name=None):
+    return _named(F.clip(input, min=min, max=max), name)
+
+
+def resize_layer(input, size, name=None):
+    return _named(F.reshape(input, shape=[-1, size]), name)
+
+
+def scale_shift_layer(input, name=None, param_attr=None, bias_attr=None):
+    """w*x + b with scalar w, b (reference ``layers.py:7378``)."""
+    w = F.create_parameter(shape=[1], dtype=input.dtype, attr=param_attr)
+    out = F.elementwise_mul(input, w)
+    if bias_attr is not False:
+        b = F.create_parameter(
+            shape=[1], dtype=input.dtype, is_bias=True,
+            attr=None if bias_attr in (None, True) else bias_attr)
+        out = F.elementwise_add(out, b)
+    return _named(out, name)
+
+
+def factorization_machine(input, factor_size, name=None, param_attr=None,
+                          layer_attr=None):
+    """2nd-order FM interaction 0.5*sum((xV)^2 - (x^2)(V^2)) (reference
+    ``layers.py:7547`` over FactorizationMachineLayer.cpp)."""
+    d = input.shape[-1]
+    v = F.create_parameter(shape=[d, factor_size], dtype=input.dtype,
+                           attr=param_attr)
+    xv = F.matmul(input, v)                        # [N, F]
+    x2v2 = F.matmul(F.square(input), F.square(v))  # [N, F]
+    out = F.scale(F.reduce_sum(F.elementwise_sub(F.square(xv), x2v2),
+                               dim=1, keep_dim=True), scale=0.5)
+    return _named(out, name)
+
+
+# ---------------------------------------------------------------------------
+# norm layers
+# ---------------------------------------------------------------------------
+
+def sum_to_one_norm_layer(input, name=None, layer_attr=None):
+    """Row L1 normalization (reference ``layers.py:3374``)."""
+    s = F.reduce_sum(input, dim=1, keep_dim=True)
+    return _named(F.elementwise_div(input, s, axis=0), name)
+
+
+def row_l2_norm_layer(input, name=None, layer_attr=None):
+    return _named(F.l2_normalize(input, axis=1), name)
+
+
+def img_cmrnorm_layer(input, size=5, scale=0.0128, power=0.75, name=None,
+                      num_channels=None, layer_attr=None):
+    """Cross-map response normalization == LRN (reference
+    ``layers.py:3199`` over CMRProjectionNormLayer.cpp)."""
+    return _named(F.lrn(input, n=size, alpha=scale, beta=power), name)
+
+
+def cross_channel_norm_layer(input, name=None, param_attr=None):
+    """L2 norm across channels with a learned per-channel scale
+    (reference ``layers.py:1377`` over CrossChannelNormLayer.cpp)."""
+    normed = F.l2_normalize(input, axis=1)
+    c = input.shape[1]
+    w = F.create_parameter(shape=[c], dtype=input.dtype, attr=param_attr)
+    return _named(F.elementwise_mul(normed, w, axis=1), name)
+
+
+def spp_layer(input, name=None, num_channels=None, pool_type=None,
+              pyramid_height=3, layer_attr=None):
+    ptype = getattr(pool_type, "name", pool_type) or "max"
+    ptype = "avg" if ptype in ("average", "avg") else ptype
+    return _named(F.spp(input, pyramid_height=pyramid_height,
+                        pool_type=ptype), name)
+
+
+# ---------------------------------------------------------------------------
+# cost layers
+# ---------------------------------------------------------------------------
+
+def classification_cost(input, label, weight=None, name=None,
+                        evaluator=None, layer_attr=None, coeff=1.0):
+    return _named(_v2.classification_cost(input=input, label=label), name)
+
+
+def cross_entropy(input, label, name=None, coeff=1.0, weight=None,
+                  layer_attr=None):
+    out = _v2.cross_entropy_cost(input=input, label=label)
+    if coeff != 1.0:
+        out = F.scale(out, scale=float(coeff))
+    return _named(out, name)
+
+
+def square_error_cost(input, label, name=None, coeff=1.0, layer_attr=None):
+    out = _v2.square_error_cost(input=input, label=label)
+    if coeff != 1.0:
+        out = F.scale(out, scale=float(coeff))
+    return _named(out, name)
 
 
 regression_cost = square_error_cost
 mse_cost = square_error_cost
+
+
+def sum_cost(input, name=None, layer_attr=None):
+    """Sum of all input elements as the cost (reference
+    ``layers.py:6250`` over SumCostLayer.cpp)."""
+    return _named(F.reduce_sum(input), name)
+
+
+def cross_entropy_with_selfnorm(input, label, name=None, coeff=1.0,
+                                softmax_selfnorm_alpha=0.1,
+                                layer_attr=None):
+    """CE + alpha * (log Z)^2 keeping the softmax close to self-normalized
+    (reference ``layers.py:6199``)."""
+    ce = F.mean(F.cross_entropy(input=input, label=label))
+    z = F.reduce_sum(input, dim=1, keep_dim=True)
+    selfnorm = F.mean(F.square(F.ops.log(z)))
+    out = F.elementwise_add(ce, F.scale(selfnorm,
+                                        scale=softmax_selfnorm_alpha))
+    if coeff != 1.0:
+        out = F.scale(out, scale=float(coeff))
+    return _named(out, name)
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, coeff=1.0,
+                                     layer_attr=None):
+    """Independent sigmoid CE per class (reference ``layers.py:6390``);
+    ``input`` should be pre-sigmoid logits."""
+    out = F.mean(F.sigmoid_cross_entropy_with_logits(input, label))
+    if coeff != 1.0:
+        out = F.scale(out, scale=float(coeff))
+    return _named(out, name)
+
+
+def smooth_l1_cost(input, label, name=None, coeff=1.0, layer_attr=None):
+    out = F.mean(F.smooth_l1(input, label))
+    if coeff != 1.0:
+        out = F.scale(out, scale=float(coeff))
+    return _named(out, name)
+
+
+def huber_regression_cost(input, label, name=None, delta=1.0, coeff=1.0,
+                          layer_attr=None):
+    out = F.mean(F.huber_loss(input, label, delta=delta))
+    if coeff != 1.0:
+        out = F.scale(out, scale=float(coeff))
+    return _named(out, name)
+
+
+def huber_classification_cost(input, label, name=None, coeff=1.0,
+                              layer_attr=None):
+    """Huberized hinge on {0,1} labels mapped to {-1,+1} (reference
+    ``layers.py:6337`` over HuberTwoClassification.cpp):
+    -4m if m < -1; (1-m)^2 if -1 <= m < 1; 0 otherwise (m = y'f)."""
+    y = F.scale(F.cast(label, "float32"), scale=2.0, bias=-1.0)
+    m = F.elementwise_mul(input, y)                # margin y'f
+    sq = F.square(F.scale(m, scale=-1.0, bias=1.0))  # (1-m)^2
+    lin = F.scale(m, scale=-4.0)                   # -4m
+    below = F.cast(F.less_than(m, _constant([-1.0], "float32")), "float32")
+    inside = F.elementwise_mul(
+        F.cast(F.less_than(m, _constant([1.0], "float32")), "float32"),
+        F.scale(below, scale=-1.0, bias=1.0))      # -1 <= m < 1
+    loss = F.elementwise_add(F.elementwise_mul(below, lin),
+                             F.elementwise_mul(inside, sq))
+    out = F.mean(loss)
+    if coeff != 1.0:
+        out = F.scale(out, scale=float(coeff))
+    return _named(out, name)
+
+
+def rank_cost(left, right, label, weight=None, name=None, coeff=1.0,
+              layer_attr=None):
+    return _named(_v2.rank_cost(left, right, label), name)
+
+
+def lambda_cost(input, score, name=None, NDCG_num=5, max_sort_size=-1,
+                layer_attr=None):
+    """LambdaRank surrogate (reference ``layers.py:6094`` over
+    LambdaCost.cpp).  TPU simplification: pairwise logistic rank loss over
+    all in-batch pairs where the true score differs — the dense,
+    static-shape analog of the reference's per-query lambda sort."""
+    s = F.reshape(input, shape=[-1, 1])
+    y = F.reshape(F.cast(score, "float32"), shape=[-1, 1])
+    diff_s = F.elementwise_sub(s, F.transpose(s, perm=[1, 0]))
+    diff_y = F.elementwise_sub(y, F.transpose(y, perm=[1, 0]))
+    zero = F.fill_constant(shape=[1], dtype="float32", value=0.0)
+    pij = F.cast(F.greater_than(diff_y, zero), "float32")
+    # log(1 + exp(-diff_s)) via softplus — numerically stable for large
+    # score gaps (naive exp overflows f32 past ~88)
+    log_term = F.softplus(F.scale(diff_s, scale=-1.0))
+    return _named(F.mean(F.elementwise_mul(pij, log_term)), name)
+
+
+def crf_layer(input, label, size=None, weight=None, param_attr=None,
+              name=None, layer_attr=None):
+    return _named(_v2.crf(input=input, label=label, size=size,
+                          param_attr=param_attr), name)
+
+
+def crf_decoding_layer(input, size=None, label=None, param_attr=None,
+                       name=None, layer_attr=None):
+    return _named(_v2.crf_decoding(input=input, size=size, label=label,
+                                   param_attr=param_attr), name)
+
+
+def nce_layer(input, label, num_classes=None, param_attr=None, weight=None,
+              num_neg_samples=10, neg_distribution=None, bias_attr=None,
+              name=None, layer_attr=None):
+    """Noise-contrastive estimation cost (reference ``layers.py:5896``
+    over NCELayer.cpp)."""
+    ins = _to_list(input)
+    x = ins[0] if len(ins) == 1 else F.concat(ins, axis=1)
+    out = F.nce(input=x, label=label, num_total_classes=num_classes,
+                num_neg_samples=num_neg_samples, param_attr=param_attr,
+                bias_attr=bias_attr)
+    return _named(F.mean(out), name)
+
+
+def hsigmoid(input, label, num_classes, name=None, bias_attr=None,
+             param_attr=None, layer_attr=None):
+    """Hierarchical sigmoid over a complete binary tree (reference
+    ``layers.py:2423`` over HierarchicalSigmoidLayer.cpp).
+
+    TPU design: the tree paths (inner-node ids + left/right codes) are
+    precomputed host-side into [C, D] constant tables; the per-sample
+    path logits come from ONE gather + batched dot — dense, static
+    shapes, scatter-free forward."""
+    ins = _to_list(input)
+    x = ins[0] if len(ins) == 1 else F.concat(ins, axis=1)
+    d = x.shape[-1]
+    num_inner = num_classes - 1
+    depth = max(1, int(np.ceil(np.log2(max(2, num_classes)))))
+    # complete-binary-tree paths: class c <-> leaf node (num_inner + c);
+    # walk up to the root collecting (inner node, am-I-right-child code)
+    path_ids = np.zeros((num_classes, depth), np.int64)
+    path_codes = np.zeros((num_classes, depth), np.float32)
+    path_mask = np.zeros((num_classes, depth), np.float32)
+    for c in range(num_classes):
+        node = num_inner + c
+        lvl = 0
+        while node > 0 and lvl < depth:
+            parent = (node - 1) // 2
+            path_ids[c, lvl] = parent
+            path_codes[c, lvl] = float(node == 2 * parent + 2)
+            path_mask[c, lvl] = 1.0
+            node = parent
+            lvl += 1
+    w = F.create_parameter(shape=[num_inner, d], dtype=x.dtype,
+                           attr=param_attr)
+    b = F.create_parameter(shape=[num_inner, 1], dtype=x.dtype,
+                           is_bias=True,
+                           attr=None if bias_attr in (None, True, False)
+                           else bias_attr)
+    ids_t = _constant(path_ids, "int64")      # [C, D]
+    codes_t = _constant(path_codes, "float32")
+    mask_t = _constant(path_mask, "float32")
+    lbl = F.reshape(label, shape=[-1])
+    sample_ids = F.gather(ids_t, lbl)         # [N, D]
+    sample_codes = F.gather(codes_t, lbl)     # [N, D]
+    sample_mask = F.gather(mask_t, lbl)       # [N, D]
+    flat_ids = F.reshape(sample_ids, shape=[-1])
+    w_rows = F.gather(w, flat_ids)            # [N*D, d]
+    b_rows = F.reshape(F.gather(b, flat_ids), shape=[-1, depth])
+    n_d = F.reshape(w_rows, shape=[-1, depth, d])
+    logits = F.elementwise_add(
+        F.reduce_sum(F.elementwise_mul(n_d, F.reshape(x, shape=[-1, 1, d])),
+                     dim=2), b_rows)          # [N, D]
+    # sigmoid CE: code 1 -> right-child target
+    ce = F.sigmoid_cross_entropy_with_logits(logits, sample_codes)
+    loss = F.reduce_sum(F.elementwise_mul(ce, sample_mask), dim=1,
+                        keep_dim=True)
+    return _named(F.mean(loss), name)
+
+
+# ---------------------------------------------------------------------------
+# detection / vision layers
+# ---------------------------------------------------------------------------
+
+def priorbox_layer(input, image, aspect_ratio, variance, min_size,
+                   max_size=None, name=None, **kwargs):
+    box, var = F.prior_box(input=input, image=image, min_sizes=min_size,
+                           max_sizes=max_size or [],
+                           aspect_ratios=aspect_ratio, variance=variance)
+    return _named(box, name)
+
+
+def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
+                           nms_threshold=0.45, nms_top_k=400,
+                           keep_top_k=200, confidence_threshold=0.01,
+                           background_id=0, name=None):
+    loc = input_loc if not isinstance(input_loc, (list, tuple)) \
+        else F.concat(list(input_loc), axis=1)
+    conf = input_conf if not isinstance(input_conf, (list, tuple)) \
+        else F.concat(list(input_conf), axis=1)
+    pb, pbv = priorbox if isinstance(priorbox, (list, tuple)) \
+        else (priorbox, None)
+    out = F.detection_output(loc, conf, pb, pbv,
+                             background_label=background_id,
+                             nms_threshold=nms_threshold,
+                             nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                             score_threshold=confidence_threshold)
+    return _named(out, name)
+
+
+def roi_pool_layer(input, rois, pooled_width, pooled_height,
+                   spatial_scale, num_channels=None, name=None):
+    return _named(F.roi_pool(input, rois, pooled_height=pooled_height,
+                             pooled_width=pooled_width,
+                             spatial_scale=spatial_scale), name)
+
+
+def multibox_loss_layer(input_loc, input_conf, priorbox, label, num_classes,
+                        overlap_threshold=0.5, neg_pos_ratio=3.0,
+                        neg_overlap=0.5, background_id=0, name=None):
+    loc = input_loc if not isinstance(input_loc, (list, tuple)) \
+        else F.concat(list(input_loc), axis=1)
+    conf = input_conf if not isinstance(input_conf, (list, tuple)) \
+        else F.concat(list(input_conf), axis=1)
+    pb, pbv = priorbox if isinstance(priorbox, (list, tuple)) \
+        else (priorbox, None)
+    gt_box, gt_label = label
+    out = F.ssd_loss(loc, conf, gt_box, gt_label, pb, pbv,
+                     background_label=background_id,
+                     overlap_threshold=overlap_threshold,
+                     neg_pos_ratio=neg_pos_ratio,
+                     neg_overlap=neg_overlap)
+    return _named(F.mean(out), name)

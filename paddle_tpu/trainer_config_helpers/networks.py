@@ -1,9 +1,265 @@
 """Composite network helpers (reference
-``trainer_config_helpers/networks.py``)."""
+``trainer_config_helpers/networks.py``, 1,813 LoC): convolution groups,
+VGG stacks, LSTM/GRU units & groups, bidirectional wrappers, attention
+blocks, and the ``inputs``/``outputs`` config markers — built from the
+legacy layer DSL so a reference-style config runs unchanged."""
 
+from __future__ import annotations
+
+import paddle_tpu.layers as F
+from paddle_tpu.v2.layer import Sum as _SumPooling
 from paddle_tpu.v2.networks import (  # noqa: F401
     simple_img_conv_pool, img_conv_group, sequence_conv_pool, simple_lstm,
     simple_gru, bidirectional_lstm)
+from paddle_tpu.trainer_config_helpers import layers as L
 
-__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
-           "simple_lstm", "simple_gru", "bidirectional_lstm"]
+__all__ = [
+    "simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+    "simple_lstm", "simple_gru", "bidirectional_lstm",
+    "img_conv_bn_pool", "img_separable_conv", "small_vgg",
+    "vgg_16_network", "lstmemory_unit", "lstmemory_group", "gru_unit",
+    "gru_group", "simple_gru2", "bidirectional_gru", "simple_attention",
+    "dot_product_attention", "inputs", "outputs",
+]
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
+                     num_channel=None, act=None, groups=1, conv_stride=1,
+                     conv_padding=0, pool_stride=1, pool_type=None,
+                     name=None, **kwargs):
+    """conv -> batch_norm -> pool (reference ``networks.py:231``)."""
+    conv = L.img_conv_layer(input=input, filter_size=filter_size,
+                            num_filters=num_filters, num_channel=num_channel,
+                            act=None, groups=groups, stride=conv_stride,
+                            padding=conv_padding, bias_attr=False)
+    bn = L.batch_norm_layer(input=conv, act=act)
+    return L.img_pool_layer(input=bn, pool_size=pool_size,
+                            pool_type=pool_type, stride=pool_stride)
+
+
+def img_separable_conv(input, num_channels, num_out_channels, filter_size,
+                       stride=1, padding=0, depth_multiplier=1, act=None,
+                       bias_attr=None, param_attr=None, name=None,
+                       **kwargs):
+    """Depthwise conv + pointwise 1x1 conv (reference ``networks.py:439``)."""
+    depthwise = L.img_conv_layer(
+        input=input, filter_size=filter_size,
+        num_filters=num_channels * depth_multiplier, stride=stride,
+        padding=padding, groups=num_channels, act=None,
+        bias_attr=bias_attr, param_attr=param_attr)
+    return L.img_conv_layer(input=depthwise, filter_size=1,
+                            num_filters=num_out_channels, stride=1,
+                            padding=0, act=act, bias_attr=bias_attr)
+
+
+def small_vgg(input_image, num_channels, num_classes):
+    """The tutorial's small VGG (reference ``networks.py:517``)."""
+    def block(ipt, num_filter, times, dropouts):
+        return img_conv_group(input=ipt, conv_num_filter=[num_filter] * times,
+                              pool_size=2, conv_padding=1,
+                              conv_filter_size=3, conv_act="relu",
+                              conv_with_batchnorm=True,
+                              conv_batchnorm_drop_rate=dropouts,
+                              pool_stride=2, pool_type="max")
+
+    tmp = block(input_image, 64, 2, [0.3, 0])
+    tmp = block(tmp, 128, 2, [0.4, 0])
+    tmp = block(tmp, 256, 3, [0.4, 0.4, 0])
+    tmp = block(tmp, 512, 3, [0.4, 0.4, 0])
+    tmp = L.img_pool_layer(input=tmp, pool_size=2, stride=2)
+    tmp = L.dropout_layer(input=tmp, dropout_rate=0.5)
+    tmp = L.fc_layer(input=tmp, size=512, act=None)
+    bn = L.batch_norm_layer(input=tmp, act="relu")
+    bn = L.dropout_layer(input=bn, dropout_rate=0.5)
+    tmp = L.fc_layer(input=bn, size=512, act=None)
+    return L.fc_layer(input=tmp, size=num_classes, act="softmax")
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000):
+    """VGG-16 (reference ``networks.py:547``)."""
+    def block(ipt, num_filter, times):
+        return img_conv_group(input=ipt, conv_num_filter=[num_filter] * times,
+                              pool_size=2, conv_padding=1,
+                              conv_filter_size=3, conv_act="relu",
+                              pool_stride=2, pool_type="max")
+
+    tmp = block(input_image, 64, 2)
+    tmp = block(tmp, 128, 2)
+    tmp = block(tmp, 256, 3)
+    tmp = block(tmp, 512, 3)
+    tmp = block(tmp, 512, 3)
+    tmp = L.fc_layer(input=tmp, size=4096, act="relu")
+    tmp = L.dropout_layer(input=tmp, dropout_rate=0.5)
+    tmp = L.fc_layer(input=tmp, size=4096, act="relu")
+    tmp = L.dropout_layer(input=tmp, dropout_rate=0.5)
+    return L.fc_layer(input=tmp, size=num_classes, act="softmax")
+
+
+def lstmemory_unit(input, out_memory=None, name=None, size=None,
+                   param_attr=None, act=None, gate_act=None, state_act=None,
+                   input_proj_bias_attr=None, input_proj_layer_attr=None,
+                   lstm_bias_attr=None, lstm_layer_attr=None):
+    """One LSTM step for recurrent_group (reference ``networks.py:717``):
+    mixed projection of [x, prev_out] -> lstm_step_layer, memories bound
+    by name."""
+    if size is None:
+        size = input.shape[-1] // 4
+    name = name or "lstmemory_unit"
+    out_mem = out_memory if out_memory is not None \
+        else L.memory(name=name, size=size)
+    state_mem = L.memory(name=f"{name}@state", size=size)
+    with L.mixed_layer(size=size * 4, bias_attr=input_proj_bias_attr) as m:
+        m += L.full_matrix_projection(input, param_attr=param_attr)
+        m += L.full_matrix_projection(out_mem)
+    lstm_out = L.lstm_step_layer(input=m.output, state=state_mem, size=size,
+                                 act=act, gate_act=gate_act,
+                                 state_act=state_act,
+                                 bias_attr=lstm_bias_attr, name=name)
+    L.get_output_layer(input=lstm_out, arg_name="state",
+                       name=f"{name}@state")
+    return lstm_out
+
+
+def lstmemory_group(input, size=None, name=None, out_memory=None,
+                    reverse=False, param_attr=None, act=None,
+                    gate_act=None, state_act=None,
+                    input_proj_bias_attr=None, input_proj_layer_attr=None,
+                    lstm_bias_attr=None, lstm_layer_attr=None):
+    """LSTM over a sequence via recurrent_group (reference
+    ``networks.py:836``); use when the step needs to compose with other
+    layers — otherwise ``lstmemory`` (the fused scan) is faster."""
+    name = name or "lstmemory_group"
+
+    def step(ipt):
+        return lstmemory_unit(
+            input=ipt, name=name, size=size, param_attr=param_attr,
+            act=act, gate_act=gate_act, state_act=state_act,
+            input_proj_bias_attr=input_proj_bias_attr,
+            lstm_bias_attr=lstm_bias_attr)
+
+    return L.recurrent_group(step=step, input=input, reverse=reverse,
+                             name=f"{name}_group")
+
+
+def gru_unit(input, memory_boot=None, size=None, name=None,
+             gru_param_attr=None, act=None, gate_act=None,
+             gru_bias_attr=None, gru_layer_attr=None, naive=False):
+    """One GRU step for recurrent_group (reference ``networks.py:940``)."""
+    if size is None:
+        size = input.shape[-1] // 3
+    name = name or "gru_unit"
+    out_mem = L.memory(name=name, size=size, boot_layer=memory_boot)
+    return L.gru_step_layer(input=input, output_mem=out_mem, size=size,
+                            act=act, gate_act=gate_act, name=name,
+                            bias_attr=gru_bias_attr,
+                            param_attr=gru_param_attr)
+
+
+def gru_group(input, memory_boot=None, size=None, name=None, reverse=False,
+              gru_param_attr=None, act=None, gate_act=None,
+              gru_bias_attr=None, gru_layer_attr=None, naive=False):
+    """GRU over a sequence via recurrent_group (reference
+    ``networks.py:1002``)."""
+    name = name or "gru_group"
+
+    def step(ipt):
+        return gru_unit(input=ipt, memory_boot=memory_boot, name=name,
+                        size=size, gru_param_attr=gru_param_attr, act=act,
+                        gate_act=gate_act, gru_bias_attr=gru_bias_attr)
+
+    return L.recurrent_group(step=step, input=input, reverse=reverse,
+                             name=f"{name}_group")
+
+
+def simple_gru2(input, size, name=None, reverse=False, mixed_param_attr=None,
+                mixed_bias_attr=None, gru_param_attr=None,
+                gru_bias_attr=None, act=None, gate_act=None,
+                mixed_layer_attr=None, gru_cell_attr=None):
+    """input projection + gru_group (reference ``networks.py:1163``)."""
+    name = name or "simple_gru2"
+    with L.mixed_layer(size=size * 3, name=f"{name}_transform",
+                       bias_attr=mixed_bias_attr) as m:
+        m += L.full_matrix_projection(input, param_attr=mixed_param_attr)
+    return gru_group(input=m.output, size=size, name=name, reverse=reverse,
+                     gru_param_attr=gru_param_attr,
+                     gru_bias_attr=gru_bias_attr, act=act,
+                     gate_act=gate_act)
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False,
+                      fwd_mixed_param_attr=None, fwd_gru_param_attr=None,
+                      bwd_mixed_param_attr=None, bwd_gru_param_attr=None,
+                      **kwargs):
+    """Forward + backward GRU, concat (reference ``networks.py:1226``):
+    ``return_seq=False`` concatenates [fwd last, bwd first]."""
+    name = name or "bidirectional_gru"
+    fwd = simple_gru2(input=input, size=size, name=f"{name}_fwd",
+                      mixed_param_attr=fwd_mixed_param_attr,
+                      gru_param_attr=fwd_gru_param_attr)
+    bwd = simple_gru2(input=input, size=size, name=f"{name}_bwd",
+                      reverse=True, mixed_param_attr=bwd_mixed_param_attr,
+                      gru_param_attr=bwd_gru_param_attr)
+    if return_seq:
+        return L.concat_layer(input=[fwd, bwd])
+    return L.concat_layer(input=[L.last_seq(fwd), L.first_seq(bwd)])
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     weight_act=None, name=None):
+    """Bahdanau additive attention (reference ``networks.py:1400``):
+    scores = v . act(W s_{t-1} + U h_j); context = sum_j softmax_j * h_j.
+    ``encoded_proj`` is U h_j precomputed outside the group."""
+    name = name or "attention"
+    proj_size = encoded_proj.shape[-1]
+    with L.mixed_layer(size=proj_size, name=f"{name}_transform") as m:
+        m += L.full_matrix_projection(decoder_state,
+                                      param_attr=transform_param_attr)
+    expanded = L.expand_layer(input=m.output, expand_as=encoded_sequence,
+                              name=f"{name}_expand")
+    with L.mixed_layer(size=proj_size, act=weight_act or "tanh",
+                       name=f"{name}_combine") as m:
+        m += L.identity_projection(expanded)
+        m += L.identity_projection(encoded_proj)
+    scores = L.fc_layer(input=m.output, size=1, act=None,
+                        param_attr=softmax_param_attr, bias_attr=False,
+                        name=f"{name}_score")
+    attention_weight = F.sequence_softmax(scores)
+    scaled = L.scaling_layer(input=encoded_sequence,
+                             weight=attention_weight,
+                             name=f"{name}_scaling")
+    return L.pooling_layer(input=scaled, pooling_type=_SumPooling(),
+                           name=f"{name}_pooling")
+
+
+def dot_product_attention(encoded_sequence, attended_sequence,
+                          transformed_state, softmax_param_attr=None,
+                          name=None):
+    """Dot-product attention (reference ``networks.py:1498``): scores are
+    inner products of the (expanded) state with each encoder step."""
+    name = name or "dot_attention"
+    expanded = L.expand_layer(input=transformed_state,
+                              expand_as=encoded_sequence,
+                              name=f"{name}_expand")
+    scores = L.dot_prod_layer(input1=expanded, input2=encoded_sequence,
+                              name=f"{name}_score")
+    attention_weight = F.sequence_softmax(scores)
+    scaled = L.scaling_layer(input=attended_sequence,
+                             weight=attention_weight,
+                             name=f"{name}_scaling")
+    return L.pooling_layer(input=scaled, pooling_type=_SumPooling(),
+                           name=f"{name}_pooling")
+
+
+def inputs(layers, *args):
+    """Declare the config's input order (reference ``networks.py:1707``);
+    feed order is by data-layer name here, so this is a no-op marker."""
+    return None
+
+
+def outputs(layers, *args):
+    """Declare the config's output layers (reference ``networks.py:1725``);
+    returns them so parse_config captures the targets."""
+    from paddle_tpu.trainer_config_helpers.layers import _to_list
+    outs = _to_list(layers) + list(args)
+    return outs if len(outs) > 1 else outs[0]

@@ -236,3 +236,22 @@ def scaling(input, weight, name=None):
 def slope_intercept(input, slope=1.0, intercept=0.0, name=None):
     """y = slope*x + intercept (reference v2 slope_intercept_layer)."""
     return F.scale(input, scale=slope, bias=intercept)
+
+
+# ---------------------------------------------------------------------------
+# legacy-DSL aliasing: the reference v2/layer.py generates its layer
+# namespace from trainer_config_helpers (``v2/layer.py:__convert_to_v2__``);
+# here a lazy module __getattr__ resolves ``v2.layer.foo`` to the legacy
+# ``foo`` / ``foo_layer`` implementation, avoiding a circular import.
+# ---------------------------------------------------------------------------
+
+def __getattr__(name):
+    if name.startswith("_"):
+        raise AttributeError(name)
+    import paddle_tpu.trainer_config_helpers.layers as _tch
+    for cand in (name, name + "_layer"):
+        if hasattr(_tch, cand):
+            obj = getattr(_tch, cand)
+            globals()[name] = obj
+            return obj
+    raise AttributeError(name)

@@ -186,3 +186,84 @@ class TestTwoProcessDistributed:
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"proc {i} failed:\n{out}"
             assert f"WORKER_OK {i}" in out
+
+
+class TestCompiledOpAttribution:
+    """Round-3 compiled-path profiling (reference platform/profiler.h:110):
+    op lowerings run under jax.named_scope, so the COMPILED executable's
+    HLO metadata — and any XProf trace of it — attributes device time back
+    to IR op names (no interpret-mode proxy)."""
+
+    def _small_train_prog(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[16], dtype="float32")
+            y = layers.data("y", shape=[1], dtype="int64")
+            h = layers.fc(x, 32, act="relu")
+            out = layers.fc(h, 4, act="softmax")
+            loss = layers.reduce_mean(layers.cross_entropy(out, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    def test_scopes_reach_hlo_metadata(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.executor import lower_block
+        from paddle_tpu import profiler
+
+        main, startup, loss = self._small_train_prog()
+        exe = fluid.Executor()
+        exe.run(startup)
+        scope = fluid.global_scope()
+        state = {n: jnp.asarray(v) for n, v in scope.items()
+                 if v is not None}
+
+        block = main.global_block()
+
+        def step(state, feed):
+            env = dict(state)
+            env.update(feed)
+            aux = {"rng_counter": 0, "lower_block": lower_block}
+            lower_block(block, env, jax.random.PRNGKey(0), True, aux)
+            # return updated params too, else XLA DCEs the whole
+            # backward+sgd chain out of the lowered module
+            return env[loss.name], {n: env[n] for n in state}
+
+        rng = np.random.RandomState(0)
+        feed = {"x": jnp.asarray(rng.rand(4, 16).astype("f")),
+                "y": jnp.asarray(rng.randint(0, 4, (4, 1))
+                                 .astype("int64"))}
+        hlo = jax.jit(step).lower(state, feed).as_text(debug_info=True)
+        # every op type in the program should appear as a ptop_ scope in
+        # the lowered module's location metadata
+        for op_type in ("mul", "relu", "softmax", "cross_entropy", "sgd"):
+            assert f"ptop_{op_type}__" in hlo, \
+                f"scope for {op_type} missing from lowered HLO"
+        parsed = profiler.parse_op_scope(
+            "jit(step)/ptop_mul__fc_0_tmp_0/dot_general")
+        assert parsed == ("mul", "fc_0_tmp_0")
+
+    def test_compiled_trace_table(self, tmp_path):
+        import jax
+        from paddle_tpu import profiler
+
+        main, startup, loss = self._small_train_prog()
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(8, 16).astype("f"),
+                "y": rng.randint(0, 4, (8, 1)).astype("int64")}
+        exe.run(main, feed=feed, fetch_list=[loss.name])  # compile
+        d = str(tmp_path / "trace")
+        jax.profiler.start_trace(d)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss.name])
+        jax.profiler.stop_trace()
+        table, rows = profiler.compiled_op_table(d)
+        # CPU traces attribute coarsely (XLA:CPU fuses aggressively); the
+        # contract here is: parses without error, table renders, and any
+        # attributed rows carry IR op types.  The TPU plane attributes
+        # fully (see COVERAGE.md for a bench-step table).
+        assert table.startswith("Event")
+        for op_type, calls, total in rows:
+            assert calls > 0 and total >= 0.0
