@@ -1,0 +1,137 @@
+"""Flash-attention crossover micro-bench (VERDICT r2 item 4).
+
+Times fwd+bwd of fused attention — Pallas flash kernels vs composed XLA
+(``ops/attention_ops.py``) — at S in {256, 512, 1024, 2048, 4096}, bf16,
+causal, B*S = 64k tokens, H=8, D=64 (transformer-base head shape).
+
+Methodology: each timed sample queues ``ITERS`` chained grad steps and
+syncs once (device-queue pipelining amortizes the axon per-dispatch
+latency); the reported per-iter time is the median of
+``PADDLE_TPU_BENCH_TRIALS`` (default 3 here) samples via
+``bench.measure_trials``.
+
+Writes ``BENCH_ATTENTION.md`` (the checked-in artifact the default
+``PADDLE_TPU_FLASH_MIN_S`` cites) and prints one JSON line per S.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+from bench import measure_trials
+
+ITERS = 10
+TOKENS = 1 << 16
+HEADS, DIM = 8, 64
+SEQS = (256, 512, 1024, 2048, 4096)
+
+
+def time_path(use_pallas, S, B):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.attention_ops import fused_attention
+
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, HEADS, S, DIM), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), q.shape, jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), q.shape, jnp.bfloat16)
+    k_mask = jnp.ones((B, S), jnp.bfloat16)
+    scale = DIM ** -0.5
+
+    def loss(q, k, v):
+        out = fused_attention(q, k, v, k_mask, True, scale, use_pallas)
+        return jnp.sum(out.astype(jnp.float32))
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    dq, _, _ = step(q, k, v)
+    np.asarray(dq[0, 0, 0, 0])  # compile + settle
+
+    def run_once():
+        nonlocal q
+        last = None
+        qq = q
+        for _ in range(ITERS):
+            g = step(qq, k, v)
+            # chain a dependency so iterations cannot be elided, while
+            # keeping the workload identical
+            qq = qq + 0.0 * g[0]
+            last = g
+        np.asarray(last[0][0, 0, 0, 0])  # one sync for the whole queue
+
+    dt, trials = measure_trials(run_once,
+                                n_trials=int(os.environ.get(
+                                    "PADDLE_TPU_BENCH_TRIALS", "3")))
+    return dt / ITERS, [t / ITERS for t in trials]
+
+
+def main():
+    rows = []
+    for S in SEQS:
+        B = max(1, TOKENS // S)
+
+        def timed(use_pallas):
+            try:
+                per_iter, trials = time_path(use_pallas, S, B)
+                return per_iter * 1e3, [t * 1e3 for t in trials]
+            except Exception as e:  # XLA path OOMs once [B,H,S,S] f32
+                if "RESOURCE_EXHAUSTED" in str(e) or "memory" in \
+                        str(e).lower():
+                    return None, []
+                raise
+
+        flash_ms, flash_tr = timed(True)
+        xla_ms, xla_tr = timed(False)
+        row = {"S": S, "B": B,
+               "flash_ms": round(flash_ms, 3) if flash_ms else None,
+               "xla_ms": round(xla_ms, 3) if xla_ms else None,
+               "speedup": round(xla_ms / flash_ms, 3)
+               if flash_ms and xla_ms else None}
+        rows.append(row)
+        print(json.dumps(row))
+        print(f"#   flash trials {['%.2f' % t for t in flash_tr]} "
+              f"xla trials {['%.2f' % t for t in xla_tr]}",
+              file=sys.stderr)
+
+    crossover = next(
+        (r["S"] for r in rows
+         if r["flash_ms"] and (r["xla_ms"] is None
+                               or r["speedup"] > 1.0)), None)
+    lines = [
+        "# Flash-attention crossover (measured)",
+        "",
+        f"Chip: {_device_kind()}; fwd+bwd, causal, bf16, "
+        f"B*S = {TOKENS} tokens, H={HEADS}, D={DIM}; per-iter median "
+        f"of queued-{ITERS} samples (see bench_attention.py).",
+        "",
+        "| S | B | flash ms/iter | XLA ms/iter | speedup |",
+        "|---|---|---|---|---|",
+    ]
+    for r in rows:
+        xla = r["xla_ms"] if r["xla_ms"] is not None else "OOM"
+        sp = f"{r['speedup']}x" if r["speedup"] is not None else "inf"
+        lines.append(f"| {r['S']} | {r['B']} | {r['flash_ms']} | "
+                     f"{xla} | {sp} |")
+    lines += [
+        "",
+        f"Measured crossover: flash wins from **S = {crossover}** "
+        f"(speedup > 1, or the composed path's [B,H,S,S] f32 scores "
+        f"no longer fit HBM).  `PADDLE_TPU_FLASH_MIN_S` defaults to "
+        f"this value (models/transformer.py gate).",
+    ]
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_ATTENTION.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"# crossover S={crossover}", file=sys.stderr)
+
+
+def _device_kind():
+    import jax
+    return getattr(jax.devices()[0], "device_kind", "unknown")
+
+
+if __name__ == "__main__":
+    main()

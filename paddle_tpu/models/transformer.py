@@ -125,10 +125,11 @@ def multi_head_attention(queries, keys, values, d_key, d_value, d_model,
 
     # the VMEM-fused kernel wins once the [S,S] score tensor dominates HBM
     # traffic; crossover is workload-dependent, so the threshold is a knob
-    # (PADDLE_TPU_FLASH_MIN_S; default 2048 from the v5e fwd+bwd causal
-    # measurement: S=2048 flash 10.3ms vs XLA 13.7ms; S=8192 18.4 vs 246)
+    # (PADDLE_TPU_FLASH_MIN_S; default 1024 from the measured v5e
+    # crossover in BENCH_ATTENTION.md: S=1024 flash 1.16x XLA, S=2048
+    # 1.37x, S=4096 XLA OOM; at S<=512 the composed path wins)
     import os
-    flash_min_s = int(os.environ.get("PADDLE_TPU_FLASH_MIN_S", "2048"))
+    flash_min_s = int(os.environ.get("PADDLE_TPU_FLASH_MIN_S", "1024"))
     use_flash = use_flash and (k.shape[2] >= flash_min_s)
     # sequence/context parallelism: shard S over the mesh 'seq' axis and
     # attend with the ppermute ring (parallel/ring_attention.py); only for

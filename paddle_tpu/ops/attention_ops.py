@@ -8,10 +8,12 @@ QK^T -> mask -> softmax -> AV in ONE Pallas kernel per (batch, head,
 q-block): scores live only in VMEM.  K/V stream through VMEM one block at
 a time with an online softmax (VMEM use independent of sequence length),
 and the backward runs as two flash kernels (dq; dk+dv) from the saved
-log-sum-exp residual, with fully-masked causal blocks skipped — measured
-on v5e (fwd+bwd, causal, bf16): S=2048 flash 10.3ms vs 13.7ms plain XLA;
-S=8192 18.4ms vs 246ms.  Below the PADDLE_TPU_FLASH_MIN_S crossover
-(default 2048) the composed XLA path wins and is used instead.
+log-sum-exp residual, with fully-masked causal blocks skipped.  Measured
+crossover (``bench_attention.py`` -> checked-in ``BENCH_ATTENTION.md``,
+v5e fwd+bwd causal bf16, 64k tokens): S=512 flash 0.98x of XLA, S=1024
+1.16x, S=2048 1.37x, S=4096 XLA OOMs ([B,H,S,S] f32 scores) while flash
+runs.  Below the PADDLE_TPU_FLASH_MIN_S crossover (default 1024, from
+that artifact) the composed XLA path wins and is used instead.
 
 Masking model (matches the transformer workloads):
   * ``k_mask`` [B, S_k] with 1 = attend / 0 = padding, optional;

@@ -75,7 +75,9 @@ def _require_lod(ctx, slot="X"):
 
 def _is_dyn(lod):
     from paddle_tpu.lod import DynLoD
-    return isinstance(lod, DynLoD)
+    # _ConstSplits presents a static lod through the same runtime-splits
+    # interface (compiled blocks in bucketed programs)
+    return isinstance(lod, (DynLoD, _ConstSplits))
 
 
 def _segment_tables(ctx, lod, n_rows):
@@ -281,8 +283,13 @@ def sequence_concat_lower(ctx: LowerContext):
         name = ctx.op.output("Out")[0] + SPLITS_SUFFIX
         ctx.outputs[name] = out_splits
         ctx.set_output("Out", out)
-        maxlen = sum(l.maxlen_bucket if _is_dyn(l) else n_out
-                     for l in lods)
+        # per-input longest-sequence bound: dyn inputs ride their bucket,
+        # static inputs their actual max length (NOT the combined row
+        # count — maxlen_bucket is the while_loop trip bound downstream)
+        maxlen = sum(
+            l.maxlen_bucket if _is_dyn(l)
+            else int(max(np.diff(np.asarray(l[0])), default=0))
+            for l in lods)
         ctx.set_output_lod("Out", DynLoD(name, num, maxlen))
         return
     # interleave per-sequence: out seq i = concat of each input's seq i
@@ -356,12 +363,38 @@ def sequence_reshape_lower(ctx: LowerContext):
     ctx.set_output_lod("Out", [splits])
 
 
+class _ConstSplits:
+    """Adapter: a STATIC lod presented through the DynLoD interface
+    (constant splits tensor) — used when a host-op's bucketed branch must
+    run traced but the variable's lod is static (mixed programs under
+    ``lod_buckets``, where the block compiles as a whole)."""
+
+    def __init__(self, level_splits):
+        arr = np.asarray(level_splits, np.int32)
+        self._splits = jnp.asarray(arr)
+        self.num_seqs = len(arr) - 1
+        lengths = np.diff(arr)
+        self.maxlen_bucket = int(lengths.max()) if len(lengths) else 0
+
+    def splits(self, env):
+        return self._splits
+
+
+def _is_traced(*vals):
+    return any(isinstance(v, jax.core.Tracer) for v in vals
+               if v is not None)
+
+
 @register_op("sequence_slice", infer_shape=_infer_ragged,
              no_gradient=True, host=True, host_dyn_ok=True)
 def sequence_slice_lower(ctx: LowerContext):
     x = ctx.input("X")
     lod = _require_lod(ctx)
-    if _is_dyn(lod):
+    if not _is_dyn(lod) and _is_traced(x, ctx.input("Offset")):
+        # compiled block (bucketed program) but this var's lod is static:
+        # run the traced branch over constant splits
+        lod = _ConstSplits(lod[_last_level(lod)])
+    if _is_dyn(lod) or isinstance(lod, _ConstSplits):
         # bucketed mode: output stays padded to the input's bucket; rows
         # move via a runtime gather built from the splits tensor
         from paddle_tpu.lod import DynLoD, SPLITS_SUFFIX
@@ -407,6 +440,8 @@ def sequence_erase_lower(ctx: LowerContext):
     x = ctx.input("X")
     tokens = sorted(set(ctx.attr("tokens", [])))
     lod = _require_lod(ctx)
+    if not _is_dyn(lod) and _is_traced(x):
+        lod = _ConstSplits(lod[_last_level(lod)])
     if _is_dyn(lod):
         from paddle_tpu.lod import DynLoD, SPLITS_SUFFIX
         n = x.shape[0]
@@ -460,12 +495,17 @@ def lod_reset_lower(ctx: LowerContext):
             ctx.set_output_lod("Out", y_lod)  # share Y's runtime splits
             return
         target = ctx.attr("target_lod", None)
-        if ctx.op.input("Y") and y_lod is None:
+        if y_lod is not None:                # Y carries a static lod
+            splits = jnp.asarray(np.asarray(y_lod[0], np.int32))
+            num = len(y_lod[0]) - 1
+        elif ctx.op.input("Y"):              # Y holds the splits values
             splits = ctx.input("Y").reshape(-1).astype(jnp.int32)
             num = splits.shape[0] - 1
-        else:
+        elif target is not None:
             splits = jnp.asarray(np.asarray(target, np.int32))
             num = len(target) - 1
+        else:
+            raise ValueError("lod_reset needs target_lod or Y")
         name = ctx.op.output("Out")[0] + SPLITS_SUFFIX
         ctx.outputs[name] = splits
         ctx.set_output("Out", x)

@@ -620,5 +620,7 @@ def sampling_id_lower(ctx):
                            dtype=x.dtype)
     cdf = jnp.cumsum(x, axis=1)
     idx = jnp.sum((cdf < u).astype(jnp.int32), axis=1, keepdims=True)
+    # int64 to match the declared IR dtype (jax truncates to int32 when
+    # x64 is disabled, the framework-wide convention — cf. arg_max)
     ctx.set_output("Out", jnp.clip(idx, 0, x.shape[1] - 1)
-                   .astype(jnp.int32))
+                   .astype(jnp.int64))

@@ -24,10 +24,15 @@ __all__ = [
 def _register(name, outputs):
     """Attach {metric_name: Variable} to the program's evaluator table;
     returns the primary Variable (reference evaluator_base semantics:
-    evaluators are config-side objects polled by the trainer loop)."""
+    evaluators are config-side objects polled by the trainer loop).
+    Colliding default names are uniquified (reference wrap_name_default)
+    so two unnamed evaluators never shadow each other."""
+    from paddle_tpu.framework import unique_name
     prog = default_main_program()
     if not hasattr(prog, "_evaluators"):
         prog._evaluators = {}
+    if name in prog._evaluators:
+        name = unique_name(name)
     prog._evaluators[name] = outputs
     return next(iter(outputs.values()))
 
