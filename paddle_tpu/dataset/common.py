@@ -31,8 +31,10 @@ def md5file(fname):
 
 
 def download(url, module_name, md5sum, save_name=None):
-    """Resolve a dataset file from the local cache.  This build has no
-    network egress: if the file is absent, raise so callers fall back to
+    """Resolve a dataset file: local DATA_HOME cache first (md5-checked,
+    reference ``dataset/common.py:download``); when the environment
+    allows egress (``PADDLE_TPU_DATASET_ONLINE=1``) fetch + verify +
+    cache like the reference; otherwise raise so callers fall back to
     their synthetic generators."""
     dirname = os.path.join(DATA_HOME, module_name)
     must_mkdirs(dirname)
@@ -41,9 +43,25 @@ def download(url, module_name, md5sum, save_name=None):
     if os.path.exists(filename) and (not md5sum or
                                      md5file(filename) == md5sum):
         return filename
+    if os.environ.get("PADDLE_TPU_DATASET_ONLINE"):
+        import urllib.request
+        tmp = filename + ".part"
+        try:
+            urllib.request.urlretrieve(url, tmp)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)  # no stale partials in the cache
+            raise
+        if md5sum and md5file(tmp) != md5sum:
+            os.remove(tmp)
+            raise RuntimeError(
+                f"md5 mismatch downloading {url} (expected {md5sum})")
+        os.replace(tmp, filename)  # atomic publish into the cache
+        return filename
     raise RuntimeError(
         f"dataset file {filename} not in local cache and downloads are "
-        f"disabled (no egress); synthetic fallback will be used")
+        f"disabled (set PADDLE_TPU_DATASET_ONLINE=1 to fetch); synthetic "
+        f"fallback will be used")
 
 
 def synthetic_rng(module_name, split_name="train"):
@@ -87,7 +105,7 @@ def cluster_files_reader(files_pattern, trainer_count, trainer_id,
 
 def convert(output_path, reader, line_count, name_prefix):
     """Convert a reader to recordio files (reference common.py convert)."""
-    from paddle_tpu.recordio import RecordIOWriter
+    from paddle_tpu.recordio_writer import RecordIOWriter
     indx_f = 0
     lines = []
 

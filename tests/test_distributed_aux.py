@@ -210,9 +210,13 @@ while True:
     xv = np.stack([r[0] for r in rows]).astype("float32")
     yv = np.stack([r[1] for r in rows]).astype("float32")
     exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss.name])
+    if not client.task_finished(task.id, task.epoch):
+        # lease expired under us (should not happen with a sane timeout):
+        # fail loudly rather than checkpoint a task the master re-leased
+        log(f"finish-rejected {task.chunks[0]}")
+        sys.exit(3)
     done += 1
     fluid.io.save_checkpoint(exe, args.ckpt, main_program=main, step=done)
-    client.task_finished(task.id, task.epoch)
     log(f"finished {task.chunks[0]}")
 client.close()
 w = np.asarray(fluid.global_scope().find_var("w"))
@@ -261,7 +265,10 @@ class TestFaultToleranceDrill:
             paths.append(p)
 
         # short lease timeout so the dead trainer's task requeues fast
-        svc = MasterService(partition_files(paths), timeout=2.0,
+        # lease timeout must comfortably exceed one task's work (jit
+        # compile + orbax save) so a LIVE worker's lease never expires —
+        # only the dead worker's; phase 2 polls until that requeue
+        svc = MasterService(partition_files(paths), timeout=20.0,
                             failure_max=5)
         server = MasterServer(svc, port=0)
         server.start_background()

@@ -261,6 +261,11 @@ class TestRingAttentionScaling:
         # assert a conservative bound so compiler drift doesn't flake
         assert ring_tmp * 4 < ref_tmp, (ring_tmp, ref_tmp)
 
-        out = np.asarray(ring(qs, qs, qs))
-        want = np.asarray(ref(q, q, q))
+        # reuse the compiled executables (lower().compile() does not
+        # populate jit's cache; calling ring()/ref() would recompile)
+        def _one(res):
+            return res[0] if isinstance(res, (list, tuple)) else res
+
+        out = np.asarray(_one(c_ring(qs, qs, qs)))
+        want = np.asarray(_one(c_ref(q, q, q)))
         np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-5)
