@@ -703,3 +703,46 @@ def row_conv_lower(ctx: LowerContext):
     out = jnp.concatenate(outs, axis=0)
     ctx.set_output("Out", out)
     ctx.set_output_lod("Out", [list(l) for l in lod])
+
+
+def _infer_kmax(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    if x.shape is None:
+        raise ShapeInferenceSkip()
+    out.shape = (-1, op.attr("beam_size"))
+    out.dtype = x.dtype
+
+
+@register_op("kmax_seq_score", infer_shape=_infer_kmax)
+def kmax_seq_score_lower(ctx: LowerContext):
+    """Per-sequence top-k of [N, 1] scores (reference
+    KmaxSeqScoreLayer.cpp): pad to dense [B, T] once (NEG_INF fill) and
+    take a single topk — static shapes regardless of raggedness."""
+    x = ctx.input("X").reshape(-1)
+    lod = _require_lod(ctx)
+    k = ctx.attr("beam_size")
+    n = x.shape[0]
+    seg, _, num, splits, valid = _segment_tables(ctx, lod, n)
+    if valid is None:
+        valid = jnp.ones(n, bool)
+    if _is_dyn(lod):
+        t = lod.maxlen_bucket
+        rows = jnp.arange(n)
+        segc = jnp.clip(seg, 0, num - 1)
+        col = rows - splits[segc]
+    else:
+        t = max(_lengths(lod, _last_level(lod)), default=1)
+        col = jnp.asarray(np.concatenate(
+            [np.arange(L) for L in _lengths(lod, _last_level(lod))]
+            or [np.zeros(0, np.int64)]))
+        segc = seg
+    dense = jnp.full((num, max(t, k)), -1e30, x.dtype)
+    # scatter-MAX, not set: clamped padding rows land on (0, 0) with the
+    # fill value, and max() cannot clobber a real score there (a .set
+    # with duplicate indices picks an unspecified writer)
+    dense = dense.at[jnp.where(valid, segc, 0),
+                     jnp.where(valid, col, 0)].max(
+        jnp.where(valid, x, jnp.asarray(-1e30, x.dtype)))
+    top, _ = jax.lax.top_k(dense, k)
+    ctx.set_output("Out", top)

@@ -867,12 +867,18 @@ def sub_seq_layer(input, offsets, sizes, act=None, bias_attr=None,
 
 
 def kmax_seq_score_layer(input, name=None, beam_size=1):
-    """Top scores within each sequence (reference ``layers.py:7191``);
-    k=1 == sequence max pool (the common configuration)."""
+    """Top-k scores within each sequence (reference ``layers.py:7191``
+    over KmaxSeqScoreLayer.cpp).  k=1 is a sequence max pool; general k
+    pads each sequence to the dense [B, T] layout once and runs topk —
+    static shapes, MXU/VPU friendly."""
     if beam_size == 1:
         return _named(F.sequence_pool(input, pool_type="max"), name)
-    raise NotImplementedError(
-        "kmax_seq_score_layer beam_size>1: use the beam_search ops")
+    from paddle_tpu.layer_helper import LayerHelper
+    helper = LayerHelper("kmax_seq_score", name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="kmax_seq_score", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"beam_size": beam_size})
+    return _named(out, name)
 
 
 def ctc_layer(input, label, size=None, name=None, norm_by_times=False,

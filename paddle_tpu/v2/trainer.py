@@ -42,6 +42,13 @@ class SGD:
         self.cost = cost
         self.parameters = parameters
         self.program = cost.block.program
+        # evaluators declared through the legacy DSL ride the event
+        # metrics (reference: trainer polls Evaluator objects each batch)
+        from paddle_tpu.trainer_config_helpers.evaluators import \
+            evaluators_of
+        for ev_name, outs in evaluators_of(self.program).items():
+            for k, v in outs.items():
+                self.__metrics.setdefault(f"{ev_name}.{k}", v)
         self.test_program = self.program.clone(for_test=True)
         with fluid.program_guard(self.program,
                                  parameters._startup):

@@ -594,3 +594,40 @@ class TestReviewRegressions:
                     {"logits": (frames, [[0, 4]]),
                      "lbl": (lbls, [[0, 2]])}, [ed.name])
         np.testing.assert_allclose(np.asarray(o).reshape(-1), [0.0])
+
+
+class TestKmaxSeqScore:
+    def _build(self, k):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            s = F.data("s", shape=[-1, 1], dtype="float32",
+                       append_batch_size=False, lod_level=1)
+            t = tch.kmax_seq_score_layer(s, beam_size=k)
+        return main, startup, t
+
+    def test_static_topk(self):
+        main, startup, t = self._build(3)
+        sv = np.arange(10, dtype="f").reshape(-1, 1)
+        (o,) = _run(main, startup, {"s": (sv, [[0, 4, 10]])}, [t.name])
+        np.testing.assert_allclose(np.asarray(o), [[3, 2, 1], [9, 8, 7]])
+
+    def test_bucketed_matches_static(self):
+        rng = np.random.RandomState(6)
+        sv = rng.rand(9, 1).astype("f")
+        lod = [[0, 2, 5, 9]]
+        outs = {}
+        for bucketed in (False, True):
+            main, startup, t = self._build(2)
+            main.lod_buckets = bucketed
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor()
+                exe.run(startup)
+                (o,) = exe.run(main, feed={"s": (sv, lod)},
+                               fetch_list=[t.name])
+            outs[bucketed] = np.asarray(o)
+        # bucketed padding must not clobber any sequence's scores
+        want = np.stack([np.sort(sv[a:b, 0])[::-1][:2]
+                         for a, b in zip(lod[0], lod[0][1:])])
+        np.testing.assert_allclose(outs[False], want, rtol=1e-6)
+        np.testing.assert_allclose(outs[True], want, rtol=1e-6)

@@ -181,3 +181,43 @@ class TestV2ExtendedLayers:
                       fetch_list=[rc, hc])
         for v in out:
             assert np.isfinite(np.asarray(v)).all()
+
+
+def test_v2_trainer_surfaces_dsl_evaluators():
+    """Evaluators declared through the legacy DSL ride the trainer's
+    event metrics (reference: the trainer polls Evaluator objects each
+    batch)."""
+    import paddle_tpu.v2 as paddle
+    import paddle_tpu.trainer_config_helpers as tch
+
+    x = paddle.layer.data(name="ev_x", type=paddle.data_type.dense_vector(8))
+    label = paddle.layer.data(name="ev_lbl",
+                              type=paddle.data_type.integer_value(3))
+    predict = paddle.layer.fc(input=x, size=3,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+    tch.sum_evaluator(predict, name="psum")
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-2))
+
+    seen = {}
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            seen.update(e.metrics)
+
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(32):
+            yield rng.rand(8).astype("float32"), int(rng.randint(0, 3))
+
+    trainer.train(paddle.batch(reader, batch_size=8), num_passes=1,
+                  event_handler=handler)
+    assert any(k.startswith("psum.") for k in seen), seen
+    v = [v for k, v in seen.items() if k.startswith("psum.")][0]
+    np.testing.assert_allclose(float(np.asarray(v).reshape(())), 8.0,
+                               rtol=1e-4)  # softmax rows sum to 1
