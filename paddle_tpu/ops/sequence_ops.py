@@ -711,14 +711,17 @@ def _infer_kmax(op, block):
     if x.shape is None:
         raise ShapeInferenceSkip()
     out.shape = (-1, op.attr("beam_size"))
-    out.dtype = x.dtype
+    out.dtype = "int64"
 
 
 @register_op("kmax_seq_score", infer_shape=_infer_kmax)
 def kmax_seq_score_lower(ctx: LowerContext):
-    """Per-sequence top-k of [N, 1] scores (reference
-    KmaxSeqScoreLayer.cpp): pad to dense [B, T] once (NEG_INF fill) and
-    take a single topk — static shapes regardless of raggedness."""
+    """Per-sequence top-k of [N, 1] scores, returning the WITHIN-SEQUENCE
+    INDEXES of the winners padded with -1 (reference
+    KmaxSeqScoreLayer.cpp semantics — downstream layers select
+    sub-sequences by these ids).  Pad to dense [B, T] once (NEG_INF
+    fill) and take a single topk — static shapes regardless of
+    raggedness."""
     x = ctx.input("X").reshape(-1)
     lod = _require_lod(ctx)
     k = ctx.attr("beam_size")
@@ -728,15 +731,10 @@ def kmax_seq_score_lower(ctx: LowerContext):
         valid = jnp.ones(n, bool)
     if _is_dyn(lod):
         t = lod.maxlen_bucket
-        rows = jnp.arange(n)
-        segc = jnp.clip(seg, 0, num - 1)
-        col = rows - splits[segc]
     else:
         t = max(_lengths(lod, _last_level(lod)), default=1)
-        col = jnp.asarray(np.concatenate(
-            [np.arange(L) for L in _lengths(lod, _last_level(lod))]
-            or [np.zeros(0, np.int64)]))
-        segc = seg
+    segc = jnp.clip(seg, 0, num - 1)
+    col = jnp.arange(n) - splits[segc]
     dense = jnp.full((num, max(t, k)), -1e30, x.dtype)
     # scatter-MAX, not set: clamped padding rows land on (0, 0) with the
     # fill value, and max() cannot clobber a real score there (a .set
@@ -744,5 +742,6 @@ def kmax_seq_score_lower(ctx: LowerContext):
     dense = dense.at[jnp.where(valid, segc, 0),
                      jnp.where(valid, col, 0)].max(
         jnp.where(valid, x, jnp.asarray(-1e30, x.dtype)))
-    top, _ = jax.lax.top_k(dense, k)
-    ctx.set_output("Out", top)
+    top, idx = jax.lax.top_k(dense, k)
+    ids = jnp.where(top <= -1e29, -1, idx)   # short sequences pad with -1
+    ctx.set_output("Out", ids.astype(jnp.int64))

@@ -871,11 +871,10 @@ def kmax_seq_score_layer(input, name=None, beam_size=1):
     over KmaxSeqScoreLayer.cpp).  k=1 is a sequence max pool; general k
     pads each sequence to the dense [B, T] layout once and runs topk —
     static shapes, MXU/VPU friendly."""
-    if beam_size == 1:
-        return _named(F.sequence_pool(input, pool_type="max"), name)
     from paddle_tpu.layer_helper import LayerHelper
     helper = LayerHelper("kmax_seq_score", name=name)
-    out = helper.create_tmp_variable(dtype=input.dtype)
+    out = helper.create_tmp_variable(dtype="int64")
+    out.stop_gradient = True
     helper.append_op(type="kmax_seq_score", inputs={"X": [input]},
                      outputs={"Out": [out]}, attrs={"beam_size": beam_size})
     return _named(out, name)

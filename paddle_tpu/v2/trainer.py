@@ -13,6 +13,13 @@ from paddle_tpu.v2 import data_type as dt
 __all__ = ["SGD"]
 
 
+def _metric_value(v):
+    """Scalar metrics come back as floats; vector evaluator outputs
+    (column_sum, precision_recall) pass through as arrays."""
+    arr = np.asarray(v)
+    return float(arr.reshape(())) if arr.size == 1 else arr
+
+
 def _feed_converter(var, column):
     """Convert a v2 minibatch column per the data layer's input type."""
     t = getattr(var, "v2_input_type", None)
@@ -88,7 +95,7 @@ class SGD:
                         feed=self._feed(data_batch, feeding),
                         fetch_list=fetches)
                     cost = float(np.asarray(res[0]).reshape(()))
-                    metrics = {n: float(np.asarray(v).reshape(()))
+                    metrics = {n: _metric_value(v)
                                for n, v in zip(metric_names, res[1:])}
                     event_handler(v2_event.EndIteration(
                         pass_id, batch_id, cost, metrics))
@@ -107,7 +114,7 @@ class SGD:
                                    fetch_list=fetches)
                 costs.append(float(np.asarray(res[0]).reshape(())))
                 for n, v in zip(metric_names, res[1:]):
-                    metrics_sum[n] += float(np.asarray(v).reshape(()))
+                    metrics_sum[n] = metrics_sum[n] + _metric_value(v)
                 counts += 1
         metrics = {n: s / max(counts, 1) for n, s in metrics_sum.items()}
         return v2_event.TestResult(float(np.mean(costs)), metrics)

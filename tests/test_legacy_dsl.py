@@ -605,11 +605,20 @@ class TestKmaxSeqScore:
             t = tch.kmax_seq_score_layer(s, beam_size=k)
         return main, startup, t
 
-    def test_static_topk(self):
+    def test_static_topk_indices(self):
         main, startup, t = self._build(3)
         sv = np.arange(10, dtype="f").reshape(-1, 1)
         (o,) = _run(main, startup, {"s": (sv, [[0, 4, 10]])}, [t.name])
-        np.testing.assert_allclose(np.asarray(o), [[3, 2, 1], [9, 8, 7]])
+        # reference semantics: WITHIN-SEQUENCE indexes of the top scores
+        np.testing.assert_array_equal(np.asarray(o),
+                                      [[3, 2, 1], [5, 4, 3]])
+
+    def test_short_sequence_pads_minus_one(self):
+        main, startup, t = self._build(3)
+        sv = np.array([[0.5], [0.1], [0.9]], "f")
+        (o,) = _run(main, startup, {"s": (sv, [[0, 2, 3]])}, [t.name])
+        np.testing.assert_array_equal(np.asarray(o),
+                                      [[0, 1, -1], [0, -1, -1]])
 
     def test_bucketed_matches_static(self):
         rng = np.random.RandomState(6)
@@ -626,8 +635,8 @@ class TestKmaxSeqScore:
                 (o,) = exe.run(main, feed={"s": (sv, lod)},
                                fetch_list=[t.name])
             outs[bucketed] = np.asarray(o)
-        # bucketed padding must not clobber any sequence's scores
-        want = np.stack([np.sort(sv[a:b, 0])[::-1][:2]
+        # bucketed padding must not clobber any sequence's winners
+        want = np.stack([np.argsort(sv[a:b, 0])[::-1][:2]
                          for a, b in zip(lod[0], lod[0][1:])])
-        np.testing.assert_allclose(outs[False], want, rtol=1e-6)
-        np.testing.assert_allclose(outs[True], want, rtol=1e-6)
+        np.testing.assert_array_equal(outs[False], want)
+        np.testing.assert_array_equal(outs[True], want)
