@@ -13,6 +13,9 @@ Usage: ``python -m paddle_tpu <command> ...``
   master  --files GLOB --port P              serve the task-dispatch master
   launch  --nproc N SCRIPT [args...]         spawn an N-process cluster on
                                              this host (jax.distributed)
+  serve   --model DIR --port P               HTTP inference server
+  profile [--model transformer|resnet ...]   per-op device-time table of
+                                             one compiled training step
   version
 """
 
@@ -131,6 +134,61 @@ def _cmd_launch(args):
     return rc
 
 
+def _cmd_profile(args):
+    """One compiled training step of a built-in model under the XProf
+    trace; prints the per-IR-op device-time table (the compiled-path
+    analog of the reference's profiler tools, platform/profiler.h)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import profiler
+
+    if args.model == "transformer":
+        from paddle_tpu.models import transformer as T
+        hp = T.ModelHyperParams()
+        hp.d_model, hp.d_inner_hid, hp.n_layer = args.d_model, \
+            2 * args.d_model, args.layers
+        hp.n_head = max(1, args.d_model // 64)
+        hp.d_key = hp.d_value = args.d_model // hp.n_head
+        hp.src_vocab_size = hp.trg_vocab_size = 1000
+        hp.max_length = max(64, args.seq)
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            cost, _ = T.transformer(args.batch, args.seq, args.seq, hp)
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(cost)
+        feed = T.fake_batch(args.batch, args.seq, args.seq, hp)
+    elif args.model == "resnet":
+        from paddle_tpu.models import resnet as R
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            cost, _, _ = R.resnet_train_program(
+                args.batch, class_dim=1000, depth=50,
+                image_shape=(3, args.seq, args.seq))
+            fluid.optimizer.Momentum(learning_rate=0.01,
+                                     momentum=0.9).minimize(cost)
+        rng = np.random.RandomState(0)
+        feed = {"image": rng.rand(args.batch, 3, args.seq,
+                                  args.seq).astype("float32"),
+                "label": rng.randint(0, 1000, (args.batch, 1))
+                .astype("int64")}
+    else:
+        raise SystemExit(f"unknown --model {args.model!r}")
+    exe = fluid.Executor()
+    exe.run(startup)
+    exe.run(main_prog, feed=feed, fetch_list=[cost.name])  # compile
+    import shutil
+    import tempfile
+    trace_dir = tempfile.mkdtemp(prefix="ptprof_")
+    try:
+        with profiler.compiled_profiler(trace_dir=trace_dir,
+                                        sorted_key=args.sorted_by):
+            for _ in range(args.steps):
+                exe.run(main_prog, feed=feed, fetch_list=[cost.name])
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="paddle_tpu", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -168,6 +226,21 @@ def main(argv=None):
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8866)
     p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("profile", help="per-op device-time table of one "
+                                       "compiled training step")
+    p.add_argument("--model", default="transformer",
+                   choices=["transformer", "resnet"])
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=64,
+                   help="sequence length (transformer) or image side "
+                        "(resnet)")
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--sorted-by", default="total",
+                   choices=["total", "calls"])
+    p.set_defaults(fn=_cmd_profile)
 
     p = sub.add_parser("launch", help="spawn a local N-process cluster")
     p.add_argument("--nproc", type=int, required=True)

@@ -50,7 +50,7 @@ def position_encoding_init(n_position, d_model):
                  * -(np.log(10000.0) / d_model))
     table = np.zeros((n_position, d_model))
     table[:, 0::2] = np.sin(position * div)
-    table[:, 1::2] = np.cos(position * div[: (d_model + 1) // 2])
+    table[:, 1::2] = np.cos(position * div[: d_model // 2])
     return table.astype("float32")
 
 

@@ -328,3 +328,13 @@ class TestDatasetConvertRoundTrip:
         with pytest.raises(RuntimeError, match="synthetic fallback"):
             common.download("http://example.invalid/blob.bin", "testmod",
                             "0" * 32)
+
+
+class TestProfileCLI:
+    def test_profile_command_prints_table(self, capsys):
+        import paddle_tpu.cli as cli
+        rc = cli.main(["profile", "--model", "transformer", "--batch", "4",
+                       "--seq", "32", "--steps", "2"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Event" in out and "Total(ms)" in out
