@@ -176,16 +176,9 @@ def _cmd_profile(args):
     exe = fluid.Executor()
     exe.run(startup)
     exe.run(main_prog, feed=feed, fetch_list=[cost.name])  # compile
-    import shutil
-    import tempfile
-    trace_dir = tempfile.mkdtemp(prefix="ptprof_")
-    try:
-        with profiler.compiled_profiler(trace_dir=trace_dir,
-                                        sorted_key=args.sorted_by):
-            for _ in range(args.steps):
-                exe.run(main_prog, feed=feed, fetch_list=[cost.name])
-    finally:
-        shutil.rmtree(trace_dir, ignore_errors=True)
+    with profiler.compiled_profiler(sorted_key=args.sorted_by):
+        for _ in range(args.steps):
+            exe.run(main_prog, feed=feed, fetch_list=[cost.name])
     return 0
 
 

@@ -745,3 +745,48 @@ def kmax_seq_score_lower(ctx: LowerContext):
     top, idx = jax.lax.top_k(dense, k)
     ids = jnp.where(top <= -1e29, -1, idx)   # short sequences pad with -1
     ctx.set_output("Out", ids.astype(jnp.int64))
+
+
+@register_op("sub_nested_seq", infer_shape=_infer_ragged,
+             no_grad_inputs=("SelectedIndices",), host=True)
+def sub_nested_seq_lower(ctx: LowerContext):
+    """Trim a 2-level nested sequence to the sub-sequences named by
+    ``SelectedIndices`` [B, k] (within-outer-sequence ids, -1 = pad) —
+    the beam-training companion of kmax_seq_score (reference
+    SubNestedSequenceLayer.cpp).  Output is a 1-level sequence of the
+    selected sub-sequences, in (outer, selection) order.  Host op: the
+    output row count is data-dependent."""
+    x = ctx.input("X")
+    lod = _require_lod(ctx)
+    if _is_dyn(lod):
+        raise NotImplementedError(
+            "sub_nested_seq needs a static 2-level LoD (beam decode runs "
+            "in interpret/eval mode, like the reference's CPU layer)")
+    if _last_level(lod) < 1:
+        raise ValueError("sub_nested_seq input must be a 2-level nested "
+                         "sequence")
+    sel = np.asarray(ctx.input("SelectedIndices"))
+    n_outer = len(lod[0]) - 1
+    if sel.ndim != 2 or sel.shape[0] != n_outer:
+        raise ValueError(
+            f"sub_nested_seq: SelectedIndices must be [num_outer_seqs, k] "
+            f"= [{n_outer}, k], got shape {tuple(sel.shape)} — one row of "
+            f"selections per OUTER sequence (kmax over per-sub-seq scores "
+            f"with a 1-level lod grouped by outer sequence)")
+    outer = np.asarray(lod[0])   # outer seq -> sub-seq span
+    inner = np.asarray(lod[1])   # sub-seq -> row span
+    rows, new_splits = [], [0]
+    for b in range(len(outer) - 1):
+        for idx in sel[b]:
+            idx = int(idx)
+            if idx < 0:
+                continue
+            sub = outer[b] + idx
+            if sub >= outer[b + 1]:
+                raise ValueError(
+                    f"sub_nested_seq: selected index {idx} out of range "
+                    f"for outer sequence {b}")
+            rows.extend(range(int(inner[sub]), int(inner[sub + 1])))
+            new_splits.append(len(rows))
+    ctx.set_output("Out", x[jnp.asarray(np.asarray(rows, np.int32))])
+    ctx.set_output_lod("Out", [new_splits])

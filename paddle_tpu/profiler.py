@@ -236,13 +236,21 @@ def compiled_op_table(trace_dir, sorted_key="total"):
 def compiled_profiler(trace_dir=None, sorted_key="total"):
     """Trace compiled execution inside the block and print the per-IR-op
     device-time table on exit (the compiled-path counterpart of
-    ``op_profiler``, which times interpret mode)."""
+    ``op_profiler``, which times interpret mode).  A temp trace dir is
+    created — and removed afterwards — unless ``trace_dir`` is given
+    (pass one to keep the raw xplane protos)."""
+    import shutil
     import tempfile
+    own = trace_dir is None
     d = trace_dir or tempfile.mkdtemp(prefix="ptprof_")
     jax.profiler.start_trace(d)
     try:
         yield d
     finally:
         jax.profiler.stop_trace()
-        table, _ = compiled_op_table(d, sorted_key)
-        print(table)
+        try:
+            table, _ = compiled_op_table(d, sorted_key)
+            print(table)
+        finally:
+            if own:
+                shutil.rmtree(d, ignore_errors=True)

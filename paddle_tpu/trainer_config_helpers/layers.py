@@ -40,7 +40,8 @@ __all__ = [
     # sequence
     "pooling_layer", "last_seq", "first_seq", "expand_layer",
     "repeat_layer", "seq_reshape_layer", "seq_slice_layer",
-    "sub_seq_layer", "kmax_seq_score_layer", "ctc_layer", "warp_ctc_layer",
+    "sub_seq_layer", "sub_nested_seq_layer", "kmax_seq_score_layer",
+    "ctc_layer", "warp_ctc_layer",
     # elementwise / math
     "addto_layer", "interpolation_layer", "bilinear_interp_layer",
     "power_layer", "scaling_layer", "slope_intercept_layer", "trans_layer",
@@ -864,6 +865,22 @@ def sub_seq_layer(input, offsets, sizes, act=None, bias_attr=None,
                   name=None):
     return _named(_apply_act(F.sequence_slice(input, offsets, sizes), act),
                   name)
+
+
+def sub_nested_seq_layer(input, selected_indices, name=None):
+    """Trim a nested sequence to the sub-sequences picked by
+    ``selected_indices`` (e.g. kmax_seq_score_layer output) — beam
+    training (reference ``layers.py:7045`` over
+    SubNestedSequenceLayer.cpp)."""
+    from paddle_tpu.layer_helper import LayerHelper
+    helper = LayerHelper("sub_nested_seq", name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    # gradients flow to X (row gather); the indices are non-differentiable
+    helper.append_op(type="sub_nested_seq",
+                     inputs={"X": [input],
+                             "SelectedIndices": [selected_indices]},
+                     outputs={"Out": [out]})
+    return _named(out, name)
 
 
 def kmax_seq_score_layer(input, name=None, beam_size=1):

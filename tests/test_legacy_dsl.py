@@ -640,3 +640,52 @@ class TestKmaxSeqScore:
                          for a, b in zip(lod[0], lod[0][1:])])
         np.testing.assert_array_equal(outs[False], want)
         np.testing.assert_array_equal(outs[True], want)
+
+
+class TestSubNestedSeq:
+    def test_select_subsequences_by_kmax_ids(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = F.data("x", shape=[-1, 2], dtype="float32",
+                       append_batch_size=False, lod_level=2)
+            sel = F.data("sel", shape=[-1, 2], dtype="int64",
+                         append_batch_size=False)
+            out = tch.sub_nested_seq_layer(x, sel)
+            pooled = F.sequence_pool(out, "sum")
+        xv = np.arange(18, dtype="f").reshape(9, 2)
+        lod = [[0, 2, 5], [0, 2, 5, 7, 8, 9]]
+        sel_v = np.array([[1, -1], [2, 0]], "int64")
+        o, p = _run(main, startup, {"x": (xv, lod), "sel": sel_v},
+                    [out.name, pooled.name])
+        # outer0 picks subseq 1 (rows 2-4); outer1 picks subseq 2 (row 8)
+        # then subseq 0 (rows 5-6)
+        np.testing.assert_allclose(np.asarray(o), xv[[2, 3, 4, 8, 5, 6]])
+        assert np.asarray(p).shape == (3, 2)  # 3 selected sub-sequences
+
+
+def test_sub_nested_seq_gradients_flow():
+    """Beam training: gradients flow through the sub-sequence selection
+    back to the upstream encoder (reference SubNestedSequenceLayer.cpp
+    implements backward)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = F.data("x", shape=[-1, 2], dtype="float32",
+                   append_batch_size=False, lod_level=2)
+        sel = F.data("sel", shape=[-1, 2], dtype="int64",
+                     append_batch_size=False)
+        h = F.fc(x, 2, bias_attr=False,
+                 param_attr=fluid.ParamAttr("sub_w"))
+        h.lod_level = 2
+        picked = tch.sub_nested_seq_layer(h, sel)
+        loss = F.reduce_sum(F.square(picked))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.arange(18, dtype="f").reshape(9, 2)
+    lod = [[0, 2, 5], [0, 2, 5, 7, 8, 9]]
+    sel_v = np.array([[1, -1], [2, 0]], "int64")
+    w0 = np.asarray(fluid.global_scope().find_var("sub_w")).copy()
+    exe.run(main, feed={"x": (xv, lod), "sel": sel_v},
+            fetch_list=[loss.name])
+    w1 = np.asarray(fluid.global_scope().find_var("sub_w"))
+    assert not np.allclose(w0, w1), "no gradient reached the encoder"
