@@ -28,7 +28,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+try:  # jax >= 0.8 promotes shard_map out of experimental
+    from jax import shard_map
+    _SM_CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+    _SM_CHECK_KW = "check_rep"
 
 __all__ = ["gpipe", "stack_stage_params"]
 
@@ -41,6 +47,12 @@ def stack_stage_params(per_stage_params):
         if p.keys() != keys:
             raise ValueError("pipeline stages must be homogeneous "
                              "(same parameter names/shapes)")
+        for k in keys:
+            if p[k].shape != per_stage_params[0][k].shape:
+                raise ValueError(
+                    f"pipeline stages must be homogeneous: param {k!r} "
+                    f"has shape {p[k].shape} vs "
+                    f"{per_stage_params[0][k].shape}")
     return {k: jnp.stack([p[k] for p in per_stage_params])
             for k in keys}
 
@@ -54,8 +66,16 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh: Mesh,
     leading [P] stage axis.  Returns [M, mb, ...] outputs (the last
     stage's results, gathered).  Fully differentiable — take ``jax.grad``
     of a loss over the returned outputs w.r.t. ``stacked_params``.
+
+    Memory note: microbatch inputs are replicated across stages (every
+    device holds [M, mb, ...]); in the deepest-memory regimes the next
+    refinement is feeding stage 0 only (shard the M axis + an ingest
+    ppermute) at the cost of schedule complexity.
     """
-    p_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    if axis not in mesh.shape:
+        raise ValueError(f"gpipe: mesh has no axis {axis!r} "
+                         f"(axes: {list(mesh.shape)})")
+    p_size = mesh.shape[axis]
     m = microbatches.shape[0]
     leading = {leaf.shape[0] for leaf in
                jax.tree_util.tree_leaves(stacked_params)}
@@ -105,5 +125,5 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh: Mesh,
         lambda _: P(axis), stacked_params)
     fn = shard_map(per_device, mesh=mesh,
                    in_specs=(spec_params, P()), out_specs=P(),
-                   check_rep=False)
+                   **{_SM_CHECK_KW: False})
     return fn(stacked_params, microbatches)
