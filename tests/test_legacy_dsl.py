@@ -689,3 +689,55 @@ def test_sub_nested_seq_gradients_flow():
             fetch_list=[loss.name])
     w1 = np.asarray(fluid.global_scope().find_var("sub_w"))
     assert not np.allclose(w0, w1), "no gradient reached the encoder"
+
+
+class TestProjectionWeightSharing:
+    def test_tied_autoencoder_shares_one_matrix(self):
+        """trans_full_matrix_projection's stated purpose: tie the decoder
+        to the encoder's weight (used transposed).  One parameter, both
+        directions; training moves the single matrix."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = tch.data_layer("x", 6)
+            enc = tch.mixed_layer(size=3, input=[
+                tch.full_matrix_projection(
+                    x, param_attr=fluid.ParamAttr("tied.w"))])
+            dec = tch.mixed_layer(size=6, input=[
+                tch.trans_full_matrix_projection(
+                    enc, param_attr=fluid.ParamAttr("tied.w"))])
+            cost = tch.sum_cost(tch.square_error_cost(dec, x))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+        # exactly ONE weight parameter exists
+        from paddle_tpu.framework import Parameter
+        params = [n for n, v in main.global_block().vars.items()
+                  if isinstance(v, Parameter)]
+        assert params == ["tied.w"], params
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(16, 6).astype("f")}
+        losses = []
+        for _ in range(30):
+            (l,) = exe.run(main, feed=feed, fetch_list=[cost.name])
+            losses.append(float(np.asarray(l).reshape(())))
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+    def test_conv_operator_numeric(self):
+        """conv_operator correlates the image with a graph-supplied
+        filter (reference ConvOperator): identity 1x1 filter passes the
+        image through."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = F.data("img", shape=[2, 1, 4, 4], dtype="float32",
+                         append_batch_size=False)
+            filt = F.data("filt", shape=[1, 1], dtype="float32",
+                          append_batch_size=False)
+            out = tch.mixed_layer(size=16, input=[
+                tch.conv_operator(img=img, filter=filt, filter_size=1,
+                                  num_filters=1, num_channels=1)])
+        rng = np.random.RandomState(0)
+        iv = rng.rand(2, 1, 4, 4).astype("f")
+        (o,) = _run(main, startup,
+                    {"img": iv, "filt": np.ones((1, 1), "f")}, [out.name])
+        np.testing.assert_allclose(np.asarray(o), iv.reshape(2, 16),
+                                   rtol=1e-6)
