@@ -125,11 +125,12 @@ def multi_head_attention(queries, keys, values, d_key, d_value, d_model,
 
     # the VMEM-fused kernel wins once the [S,S] score tensor dominates HBM
     # traffic; crossover is workload-dependent, so the threshold is a knob
-    # (PADDLE_TPU_FLASH_MIN_S; default 1024 from the measured v5e
-    # crossover in BENCH_ATTENTION.md: S=1024 flash 1.16x XLA, S=2048
-    # 1.37x, S=4096 XLA OOM; at S<=512 the composed path wins)
+    # (PADDLE_TPU_FLASH_MIN_S; default 512 from the measured v5e
+    # crossover in BENCH_ATTENTION.md with 1024-blocks: S=512 flash
+    # 1.13x XLA, S=1024 1.47x, S=2048 1.94x, S=4096 XLA OOM; at S=256
+    # the composed path wins 0.80x)
     import os
-    flash_min_s = int(os.environ.get("PADDLE_TPU_FLASH_MIN_S", "1024"))
+    flash_min_s = int(os.environ.get("PADDLE_TPU_FLASH_MIN_S", "512"))
     use_flash = use_flash and (k.shape[2] >= flash_min_s)
     # sequence/context parallelism: shard S over the mesh 'seq' axis and
     # attend with the ppermute ring (parallel/ring_attention.py); only for

@@ -10,10 +10,11 @@ a time with an online softmax (VMEM use independent of sequence length),
 and the backward runs as two flash kernels (dq; dk+dv) from the saved
 log-sum-exp residual, with fully-masked causal blocks skipped.  Measured
 crossover (``bench_attention.py`` -> checked-in ``BENCH_ATTENTION.md``,
-v5e fwd+bwd causal bf16, 64k tokens): S=512 flash 0.98x of XLA, S=1024
-1.16x, S=2048 1.37x, S=4096 XLA OOMs ([B,H,S,S] f32 scores) while flash
-runs.  Below the PADDLE_TPU_FLASH_MIN_S crossover (default 1024, from
-that artifact) the composed XLA path wins and is used instead.
+v5e fwd+bwd causal bf16, 64k tokens, 1024-blocks): S=512 flash 1.13x of
+XLA, S=1024 1.47x, S=2048 1.94x, S=4096 XLA OOMs ([B,H,S,S] f32 scores)
+while flash runs.  Below the PADDLE_TPU_FLASH_MIN_S crossover (default
+512, from that artifact) the composed XLA path wins and is used
+instead.
 
 Masking model (matches the transformer workloads):
   * ``k_mask`` [B, S_k] with 1 = attend / 0 = padding, optional;
@@ -221,18 +222,28 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
         dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _pick_block(s, prefer=(512, 256, 128, 64, 32, 16, 8)):
+def _pick_block(s, prefer=None):
     """Largest block size tiling ``s`` evenly (TPU wants the sublane dim a
-    multiple of 8); None = no even tiling -> use the reference path."""
+    multiple of 8); None = no even tiling -> use the reference path.
+    1024-blocks are the measured VMEM sweet spot (see _flash_blocks);
+    a full-array block up to 1024 is the last resort."""
+    if prefer is None:
+        prefer = _BLOCK_PREFER
     for cand in prefer:
         if s % cand == 0:
             return cand
-    return s if s <= 512 else None  # full-array block as last resort
+    return s if s <= 1024 else None  # full-array block as last resort
+
+
+_BLOCK_PREFER = (1024, 512, 256, 128, 64, 32, 16, 8)
 
 
 def _flash_blocks(S_q, S_k, interpret=False):
-    block_q = _pick_block(S_q, prefer=(256, 128, 64, 32, 16, 8))
-    block_k = _pick_block(S_k, prefer=(512, 256, 128, 64, 32, 16, 8))
+    # 1024-first: measured on v5e (fwd+bwd causal bf16, 64k tokens) —
+    # (1024,1024) beats the old (256,512) by 27-30% at S>=2048 (smaller
+    # S picks its own full-array block); (2048,2048) exceeds VMEM.
+    block_q = _pick_block(S_q)
+    block_k = _pick_block(S_k)
     if not interpret:
         # real TPU lowering: a block's last dim must be a multiple of 128
         # or equal to the array dim (the mask block's last dim is block_k)
