@@ -27,7 +27,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from paddle_tpu.ops.registry import (
     register_op, LowerContext, ShapeInferenceSkip)

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.ops.registry import (
-    register_op, register_grad_lower, infer_shape_unary, ShapeInferenceSkip)
+    register_op, infer_shape_unary, ShapeInferenceSkip)
 
 
 # ---------------------------------------------------------------------------

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.ops.registry import (
-    register_op, register_grad_lower, LowerContext, ShapeInferenceSkip)
+    register_op, LowerContext, ShapeInferenceSkip)
 
 
 def _infer_skip(op, block):
