@@ -8,6 +8,7 @@ import hashlib
 import os
 import pickle
 import glob
+import shutil
 
 import numpy as np
 
@@ -47,7 +48,22 @@ def download(url, module_name, md5sum, save_name=None):
         import urllib.request
         tmp = filename + ".part"
         try:
-            urllib.request.urlretrieve(url, tmp)
+            # stream with a connect/read timeout so a stalled connection
+            # raises (and the caller falls back to the synthetic
+            # generator) instead of hanging the resolver forever
+            timeout = float(os.environ.get(
+                "PADDLE_TPU_DATASET_TIMEOUT", "60"))
+            with urllib.request.urlopen(url, timeout=timeout) as resp, \
+                    open(tmp, "wb") as out_f:
+                shutil.copyfileobj(resp, out_f)
+            # a mid-body connection close returns normally from
+            # copyfileobj; catch truncation before publishing (matters
+            # when md5sum is falsy and the md5 gate below is skipped)
+            want = resp.headers.get("Content-Length")
+            if want is not None and os.path.getsize(tmp) != int(want):
+                raise IOError(
+                    f"truncated download of {url}: got "
+                    f"{os.path.getsize(tmp)} of {want} bytes")
         except BaseException:
             if os.path.exists(tmp):
                 os.remove(tmp)  # no stale partials in the cache

@@ -1137,15 +1137,20 @@ def ctc_greedy_decoder(input, blank=0):
     return out
 
 
-def image_resize(input, out_shape, method="bilinear", name=None):
+def image_resize(input, out_shape, method="bilinear", name=None,
+                 align_corners=True):
     """Resize NCHW feature maps to ``out_shape`` = (H, W) by bilinear or
     nearest interpolation (reference gserver BilinearInterpLayer.cpp /
-    UpsampleLayer.cpp; lowered to jax.image.resize)."""
+    UpsampleLayer.cpp). For bilinear, ``align_corners=True`` (the
+    default) matches the reference's ``(in-1)/(out-1)`` sampling ratios
+    and ``False`` uses the half-pixel convention of jax.image.resize;
+    nearest always uses half-pixel (identical to the reference's
+    pixel-duplication for integer upsample factors)."""
     helper = LayerHelper("image_resize", name=name)
     out = helper.create_tmp_variable(dtype=input.dtype)
     helper.append_op(
         type="image_resize", inputs={"X": [input]},
         outputs={"Out": [out]},
         attrs={"out_h": int(out_shape[0]), "out_w": int(out_shape[1]),
-               "method": method})
+               "method": method, "align_corners": bool(align_corners)})
     return out

@@ -687,13 +687,40 @@ def _infer_image_resize(op, block):
     out.dtype = x.dtype
 
 
+def _bilinear_align_corners(x, out_h, out_w):
+    """Align-corners bilinear resize of NCHW maps: source coordinate
+    ``i * (in-1)/(out-1)`` per the reference BilinearInterpLayer ratios
+    (vs jax.image.resize's half-pixel convention). Gather + lerp, so it
+    is differentiable."""
+    _, _, h, w = x.shape
+
+    def axis(in_sz, out_sz):
+        if out_sz == 1 or in_sz == 1:
+            zero = jnp.zeros((out_sz,), jnp.int32)
+            return zero, zero, jnp.zeros((out_sz,), x.dtype)
+        pos = jnp.arange(out_sz, dtype=x.dtype) * ((in_sz - 1) / (out_sz - 1))
+        lo = jnp.floor(pos).astype(jnp.int32)
+        lo = jnp.minimum(lo, in_sz - 2)
+        return lo, lo + 1, pos - lo.astype(x.dtype)
+
+    h0, h1, fh = axis(h, out_h)
+    w0, w1, fw = axis(w, out_w)
+    fh = fh[None, None, :, None]
+    fw = fw[None, None, None, :]
+    rows = x[:, :, h0, :] * (1 - fh) + x[:, :, h1, :] * fh
+    return rows[:, :, :, w0] * (1 - fw) + rows[:, :, :, w1] * fw
+
+
 @register_op("image_resize", infer_shape=_infer_image_resize)
 def image_resize_lower(ctx):
     x = ctx.input("X")                   # [N, C, H, W]
     method = ctx.attr("method", "bilinear")
     out_h, out_w = ctx.attr("out_h"), ctx.attr("out_w")
-    jmethod = {"bilinear": "linear", "nearest": "nearest"}[method]
-    out = jax.image.resize(
-        x.astype(jnp.float32), (x.shape[0], x.shape[1], out_h, out_w),
-        method=jmethod)
+    xf = x.astype(jnp.float32)
+    if method == "bilinear" and ctx.attr("align_corners", True):
+        out = _bilinear_align_corners(xf, out_h, out_w)
+    else:
+        jmethod = {"bilinear": "linear", "nearest": "nearest"}[method]
+        out = jax.image.resize(
+            xf, (x.shape[0], x.shape[1], out_h, out_w), method=jmethod)
     ctx.set_output("Out", out.astype(x.dtype))

@@ -616,9 +616,12 @@ def _infer_sampling_id(op, block):
              no_gradient=True, uses_rng=True)
 def sampling_id_lower(ctx):
     x = ctx.input("X")                       # [N, C] probabilities
+    # CDF + uniforms in float32 regardless of input dtype: bf16 cumsum
+    # over a large vocab accumulates ~2^-8 rounding that visibly biases
+    # the sampled distribution.
     u = jax.random.uniform(ctx.rng_key(), (x.shape[0], 1),
-                           dtype=x.dtype)
-    cdf = jnp.cumsum(x, axis=1)
+                           dtype=jnp.float32)
+    cdf = jnp.cumsum(x.astype(jnp.float32), axis=1)
     idx = jnp.sum((cdf < u).astype(jnp.int32), axis=1, keepdims=True)
     # int64 to match the declared IR dtype (jax truncates to int32 when
     # x64 is disabled, the framework-wide convention — cf. arg_max)

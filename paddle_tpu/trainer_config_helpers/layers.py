@@ -1200,8 +1200,12 @@ def row_l2_norm_layer(input, name=None, layer_attr=None):
 def img_cmrnorm_layer(input, size=5, scale=0.0128, power=0.75, name=None,
                       num_channels=None, layer_attr=None):
     """Cross-map response normalization == LRN (reference
-    ``layers.py:3199`` over CMRProjectionNormLayer.cpp)."""
-    return _named(F.lrn(input, n=size, alpha=scale, beta=power), name)
+    ``layers.py:3199`` over CMRProjectionNormLayer.cpp). The reference
+    config_parser divides ``scale`` by the window size for
+    cmrnorm-projection (``norm_conf.scale /= norm.size``), so the LRN
+    alpha is ``scale / size``."""
+    return _named(F.lrn(input, n=size, alpha=scale / size, beta=power),
+                  name)
 
 
 def cross_channel_norm_layer(input, name=None, param_attr=None):
@@ -1395,10 +1399,11 @@ def hsigmoid(input, label, num_classes, name=None, bias_attr=None,
             lvl += 1
     w = F.create_parameter(shape=[num_inner, d], dtype=x.dtype,
                            attr=param_attr)
-    b = F.create_parameter(shape=[num_inner, 1], dtype=x.dtype,
-                           is_bias=True,
-                           attr=None if bias_attr in (None, True, False)
-                           else bias_attr)
+    # bias_attr=False disables the bias entirely (same gating as
+    # mixed_layer/addto_layer); None/True means a default bias.
+    b = None if bias_attr is False else F.create_parameter(
+        shape=[num_inner, 1], dtype=x.dtype, is_bias=True,
+        attr=None if bias_attr in (None, True) else bias_attr)
     ids_t = _constant(path_ids, "int64")      # [C, D]
     codes_t = _constant(path_codes, "float32")
     mask_t = _constant(path_mask, "float32")
@@ -1408,11 +1413,12 @@ def hsigmoid(input, label, num_classes, name=None, bias_attr=None,
     sample_mask = F.gather(mask_t, lbl)       # [N, D]
     flat_ids = F.reshape(sample_ids, shape=[-1])
     w_rows = F.gather(w, flat_ids)            # [N*D, d]
-    b_rows = F.reshape(F.gather(b, flat_ids), shape=[-1, depth])
     n_d = F.reshape(w_rows, shape=[-1, depth, d])
-    logits = F.elementwise_add(
-        F.reduce_sum(F.elementwise_mul(n_d, F.reshape(x, shape=[-1, 1, d])),
-                     dim=2), b_rows)          # [N, D]
+    logits = F.reduce_sum(
+        F.elementwise_mul(n_d, F.reshape(x, shape=[-1, 1, d])), dim=2)
+    if b is not None:
+        b_rows = F.reshape(F.gather(b, flat_ids), shape=[-1, depth])
+        logits = F.elementwise_add(logits, b_rows)   # [N, D]
     # sigmoid CE: code 1 -> right-child target
     ce = F.sigmoid_cross_entropy_with_logits(logits, sample_codes)
     loss = F.reduce_sum(F.elementwise_mul(ce, sample_mask), dim=1,

@@ -167,6 +167,40 @@ class TestMathLayers:
         assert shapes[3] == (2, 3, 8, 8)
         assert shapes[4][1] == 2  # 4 channels maxout 2 groups
 
+    def test_bilinear_interp_align_corners(self):
+        # align-corners ratios: src = i*(in-1)/(out-1), the reference
+        # BilinearInterpLayer convention
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = F.data("img", shape=[1, 1, 3, 3], dtype="float32",
+                         append_batch_size=False)
+            bi = tch.bilinear_interp_layer(img, out_size_x=5, out_size_y=5)
+        xv = np.arange(9, dtype="f").reshape(1, 1, 3, 3)
+        (o,) = _run(main, startup, {"img": xv}, [bi.name])
+        pos = np.arange(5) * (3 - 1) / (5 - 1)
+        lo = np.minimum(np.floor(pos).astype(int), 1)
+        fr = pos - lo
+        src = xv[0, 0]
+        rows = src[lo, :] * (1 - fr)[:, None] + src[lo + 1, :] * fr[:, None]
+        want = rows[:, lo] * (1 - fr)[None, :] + rows[:, lo + 1] * fr[None, :]
+        np.testing.assert_allclose(np.asarray(o).reshape(5, 5), want,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_img_cmrnorm_scale_over_size(self):
+        # the reference config_parser divides scale by the window size
+        # before it reaches the LRN kernel (norm_conf.scale /= size)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = F.data("img", shape=[1, 4, 2, 2], dtype="float32",
+                         append_batch_size=False)
+            cmr = tch.img_cmrnorm_layer(img, size=4, scale=0.4, power=0.75)
+            direct = F.lrn(img, n=4, alpha=0.1, beta=0.75)
+        rng = np.random.RandomState(1)
+        feed = {"img": rng.rand(1, 4, 2, 2).astype("f")}
+        ov, dv = _run(main, startup, feed, [cmr.name, direct.name])
+        np.testing.assert_allclose(np.asarray(ov), np.asarray(dv),
+                                   rtol=1e-6)
+
     def test_sequence_reverse(self):
         main, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, startup):
@@ -184,6 +218,17 @@ class TestMathLayers:
 # ---------------------------------------------------------------------------
 
 class TestCostLayers:
+    def test_hsigmoid_bias_attr_false_skips_bias(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = tch.data_layer("x", 10)
+            lbl = tch.data_layer("lbl", 1, type=dt.integer_value(8))
+            tch.hsigmoid(x, lbl, num_classes=8, bias_attr=False)
+        n_bias = sum(1 for v in main.global_block().vars.values()
+                     if getattr(v, "persistable", False)
+                     and tuple(v.shape or ())[-1:] == (1,))
+        assert n_bias == 0, "bias_attr=False must not create a bias"
+
     def test_hsigmoid_and_fm_train(self):
         main, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, startup):
