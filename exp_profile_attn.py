@@ -1,0 +1,40 @@
+"""Profile one training step of the flagship bench model, flash vs
+composed, attributing device time per IR op (round-4 S=256 analysis)."""
+import os, sys, tempfile
+os.environ["PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION"] = "python"
+import numpy as np
+import jax
+
+mode = sys.argv[1] if len(sys.argv) > 1 else "flash"
+os.environ["PADDLE_TPU_FLASH_MIN_S"] = "256" if mode == "flash" else "99999"
+
+import paddle_tpu as fluid
+from paddle_tpu.models import transformer as T
+from paddle_tpu import profiler
+
+hp = T.ModelHyperParams()
+batch, seq, steps = 256, 256, 4
+main_prog, startup = fluid.Program(), fluid.Program()
+batches = [T.fake_batch(batch, seq, seq, hp, seed=s) for s in range(steps)]
+with fluid.program_guard(main_prog, startup):
+    avg_cost, _ = T.transformer(batch, seq, seq, hp)
+    fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+main_prog.amp = True
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe = fluid.Executor()
+    exe.run(startup)
+    stacked = {k: jax.device_put(np.stack([b[k] for b in batches]))
+               for k in batches[0]}
+    exe.run_steps(main_prog, feed=stacked, fetch_list=[avg_cost.name],
+                  steps=steps)  # warmup/compile
+    td = tempfile.mkdtemp()
+    jax.profiler.start_trace(td)
+    exe.run_steps(main_prog, feed=stacked, fetch_list=[avg_cost.name],
+                  steps=steps)
+    jax.profiler.stop_trace()
+    table, rows = profiler.compiled_op_table(td)
+    total = sum(r[2] for r in rows)
+    print(f"mode={mode} total_device_s={total:.4f} ({steps} steps)")
+    for op, calls, sec in rows[:25]:
+        print(f"  {op:40s} {calls:6d} {sec*1e3/steps:9.3f} ms/step")

@@ -1,0 +1,288 @@
+"""Experiment: small-S specialized flash attention (round-4 perf item).
+
+At S=256 the shipped flash kernel loses to composed XLA (0.803x): its
+grid is (B, H, 1, 1) = 2048 tiny programs, each paying online-softmax
+scratch traffic that is pointless when the whole [S, S] score tile fits
+VMEM.  This experiment tries a specialization for S_q == S_k <= 1024:
+
+  * fold (B, H) into ONE grid axis with G bh-pairs per program
+    (1 grid dim instead of 4);
+  * single-pass softmax — scores live in registers/VMEM once, no
+    running-max/denominator scratch, no @pl.when init/final phases;
+  * ONE backward kernel producing dq, dk, dv together (the shipped path
+    runs two kernels, each recomputing the scores).
+
+Times fwd+bwd vs the shipped flash and composed XLA at S in {256, 512},
+G in {1, 4, 8, 16}, and checks numerics against the reference path.
+Artifact feeding the ops/attention_ops.py integration.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bench import measure_trials
+from paddle_tpu.ops.attention_ops import (
+    fused_attention, _reference_attention, NEG_INF)
+
+ITERS = 10
+HEADS, DIM = 8, 64
+TOKENS = 1 << 16
+
+
+def _causal_bias_2d(S):
+    row = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    return jnp.where(col > row, NEG_INF, 0.0)
+
+
+def _smalls_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
+                       causal, scale, G, S):
+    bias = _causal_bias_2d(S) if causal else None
+    for g in range(G):
+        q = q_ref[g]                      # [S, D]
+        k = k_ref[g]
+        v = v_ref[g]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = s + (1.0 - mask_ref[g][:, 0].astype(jnp.float32))[None, :] * NEG_INF
+        if bias is not None:
+            s = s + bias
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_ref[g] = (o / l).astype(o_ref.dtype)
+        # residual as (m, log l) SEPARATELY: fl(m + log l) == m when
+        # |m| ~ 1e9 (fully-masked row), which breaks bwd's p = exp(s-lse)
+        lse_ref[g] = jnp.concatenate([m, jnp.log(l)], axis=1)
+
+
+def _smalls_bwd_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                       delta_ref, dq_ref, dk_ref, dv_ref, *, causal,
+                       scale, G, S):
+    bias = _causal_bias_2d(S) if causal else None
+    for g in range(G):
+        q = q_ref[g]
+        k = k_ref[g]
+        v = v_ref[g]
+        do = do_ref[g]
+        m = lse_ref[g][:, 0:1]            # [S, 1]
+        logl = lse_ref[g][:, 1:2]         # [S, 1]
+        delta = delta_ref[g]              # [S, 1]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = s + (1.0 - mask_ref[g][:, 0].astype(jnp.float32))[None, :] * NEG_INF
+        if bias is not None:
+            s = s + bias
+        p = jnp.exp((s - m) - logl)
+        dv_ref[g] = jax.lax.dot_general(
+            p.astype(do.dtype), do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_ref[g] = jax.lax.dot_general(
+            ds.astype(k.dtype), k,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        dk_ref[g] = jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+
+def smalls_fwd(q, k, v, k_mask, causal, scale, G):
+    B, H, S, D = q.shape
+    BH = B * H
+    qf = q.reshape(BH, S, D)
+    kf = k.reshape(BH, S, D)
+    vf = v.reshape(BH, S, D)
+    maskf = jnp.broadcast_to(k_mask[:, None, :], (B, H, S)) \
+        .reshape(BH, S, 1)
+    out, lse = pl.pallas_call(
+        functools.partial(_smalls_fwd_kernel, causal=causal, scale=scale,
+                          G=G, S=S),
+        grid=(BH // G,),
+        in_specs=[
+            pl.BlockSpec((G, S, D), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((G, S, D), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((G, S, D), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((G, S, 1), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((G, S, D), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((G, S, 2), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, 2), jnp.float32),
+        ],
+    )(qf, kf, vf, maskf)
+    return out.reshape(B, H, S, D), lse.reshape(B, H, S, 2)
+
+
+def smalls_bwd(q, k, v, k_mask, o, lse, g, causal, scale, G):
+    B, H, S, D = q.shape
+    BH = B * H
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    maskf = jnp.broadcast_to(k_mask[:, None, :], (B, H, S)) \
+        .reshape(BH, S, 1)
+    flat = lambda x: x.reshape(BH, S, -1)
+    spec3 = pl.BlockSpec((G, S, D), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM)
+    spec1 = pl.BlockSpec((G, S, 1), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM)
+    spec2 = pl.BlockSpec((G, S, 2), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_smalls_bwd_kernel, causal=causal, scale=scale,
+                          G=G, S=S),
+        grid=(BH // G,),
+        in_specs=[
+            spec3, spec3, spec3, spec1,
+            spec3, spec2, spec1,
+        ],
+        out_specs=[spec3, spec3, spec3],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+    )(flat(q), flat(k), flat(v), maskf, flat(g), lse.reshape(BH, S, 2),
+      delta.reshape(BH, S, 1))
+    unflat = lambda x: x.reshape(B, H, S, D)
+    return unflat(dq), unflat(dk), unflat(dv)
+
+
+def make_smalls_attention(G):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+    def attn(q, k, v, k_mask, causal, scale):
+        out, _ = fwd(q, k, v, k_mask, causal, scale)
+        return out
+
+    def fwd(q, k, v, k_mask, causal, scale):
+        out, lse = smalls_fwd(q, k, v, k_mask, causal, scale, G)
+        return out, (q, k, v, k_mask, out, lse)
+
+    def bwd(causal, scale, res, g):
+        q, k, v, k_mask, o, lse = res
+        dq, dk, dv = smalls_bwd(q, k, v, k_mask, o, lse, g, causal,
+                                scale, G)
+        return dq, dk, dv, None
+
+    attn.defvjp(fwd, bwd)
+    return attn
+
+
+def check_numerics(S=256, B=4):
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, HEADS, S, DIM), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), q.shape, jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), q.shape, jnp.bfloat16)
+    k_mask = (jax.random.uniform(jax.random.PRNGKey(3), (B, S))
+              > 0.1).astype(jnp.bfloat16)
+    scale = DIM ** -0.5
+    attn = make_smalls_attention(G=4)
+
+    for causal in (False, True):
+        def loss_small(q, k, v):
+            return jnp.sum(attn(q, k, v, k_mask, causal, scale)
+                           .astype(jnp.float32))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_reference_attention(
+                q, k, v, k_mask, causal, scale).astype(jnp.float32))
+
+        o_s = attn(q, k, v, k_mask, causal, scale)
+        o_r = _reference_attention(q, k, v, k_mask, causal, scale)
+        err = jnp.max(jnp.abs(o_s.astype(jnp.float32)
+                              - o_r.astype(jnp.float32)))
+        gs = jax.jit(jax.grad(loss_small, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        gerr = max(float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                         - b.astype(jnp.float32))))
+                   for a, b in zip(gs, gr))
+        print(f"# numerics causal={causal}: fwd maxerr={float(err):.4f} "
+              f"bwd maxerr={gerr:.4f}", file=sys.stderr)
+        assert float(err) < 0.1 and gerr < 0.5, "numerics mismatch"
+
+
+def time_variant(step_fn, q, k, v):
+    g = step_fn(q, k, v)
+    np.asarray(g[0][0, 0, 0, 0])  # compile + settle
+
+    def run_once():
+        qq = q
+        last = None
+        for _ in range(ITERS):
+            gg = step_fn(qq, k, v)
+            qq = qq + 0.0 * gg[0]
+            last = gg
+        np.asarray(last[0][0, 0, 0, 0])
+
+    dt, _ = measure_trials(run_once, n_trials=3)
+    return dt / ITERS * 1e3
+
+
+def main():
+    check_numerics()
+    scale = DIM ** -0.5
+    for S in (256, 512):
+        B = TOKENS // S
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (B, HEADS, S, DIM), jnp.bfloat16)
+        k = jax.random.normal(jax.random.PRNGKey(1), q.shape, jnp.bfloat16)
+        v = jax.random.normal(jax.random.PRNGKey(2), q.shape, jnp.bfloat16)
+        k_mask = jnp.ones((B, S), jnp.bfloat16)
+        row = {"S": S, "B": B}
+
+        def mk(fn):
+            def loss(q, k, v):
+                return jnp.sum(fn(q, k, v).astype(jnp.float32))
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        row["xla_ms"] = round(time_variant(
+            mk(lambda q, k, v: fused_attention(
+                q, k, v, k_mask, True, scale, False)), q, k, v), 3)
+        row["flash_ms"] = round(time_variant(
+            mk(lambda q, k, v: fused_attention(
+                q, k, v, k_mask, True, scale, True)), q, k, v), 3)
+        for G in (1, 4, 8, 16):
+            attn = make_smalls_attention(G)
+            try:
+                row[f"smalls_G{G}_ms"] = round(time_variant(
+                    mk(lambda q, k, v, a=attn: a(
+                        q, k, v, k_mask, True, scale)), q, k, v), 3)
+            except Exception as e:
+                row[f"smalls_G{G}_ms"] = f"ERR {type(e).__name__}"
+                print(f"# S={S} G={G}: {e}", file=sys.stderr)
+        print(json.dumps(row))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
