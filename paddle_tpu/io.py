@@ -3,10 +3,15 @@
 ``save_persistables:145``, ``load_persistables:234``,
 ``save_inference_model:298``, ``load_inference_model:383``).
 
-Serialization: one ``.npz``-style file per variable (numpy format, TPU
-arrays are pulled to host) plus a JSON ``__model__`` for inference programs
-— replacing the reference's save_op tensor-proto files.  Sharded /
-multi-host checkpointing lives in ``paddle_tpu.checkpoint`` (orbax-style).
+Persistence runs THROUGH PROGRAMS, like the reference: ``save_vars`` /
+``load_vars`` build a program of ``save``/``load`` IR ops (one per
+variable, or a single ``save_combine``/``load_combine`` when ``filename``
+is given) and execute it — so a startup-style program containing load ops
+boots a scope, and exported models are runnable by ``native/capi.cpp``.
+The on-disk tensor format is the versioned container of
+``ops/persist_ops.py`` (replacing the reference's LoDTensor proto files
+of ``save_op.cc``).  Sharded / multi-host checkpointing lives below
+(orbax-style).
 """
 
 from __future__ import annotations
@@ -39,28 +44,48 @@ def _var_path(dirname, name):
     return os.path.join(dirname, name.replace("/", "%2F"))
 
 
+def _persist_program(vars, for_load):
+    """A fresh program whose global block mirrors ``vars`` (persistable),
+    ready to host save/load ops over them."""
+    prog = Program()
+    block = prog.global_block()
+    for var in vars:
+        v = block.create_var(name=var.name, shape=var.shape,
+                             dtype=var.dtype)
+        v.persistable = True
+        if for_load:
+            v.stop_gradient = True
+    return prog, block
+
+
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
-    """reference ``io.py:66``."""
+    """reference ``io.py:66``: build a program of ``save`` ops (or one
+    ``save_combine``) over the selected variables and run it."""
     scope = global_scope()
     if vars is None:
         main_program = main_program or default_main_program()
         vars = list(filter(predicate, main_program.list_vars()))
+    vars = [v for v in vars if scope.find_var(v.name) is not None]
     os.makedirs(dirname, exist_ok=True)
     if filename is not None:
-        arrs = {}
+        # combined records carry no names — order is the contract, so
+        # both ends sort by name (load_vars below does the same)
+        vars = sorted(vars, key=lambda v: v.name)
+    prog, block = _persist_program(vars, for_load=False)
+    if filename is not None:
+        if vars:
+            block.append_op(
+                type="save_combine",
+                inputs={"X": [v.name for v in vars]}, outputs={},
+                attrs={"file_path": os.path.join(dirname, filename)})
+    else:
         for var in vars:
-            val = scope.find_var(var.name)
-            if val is None:
-                continue
-            arrs[var.name] = np.asarray(val)
-        np.savez(os.path.join(dirname, filename), **arrs)
-        return
-    for var in vars:
-        val = scope.find_var(var.name)
-        if val is None:
-            continue
-        np.save(_var_path(dirname, var.name) + ".npy", np.asarray(val))
+            block.append_op(
+                type="save", inputs={"X": [var.name]}, outputs={},
+                attrs={"file_path": _var_path(dirname, var.name)})
+    if block.ops:
+        executor.run(prog, feed={}, fetch_list=[])
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
@@ -74,21 +99,45 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 
 def load_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
-    """reference ``io.py`` load_vars."""
-    scope = global_scope()
+    """reference ``io.py`` load_vars: build a program of ``load`` ops (or
+    one ``load_combine``) and run it to boot the scope."""
     if vars is None:
         main_program = main_program or default_main_program()
         vars = list(filter(predicate, main_program.list_vars()))
     if filename is not None:
-        data = np.load(os.path.join(dirname, filename))
-        for var in vars:
-            if var.name in data:
-                scope.set_var(var.name, data[var.name])
+        path = os.path.join(dirname, filename)
+        # the record order in the file is the contract; match the
+        # program's vars BY RECORDED NAME so a var that was skipped at
+        # save time (uninitialized) cannot shift every later assignment
+        from paddle_tpu.ops.persist_ops import read_record_names
+        recorded = read_record_names(path)
+        by_name = {v.name: v for v in vars}
+        if any(n is None for n in recorded):
+            vars = sorted(vars, key=lambda v: v.name)  # legacy files
+        else:
+            missing = [n for n in recorded if n not in by_name]
+            if missing:
+                raise ValueError(
+                    f"load_vars: {path!r} holds records for "
+                    f"{missing[:3]}... not present in the program")
+            vars = [by_name[n] for n in recorded]
+        prog, block = _persist_program(vars, for_load=True)
+        if vars:
+            block.append_op(
+                type="load_combine", inputs={},
+                outputs={"Out": [v.name for v in vars]},
+                attrs={"file_path": path})
+            executor.run(prog, feed={}, fetch_list=[])
         return
+    vars = [v for v in vars
+            if os.path.exists(_var_path(dirname, v.name))]
+    prog, block = _persist_program(vars, for_load=True)
     for var in vars:
-        path = _var_path(dirname, var.name) + ".npy"
-        if os.path.exists(path):
-            scope.set_var(var.name, np.load(path))
+        block.append_op(
+            type="load", inputs={}, outputs={"Out": [var.name]},
+            attrs={"file_path": _var_path(dirname, var.name)})
+    if block.ops:
+        executor.run(prog, feed={}, fetch_list=[])
 
 
 def load_params(executor, dirname, main_program=None, filename=None):
@@ -132,7 +181,10 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     model_filename = model_filename or "__model__"
     with open(os.path.join(dirname, model_filename), "w") as f:
         json.dump(model, f)
-    save_persistables(executor, dirname, inference_program, params_filename)
+    # combined params by default: __model__ + __params__ is the whole
+    # deployable artifact (runnable by serving.Predictor / native/capi.cpp)
+    save_persistables(executor, dirname, inference_program,
+                      params_filename or "__params__")
     return fetch_var_names
 
 
@@ -144,6 +196,9 @@ def load_inference_model(dirname, executor, model_filename=None,
         model = json.load(f)
     program = Program.from_dict(model["program"])
     program._is_inference = True
+    if params_filename is None and \
+            os.path.exists(os.path.join(dirname, "__params__")):
+        params_filename = "__params__"
     load_persistables(executor, dirname, program, params_filename)
     fetch_vars = [program.global_block().var(n)
                   for n in model["fetch_var_names"]]

@@ -20,6 +20,7 @@ from paddle_tpu.ops import (  # noqa: F401
     logic_ops,
     metric_ops,
     io_ops,
+    persist_ops,
     control_flow_ops,
     sequence_ops,
     rnn_ops,

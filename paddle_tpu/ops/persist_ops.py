@@ -1,0 +1,156 @@
+"""save / load / save_combine / load_combine — persistence as IR ops.
+
+The reference serializes tensors from INSIDE programs (startup programs
+run load ops; inference export runs save ops): ``save_op.cc:1-130``,
+``load_op.cc:1-87``, ``save_load_combine_op_test.cc``.  These host-side
+lowerings do the same for this framework, with a versioned container
+format replacing the reference's LoDTensor proto header:
+
+  record := magic b"PTT0" | u32 header_len | JSON header | raw bytes
+  header := {"dtype": str, "shape": [int], "lod": [[int]]}
+
+``save_combine``/``load_combine`` concatenate records in one file (the
+order of the X/Out slots).  Data is little-endian C-order; bfloat16 is
+stored as uint16 words with dtype "bfloat16" in the header.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.registry import register_op
+
+MAGIC = b"PTT0"
+
+
+def _to_numpy(value):
+    arr = np.asarray(value)
+    if arr.dtype == jnp.bfloat16:
+        return arr.view(np.uint16), "bfloat16"
+    return arr, str(arr.dtype)
+
+
+def write_tensor(f, value, lod=None, name=None):
+    """Append one tensor record to an open binary file.  ``name`` is
+    advisory metadata (load_combine assigns POSITIONALLY, the reference
+    contract, but io.py uses recorded names to guard against skew)."""
+    arr, dtype_name = _to_numpy(value)
+    hdr = {
+        "dtype": dtype_name,
+        "shape": list(arr.shape),
+        "lod": [list(map(int, level)) for level in (lod or [])],
+    }
+    if name is not None:
+        hdr["name"] = str(name)
+    header = json.dumps(hdr).encode("utf-8")
+    f.write(MAGIC)
+    f.write(struct.pack("<I", len(header)))
+    f.write(header)
+    f.write(np.ascontiguousarray(arr).tobytes())
+
+
+def _read_header(f):
+    magic = f.read(4)
+    if magic != MAGIC:
+        raise ValueError(
+            f"bad tensor file: magic {magic!r} != {MAGIC!r} (wrong file "
+            f"or unsupported version)")
+    (hdr_len,) = struct.unpack("<I", f.read(4))
+    header = json.loads(f.read(hdr_len).decode("utf-8"))
+    shape = tuple(header["shape"])
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    itemsize = 2 if header["dtype"] == "bfloat16" else \
+        np.dtype(header["dtype"]).itemsize
+    return header, shape, n, itemsize
+
+
+def read_tensor(f):
+    """Read one tensor record; returns (ndarray, lod-list)."""
+    header, shape, n, itemsize = _read_header(f)
+    if header["dtype"] == "bfloat16":
+        raw = np.frombuffer(f.read(2 * n), dtype=np.uint16)
+        arr = raw.view(jnp.bfloat16).reshape(shape)
+    else:
+        dt = np.dtype(header["dtype"])
+        arr = np.frombuffer(f.read(dt.itemsize * n),
+                            dtype=dt).reshape(shape)
+    return arr, header.get("lod", [])
+
+
+def read_record_names(path):
+    """Recorded names of a combined file, in order (header scan only —
+    tensor payloads are seeked over, not read)."""
+    names = []
+    with open(path, "rb") as f:
+        while f.peek(4)[:4] if hasattr(f, "peek") else True:
+            probe = f.read(4)
+            if not probe:
+                break
+            f.seek(-4, 1)
+            header, _, n, itemsize = _read_header(f)
+            names.append(header.get("name"))
+            f.seek(n * itemsize, 1)
+    return names
+
+
+def _prepare_path(path, overwrite):
+    if os.path.exists(path) and not overwrite:
+        raise RuntimeError(
+            f"save: {path!r} exists and overwrite is disabled "
+            f"(reference save_op.cc PADDLE_ENFORCE)")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+
+
+@register_op("save", no_gradient=True, host=True)
+def save_lower(ctx):
+    """One variable -> one file (reference ``save_op.cc:1-130``)."""
+    path = ctx.attr("file_path")
+    _prepare_path(path, ctx.attr("overwrite", True))
+    name = ctx.op.input("X")[0]
+    with open(path, "wb") as f:
+        write_tensor(f, ctx.env[name], ctx.input_lod("X"), name=name)
+
+
+@register_op("load", no_gradient=True, host=True)
+def load_lower(ctx):
+    """One file -> one variable (reference ``load_op.cc:1-87``)."""
+    path = ctx.attr("file_path")
+    with open(path, "rb") as f:
+        arr, lod = read_tensor(f)
+    out_name = ctx.op.output("Out")[0]
+    ctx.outputs[out_name] = jnp.asarray(arr)
+    if lod:
+        ctx.aux.setdefault("lod", {})[out_name] = lod
+
+
+@register_op("save_combine", no_gradient=True, host=True)
+def save_combine_lower(ctx):
+    """All X inputs, in slot order, into one file (reference
+    ``save_combine_op`` in save_load_combine_op_test.cc)."""
+    path = ctx.attr("file_path")
+    _prepare_path(path, ctx.attr("overwrite", True))
+    names = ctx.op.input("X")
+    with open(path, "wb") as f:
+        for name in names:
+            lod = ctx.aux.get("lod", {}).get(name)
+            write_tensor(f, ctx.env[name], lod, name=name)
+
+
+@register_op("load_combine", no_gradient=True, host=True)
+def load_combine_lower(ctx):
+    """One file -> all Out outputs, in slot order."""
+    path = ctx.attr("file_path")
+    names = ctx.op.output("Out")
+    with open(path, "rb") as f:
+        for name in names:
+            arr, lod = read_tensor(f)
+            ctx.outputs[name] = jnp.asarray(arr)
+            if lod:
+                ctx.aux.setdefault("lod", {})[name] = lod

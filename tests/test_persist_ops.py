@@ -1,0 +1,219 @@
+"""save/load/save_combine/load_combine IR ops + program-level persistence
+(reference ``save_op.cc``, ``load_op.cc``, ``save_load_combine_op_test.cc``).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program
+from paddle_tpu.ops.persist_ops import MAGIC, read_tensor, write_tensor
+from paddle_tpu.scope import Scope, scope_guard
+
+
+def _scope_with(values):
+    scope = Scope()
+    for name, arr in values.items():
+        scope.set_var(name, arr)
+    return scope
+
+
+class TestTensorFormat:
+    def test_round_trip_dtypes(self, tmp_path):
+        path = tmp_path / "t.bin"
+        arrays = [
+            np.arange(12, dtype="float32").reshape(3, 4),
+            np.array([[1, 2], [3, 4]], dtype="int64"),
+            np.float32(3.5).reshape(()),  # rank-0
+        ]
+        with open(path, "wb") as f:
+            for a in arrays:
+                write_tensor(f, a)
+        with open(path, "rb") as f:
+            for a in arrays:
+                got, lod = read_tensor(f)
+                np.testing.assert_array_equal(got, a)
+                assert lod == []
+
+    def test_lod_round_trip(self, tmp_path):
+        path = tmp_path / "t.bin"
+        a = np.ones((5, 2), "float32")
+        with open(path, "wb") as f:
+            write_tensor(f, a, lod=[[0, 2, 5]])
+        with open(path, "rb") as f:
+            got, lod = read_tensor(f)
+        assert lod == [[0, 2, 5]]
+
+    def test_bad_magic_rejected(self, tmp_path):
+        path = tmp_path / "t.bin"
+        path.write_bytes(b"XXXX" + b"\0" * 16)
+        with open(path, "rb") as f:
+            with pytest.raises(ValueError, match="magic"):
+                read_tensor(f)
+
+    def test_versioned_header(self, tmp_path):
+        path = tmp_path / "t.bin"
+        with open(path, "wb") as f:
+            write_tensor(f, np.zeros((2,), "float32"))
+        assert path.read_bytes()[:4] == MAGIC
+
+
+class TestSaveLoadOps:
+    def test_save_then_load_program(self, tmp_path):
+        """A program containing save ops writes the files; a startup-style
+        program containing load ops boots a fresh scope — mirroring
+        save_load_combine_op_test.cc's lifecycle."""
+        rng = np.random.RandomState(0)
+        w = rng.rand(4, 3).astype("float32")
+        b = rng.rand(3).astype("float32")
+
+        save_prog = Program()
+        blk = save_prog.global_block()
+        for name, arr in (("w", w), ("b", b)):
+            v = blk.create_var(name=name, shape=arr.shape,
+                               dtype="float32")
+            v.persistable = True
+            blk.append_op(type="save", inputs={"X": [name]}, outputs={},
+                          attrs={"file_path": str(tmp_path / name)})
+        exe = fluid.Executor()
+        with scope_guard(_scope_with({"w": w, "b": b})):
+            exe.run(save_prog, feed={}, fetch_list=[])
+        assert (tmp_path / "w").exists() and (tmp_path / "b").exists()
+
+        boot_prog = Program()
+        blk = boot_prog.global_block()
+        for name, arr in (("w", w), ("b", b)):
+            v = blk.create_var(name=name, shape=arr.shape,
+                               dtype="float32")
+            v.persistable = True
+            blk.append_op(type="load", inputs={},
+                          outputs={"Out": [name]},
+                          attrs={"file_path": str(tmp_path / name)})
+        fresh = Scope()
+        with scope_guard(fresh):
+            exe.run(boot_prog, feed={}, fetch_list=[])
+            np.testing.assert_array_equal(
+                np.asarray(fresh.find_var("w")), w)
+            np.testing.assert_array_equal(
+                np.asarray(fresh.find_var("b")), b)
+
+    def test_save_combine_load_combine(self, tmp_path):
+        """Port of save_load_combine_op_test.cc: several tensors through
+        ONE file, restored in slot order."""
+        rng = np.random.RandomState(1)
+        tensors = {f"t{i}": rng.rand(2, i + 1).astype("float32")
+                   for i in range(4)}
+        names = sorted(tensors)
+        path = str(tmp_path / "combined")
+
+        save_prog = Program()
+        blk = save_prog.global_block()
+        for n in names:
+            v = blk.create_var(name=n, shape=tensors[n].shape,
+                               dtype="float32")
+            v.persistable = True
+        blk.append_op(type="save_combine", inputs={"X": names},
+                      outputs={}, attrs={"file_path": path})
+        exe = fluid.Executor()
+        with scope_guard(_scope_with(tensors)):
+            exe.run(save_prog, feed={}, fetch_list=[])
+
+        load_prog = Program()
+        blk = load_prog.global_block()
+        for n in names:
+            v = blk.create_var(name=n, shape=tensors[n].shape,
+                               dtype="float32")
+            v.persistable = True
+        blk.append_op(type="load_combine", inputs={},
+                      outputs={"Out": names}, attrs={"file_path": path})
+        fresh = Scope()
+        with scope_guard(fresh):
+            exe.run(load_prog, feed={}, fetch_list=[])
+            for n in names:
+                np.testing.assert_array_equal(
+                    np.asarray(fresh.find_var(n)), tensors[n])
+
+    def test_save_no_overwrite_errors(self, tmp_path):
+        path = str(tmp_path / "once")
+        prog = Program()
+        blk = prog.global_block()
+        v = blk.create_var(name="x", shape=(2,), dtype="float32")
+        v.persistable = True
+        blk.append_op(type="save", inputs={"X": ["x"]}, outputs={},
+                      attrs={"file_path": path, "overwrite": False})
+        exe = fluid.Executor()
+        with scope_guard(_scope_with({"x": np.zeros(2, "f")})):
+            exe.run(prog, feed={}, fetch_list=[])
+            with pytest.raises(Exception, match="overwrite"):
+                exe.run(prog, feed={}, fetch_list=[])
+
+
+class TestInferenceModelDirectory:
+    def test_model_dir_is_model_plus_params(self, tmp_path):
+        """save_inference_model emits __model__ + combined __params__ and
+        load_inference_model (hence serving.Predictor / native/capi.cpp)
+        runs it."""
+        import paddle_tpu.layers as layers
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            y = layers.fc(input=x, size=3, act="softmax")
+        exe = fluid.Executor()
+        scope = Scope()
+        d = str(tmp_path / "model")
+        with scope_guard(scope):
+            exe.run(startup)
+            fluid.io.save_inference_model(d, ["x"], [y], exe, main)
+            xv = np.random.RandomState(2).rand(5, 4).astype("f")
+            (want,) = exe.run(main.prune([y]).inference_optimize(),
+                              feed={"x": xv}, fetch_list=[y.name])
+        assert os.path.exists(os.path.join(d, "__model__"))
+        assert os.path.exists(os.path.join(d, "__params__"))
+        with open(os.path.join(d, "__params__"), "rb") as f:
+            assert f.read(4) == MAGIC
+
+        fresh = Scope()
+        with scope_guard(fresh):
+            prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+            (got,) = exe.run(prog, feed={feeds[0]: xv},
+                             fetch_list=[v.name for v in fetches])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5)
+
+
+class TestCombinedNameSafety:
+    def test_partial_save_does_not_shift_records(self, tmp_path):
+        """A var missing from the scope at save time must not mis-assign
+        every later record on load (records carry names; load matches by
+        name)."""
+        import paddle_tpu.layers as layers
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            layers.fc(input=x, size=3)
+        exe = fluid.Executor()
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(startup)
+            # drop ONE persistable from the scope -> save skips it
+            names = [v.name for v in main.list_vars()
+                     if getattr(v, "persistable", False)]
+            dropped = sorted(names)[0]
+            kept = {n: np.asarray(scope.find_var(n))
+                    for n in names if n != dropped}
+            scope2 = Scope()
+            for n, v in kept.items():
+                scope2.set_var(n, v)
+        with scope_guard(scope2):
+            fluid.io.save_persistables(exe, str(tmp_path), main,
+                                       filename="__params__")
+        fresh = Scope()
+        with scope_guard(fresh):
+            fluid.io.load_persistables(exe, str(tmp_path), main,
+                                       filename="__params__")
+            for n, want in kept.items():
+                np.testing.assert_array_equal(
+                    np.asarray(fresh.find_var(n)), want)
+            assert fresh.find_var(dropped) is None
