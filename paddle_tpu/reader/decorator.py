@@ -1,48 +1,74 @@
-"""Reader decorators (reference ``python/paddle/reader/decorator.py``):
-a *reader* is a nullary callable returning an iterable of samples."""
+"""Reader decorators.
+
+A *reader* is a nullary callable returning an iterable of samples — the
+reference's data-pipeline protocol (``python/paddle/reader/decorator.py``
+declares the same surface).  Each decorator here wraps one reader (or
+several) and returns a new reader; the threaded ones (``buffered``,
+``xmap_readers``) use a shared ``_STOP`` sentinel plus bounded queues,
+and ordered ``xmap_readers`` re-sequences results with a heap on the
+consumer side instead of busy-waiting in the workers.
+"""
 
 from __future__ import annotations
 
+import heapq
 import itertools
+import queue
 import random
-from queue import Queue
-from threading import Thread
+import threading
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache"]
 
+# end-of-stream marker shared by the threaded decorators (identity
+# compared, so samples can be anything — including numpy arrays)
+_STOP = object()
+
+
+class _Raised:
+    """A producer/worker exception, carried through the queue so it
+    re-raises on the CONSUMER side instead of vanishing in a daemon
+    thread (which would read as a clean, silently-truncated stream)."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
 
 def map_readers(func, *readers):
-    def reader():
-        rs = [r() for r in readers]
-        for e in map(func, *rs):
-            yield e
-    return reader
+    """``func`` applied elementwise across the readers' parallel streams."""
+
+    def _read():
+        yield from map(func, *(r() for r in readers))
+
+    return _read
 
 
 def shuffle(reader, buf_size):
-    def data_reader():
-        buf = []
-        for e in reader():
-            buf.append(e)
-            if len(buf) >= buf_size:
-                random.shuffle(buf)
-                for b in buf:
-                    yield b
-                buf = []
-        if len(buf) > 0:
-            random.shuffle(buf)
-            for b in buf:
-                yield b
-    return data_reader
+    """Local shuffling: collect a window of ``buf_size`` samples, emit it
+    in random order, repeat; the tail window is shuffled too."""
+
+    def _read():
+        window = []
+        for sample in reader():
+            window.append(sample)
+            if len(window) == buf_size:
+                random.shuffle(window)
+                yield from window
+                window = []
+        random.shuffle(window)
+        yield from window
+
+    return _read
 
 
 def chain(*readers):
-    def reader():
-        rs = [r() for r in readers]
-        for e in itertools.chain(*rs):
-            yield e
-    return reader
+    """All samples of the first reader, then the second, and so on."""
+
+    def _read():
+        for r in readers:
+            yield from r()
+
+    return _read
 
 
 class ComposeNotAligned(ValueError):
@@ -50,137 +76,163 @@ class ComposeNotAligned(ValueError):
 
 
 def compose(*readers, **kwargs):
+    """Zip several readers into one: each output sample is the
+    concatenation of one (tuple-ified) sample from every input.  With
+    ``check_alignment=True`` (default) a length mismatch raises
+    :class:`ComposeNotAligned`; otherwise the shortest stream wins."""
     check_alignment = kwargs.pop("check_alignment", True)
 
-    def make_tuple(x):
-        if isinstance(x, tuple):
-            return x
-        return (x,)
+    def _as_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
 
-    def reader():
-        rs = [r() for r in readers]
-        if not check_alignment:
-            for outputs in zip(*rs):
-                yield sum(list(map(make_tuple, outputs)), ())
+    def _read():
+        streams = [r() for r in readers]
+        if check_alignment:
+            groups = itertools.zip_longest(*streams, fillvalue=_STOP)
         else:
-            for outputs in zip(*rs):
-                lens = set(map(len, outputs)) if all(
-                    isinstance(o, tuple) for o in outputs) else None
-                yield sum(list(map(make_tuple, outputs)), ())
-    return reader
+            groups = zip(*streams)
+        for group in groups:
+            if any(s is _STOP for s in group):
+                raise ComposeNotAligned(
+                    "composed readers produced streams of different "
+                    "lengths")
+            yield tuple(itertools.chain.from_iterable(
+                map(_as_tuple, group)))
+
+    return _read
 
 
 def buffered(reader, size):
-    class EndSignal:
-        pass
+    """Decouple producer from consumer: a daemon thread pumps the wrapped
+    reader into a queue bounded at ``size`` samples, hiding producer
+    latency behind consumption."""
 
-    end = EndSignal()
+    def _read():
+        q = queue.Queue(maxsize=size)
 
-    def read_worker(r, q):
-        for d in r:
-            q.put(d)
-        q.put(end)
+        def pump():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            except BaseException as e:  # re-raised consumer-side
+                q.put(_Raised(e))
+            else:
+                q.put(_STOP)
 
-    def data_reader():
-        r = reader()
-        q = Queue(maxsize=size)
-        t = Thread(target=read_worker, args=(r, q))
-        t.daemon = True
-        t.start()
-        e = q.get()
-        while e is not end:
-            yield e
-            e = q.get()
-    return data_reader
+        threading.Thread(target=pump, daemon=True).start()
+        while True:
+            sample = q.get()
+            if sample is _STOP:
+                return
+            if isinstance(sample, _Raised):
+                raise sample.exc
+            yield sample
+
+    return _read
 
 
 def firstn(reader, n):
-    def firstn_reader():
-        for i, item in enumerate(reader()):
-            if i == n:
-                break
-            yield item
-    return firstn_reader
+    """Only the first ``n`` samples."""
+
+    def _read():
+        return itertools.islice(reader(), n)
+
+    return _read
 
 
 def cache(reader):
-    all_data = []
+    """Materialize the stream on first full pass; replay from memory on
+    every later pass.  (A pass abandoned midway is not cached.)"""
+    memo = []
+    complete = [False]
 
-    def cached_reader():
-        if not all_data:
-            for item in reader():
-                all_data.append(item)
-                yield item
-        else:
-            yield from all_data
-    return cached_reader
+    def _read():
+        if complete[0]:
+            yield from memo
+            return
+        fresh = []
+        for sample in reader():
+            fresh.append(sample)
+            yield sample
+        memo[:] = fresh
+        complete[0] = True
 
-
-class XmapEndSignal:
-    pass
+    return _read
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """Parallel map over a reader with worker threads (reference
-    decorator.py xmap_readers)."""
-    end = XmapEndSignal()
+    """Run ``mapper`` over the stream on ``process_num`` worker threads.
 
-    def read_worker(reader, in_queue):
-        for i in reader():
-            in_queue.put(i)
-        in_queue.put(end)
+    Every sample is tagged with its position; with ``order=True`` the
+    consumer re-sequences results through a min-heap keyed on that
+    position (workers never wait on each other).  Total in-flight
+    samples — queues, worker hands, and the re-sequencing heap — are
+    bounded by a sliding window of ``2 * buffer_size + process_num``
+    un-yielded samples, enforced at the feeder.  Exceptions from the
+    reader or the mapper re-raise on the consumer side.
+    """
 
-    def order_read_worker(reader, in_queue):
-        for in_order, sample in enumerate(reader()):
-            in_queue.put((in_order, sample))
-        in_queue.put(end)
+    def _read():
+        inq = queue.Queue(maxsize=buffer_size)
+        outq = queue.Queue()     # bounded by the window semaphore
+        window = threading.Semaphore(2 * buffer_size + process_num)
 
-    def handle_worker(in_queue, out_queue, mapper):
-        sample = in_queue.get()
-        while not isinstance(sample, XmapEndSignal):
-            out_queue.put(mapper(sample))
-            sample = in_queue.get()
-        in_queue.put(end)
-        out_queue.put(end)
+        def feed():
+            try:
+                for tagged in enumerate(reader()):
+                    window.acquire()
+                    inq.put(tagged)
+            except BaseException as e:
+                outq.put(_Raised(e))
+            finally:
+                for _ in range(process_num):
+                    inq.put(_STOP)
 
-    def order_handle_worker(in_queue, out_queue, mapper, out_order):
-        ins = in_queue.get()
-        while not isinstance(ins, XmapEndSignal):
-            order, sample = ins
-            result = mapper(sample)
-            while order != out_order[0]:
-                pass
-            out_queue.put(result)
-            out_order[0] += 1
-            ins = in_queue.get()
-        in_queue.put(end)
-        out_queue.put(end)
+        def work():
+            try:
+                while True:
+                    item = inq.get()
+                    if item is _STOP:
+                        return
+                    pos, sample = item
+                    outq.put((pos, mapper(sample)))
+            except BaseException as e:
+                outq.put(_Raised(e))
+            finally:
+                outq.put(_STOP)
 
-    def xreader():
-        in_queue = Queue(buffer_size)
-        out_queue = Queue(buffer_size)
-        out_order = [0]
-        target = order_read_worker if order else read_worker
-        t = Thread(target=target, args=(reader, in_queue))
-        t.daemon = True
-        t.start()
-        target = order_handle_worker if order else handle_worker
-        args = (in_queue, out_queue, mapper, out_order) if order else \
-            (in_queue, out_queue, mapper)
-        workers = []
-        for i in range(process_num):
-            worker = Thread(target=target, args=args)
-            worker.daemon = True
-            workers.append(worker)
-        for w in workers:
-            w.start()
+        for target in [feed] + [work] * process_num:
+            threading.Thread(target=target, daemon=True).start()
 
-        sample = out_queue.get()
-        finish = 1
-        while not isinstance(sample, XmapEndSignal) or finish < process_num:
-            if not isinstance(sample, XmapEndSignal):
-                yield sample
+        def drain():
+            item = outq.get()
+            if isinstance(item, _Raised):
+                raise item.exc
+            return item
+
+        live_workers = process_num
+        if not order:
+            while live_workers:
+                item = drain()
+                if item is _STOP:
+                    live_workers -= 1
+                else:
+                    window.release()
+                    yield item[1]
+            return
+
+        ahead = []              # results that arrived before their turn
+        next_pos = 0
+        while live_workers or ahead:
+            if ahead and ahead[0][0] == next_pos:
+                window.release()
+                yield heapq.heappop(ahead)[1]
+                next_pos += 1
             else:
-                finish += 1
-            sample = out_queue.get()
-    return xreader
+                item = drain()
+                if item is _STOP:
+                    live_workers -= 1
+                else:
+                    heapq.heappush(ahead, item)
+
+    return _read

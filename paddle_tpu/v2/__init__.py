@@ -34,6 +34,7 @@ from paddle_tpu.v2 import optimizer
 from paddle_tpu.v2 import parameters
 from paddle_tpu.v2 import trainer
 from paddle_tpu.v2 import event
+from paddle_tpu.v2 import plot
 from paddle_tpu.v2.minibatch import batch
 from paddle_tpu.v2.inference import infer
 from paddle_tpu import dataset
@@ -41,7 +42,7 @@ from paddle_tpu import reader
 
 __all__ = ["init", "layer", "networks", "optimizer", "parameters",
            "trainer", "event", "batch", "infer", "dataset", "reader",
-           "data_type", "activation", "attr"]
+           "data_type", "activation", "attr", "plot"]
 
 _initialized = False
 

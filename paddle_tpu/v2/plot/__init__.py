@@ -1,0 +1,6 @@
+"""Training-curve plotting over the v2 event stream (reference
+``python/paddle/v2/plot/plot.py:1-82``)."""
+
+from paddle_tpu.v2.plot.plot import Ploter, PlotData
+
+__all__ = ["Ploter", "PlotData"]

@@ -1,0 +1,96 @@
+"""Ploter: live training curves from the v2 trainer's event stream.
+
+Reference ``python/paddle/v2/plot/plot.py:1-82``.  Typical use inside an
+event handler::
+
+    ploter = Ploter("train_cost", "test_cost")
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndIteration):
+            ploter.append("train_cost", event.batch_id, event.cost)
+            ploter.plot()
+
+Plotting is skipped entirely (appends still accumulate) when matplotlib
+is unavailable or ``DISABLE_PLOT=True`` is set — so headless test runs
+and notebook demos share one code path.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["Ploter", "PlotData"]
+
+
+class PlotData:
+    """One named curve: parallel lists of steps and values."""
+
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(float(value))
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+def _load_pyplot():
+    if os.environ.get("DISABLE_PLOT") == "True":
+        return None
+    try:
+        import matplotlib
+        if not os.environ.get("DISPLAY"):
+            matplotlib.use("Agg")  # headless boxes
+        import matplotlib.pyplot as plt
+        return plt
+    except Exception:
+        return None
+
+
+class Ploter:
+    """Multi-curve live plot keyed by title; degrades to a no-op sink
+    when plotting is disabled."""
+
+    def __init__(self, *titles):
+        self._titles = titles
+        self._curves = {t: PlotData() for t in titles}
+        self._plt = _load_pyplot()
+
+    @property
+    def curves(self):
+        return self._curves
+
+    def append(self, title, step, value):
+        self._curves[title].append(step, value)
+
+    def plot(self, path=None):
+        """Redraw all non-empty curves; save to ``path`` when given,
+        else display in place (IPython when available)."""
+        if self._plt is None:
+            return
+        drawn = []
+        for title in self._titles:
+            curve = self._curves[title]
+            if curve.step:
+                self._plt.plot(curve.step, curve.value)
+                drawn.append(title)
+        if drawn:
+            self._plt.legend(drawn, loc="upper left")
+        if path is not None:
+            self._plt.savefig(path)
+        else:
+            try:
+                from IPython import display
+                display.clear_output(wait=True)
+                display.display(self._plt.gcf())
+            except Exception:
+                self._plt.draw()
+        self._plt.gcf().clear()
+
+    def reset(self):
+        for curve in self._curves.values():
+            curve.reset()

@@ -1,0 +1,153 @@
+"""Reader-decorator semantics (paddle_tpu/reader/decorator.py).
+
+Covers the full decorator surface the reference exposes in
+``python/paddle/reader/decorator.py`` (map/shuffle/chain/compose/
+buffered/firstn/cache/xmap), including the threaded paths and the
+ordered-xmap re-sequencing.
+"""
+
+import numpy as np
+
+from paddle_tpu.reader import decorator as dec
+
+
+def r10():
+    return lambda: iter(range(10))
+
+
+class TestPureDecorators:
+    def test_map_readers(self):
+        got = list(dec.map_readers(lambda a, b: a + b, r10(), r10())())
+        assert got == [2 * i for i in range(10)]
+
+    def test_shuffle_preserves_multiset(self):
+        out = list(dec.shuffle(r10(), 4)())
+        assert sorted(out) == list(range(10))
+
+    def test_chain(self):
+        assert list(dec.chain(r10(), r10())()) == list(range(10)) * 2
+
+    def test_compose_aligned(self):
+        got = list(dec.compose(r10(), r10())())
+        assert got[0] == (0, 0) and len(got) == 10
+
+    def test_compose_misaligned_raises(self):
+        short = lambda: iter(range(5))
+        try:
+            list(dec.compose(r10(), short)())
+            raise AssertionError("expected ComposeNotAligned")
+        except dec.ComposeNotAligned:
+            pass
+        # unchecked mode: shortest stream wins
+        got = list(dec.compose(r10(), short, check_alignment=False)())
+        assert len(got) == 5
+
+    def test_firstn(self):
+        assert list(dec.firstn(r10(), 3)()) == [0, 1, 2]
+
+    def test_cache_partial_pass_not_cached(self):
+        calls = [0]
+
+        def counting():
+            calls[0] += 1
+            return iter(range(5))
+
+        c = dec.cache(counting)
+        next(c())  # abandon midway -> must NOT poison the cache
+        assert list(c()) == list(range(5))
+        assert calls[0] == 2
+        assert list(c()) == list(range(5))
+        assert calls[0] == 2  # served from memory
+
+
+class TestThreadedDecorators:
+    def test_buffered(self):
+        assert list(dec.buffered(r10(), 3)()) == list(range(10))
+
+    def test_xmap_unordered_multiset(self):
+        out = sorted(dec.xmap_readers(lambda x: x * 2, r10(), 3, 4)())
+        assert out == [2 * i for i in range(10)]
+
+    def test_xmap_ordered_exact_order(self):
+        out = list(dec.xmap_readers(lambda x: x * 2, r10(), 3, 4,
+                                    order=True)())
+        assert out == [2 * i for i in range(10)]
+
+    def test_xmap_ordered_numpy_payloads(self):
+        # the re-sequencing heap must key on position only — numpy
+        # payloads are not comparable
+        arr_reader = lambda: (np.full((3,), i) for i in range(20))
+        out = list(dec.xmap_readers(lambda x: x + 1, arr_reader, 4, 2,
+                                    order=True)())
+        assert all((o == i + 1).all() for i, o in enumerate(out))
+
+
+class TestV2Plot:
+    def test_ploter_accumulates_and_saves(self, tmp_path):
+        from paddle_tpu.v2.plot import Ploter
+        p = Ploter("train_cost", "test_cost")
+        for i in range(5):
+            p.append("train_cost", i, 1.0 / (i + 1))
+        p.append("test_cost", 0, 0.9)
+        assert p.curves["train_cost"].step == [0, 1, 2, 3, 4]
+        out = tmp_path / "curve.png"
+        p.plot(path=str(out))
+        if p._plt is not None:
+            assert out.exists() and out.stat().st_size > 0
+        p.reset()
+        assert p.curves["train_cost"].step == []
+
+    def test_ploter_disabled_is_noop(self, monkeypatch):
+        monkeypatch.setenv("DISABLE_PLOT", "True")
+        from paddle_tpu.v2.plot.plot import Ploter
+        p = Ploter("c")
+        p.append("c", 0, 1.0)
+        p.plot()  # must not raise without matplotlib state
+
+
+class TestThreadedErrorPropagation:
+    def test_buffered_reraises_producer_exception(self):
+        def bad():
+            yield 1
+            raise IOError("truncated stream")
+
+        it = dec.buffered(lambda: bad(), 4)()
+        assert next(it) == 1
+        try:
+            list(it)
+            raise AssertionError("expected IOError")
+        except IOError:
+            pass
+
+    def test_xmap_reraises_mapper_exception(self):
+        def mapper(x):
+            if x == 5:
+                raise ValueError("boom")
+            return x
+
+        try:
+            list(dec.xmap_readers(mapper, lambda: iter(range(10)),
+                                  2, 2)())
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
+
+    def test_xmap_ordered_reraises_and_does_not_hang(self):
+        def mapper(x):
+            if x == 3:
+                raise ValueError("boom")
+            return x
+
+        try:
+            list(dec.xmap_readers(mapper, lambda: iter(range(10)),
+                                  3, 2, order=True)())
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
+
+    def test_xmap_ordered_long_stream_window(self):
+        # the in-flight window must keep a long ordered stream moving
+        out = list(dec.xmap_readers(lambda x: x * 2,
+                                    lambda: iter(range(500)), 4, 4,
+                                    order=True)())
+        assert out == [i * 2 for i in range(500)]
