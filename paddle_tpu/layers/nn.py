@@ -32,7 +32,8 @@ __all__ = [
     "fused_attention",
     "autoincreased_step_counter", "cos_sim", "dot_product_attention",
     "beam_search", "beam_search_decode", "ring_attention",
-    "conv3d", "warpctc", "ctc_greedy_decoder", "image_resize",
+    "conv3d", "conv3d_transpose", "warpctc", "ctc_greedy_decoder",
+    "image_resize",
 ]
 
 
@@ -1105,6 +1106,48 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0,
     pre_bias = helper.create_tmp_variable(dtype)
     helper.append_op(
         type="conv3d", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={"strides": stride, "paddings": padding,
+               "dilations": dilation, "groups": groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """Transposed 3-D convolution, NCDHW (reference ``nn.py``
+    conv3d_transpose over ``conv_transpose_op.cc:314``); filter layout
+    (C_in, C_out/groups, kd, kh, kw) like conv2d_transpose."""
+    helper = LayerHelper("conv3d_transpose", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    groups = groups or 1
+
+    def _triple(x):
+        return [x, x, x] if isinstance(x, int) else list(x)
+
+    stride, padding, dilation = (_triple(stride), _triple(padding),
+                                 _triple(dilation))
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("output_size or filter_size required")
+        output_size = _triple(output_size)
+        filter_size = [
+            (output_size[i] - (input.shape[2 + i] - 1) * stride[i]
+             + 2 * padding[i] - 1) // dilation[i] + 1
+            for i in range(3)]
+    else:
+        filter_size = _triple(filter_size)
+    filter_shape = [num_channels, num_filters // groups] + filter_size
+    w = helper.create_parameter(helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op(
+        type="conv3d_transpose", inputs={"Input": [input], "Filter": [w]},
         outputs={"Output": [pre_bias]},
         attrs={"strides": stride, "paddings": padding,
                "dilations": dilation, "groups": groups})

@@ -138,9 +138,86 @@ def conv2d_transpose_lower(ctx):
     paddings = ctx.attr("paddings", [0, 0])
     dilations = tuple(ctx.attr("dilations", [1, 1]))
     pad = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
-    out = jax.lax.conv_transpose(
-        x, w, strides=strides, padding=pad, rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"))
+    # The reference deconv is the GRADIENT of a forward conv: scatter-add
+    # out[i*s - p + d*k'] += x[i] * w[k'], with out = (i-1)s - 2p + d(k-1)+1.
+    # In jax that is transpose_kernel=True (flip spatial axes + swap the
+    # kernel's channel roles — hence the forward-conv spec "OIHW" for our
+    # (C_in, C_out, kh, kw) layout) with use_consistent_padding=True
+    # (integer pads read as the forward conv's padding).  The defaults
+    # only coincide when p == d(k-1)/2 and the kernel is symmetric.
+    # conv_transpose has no feature_group_count: grouped deconv runs one
+    # transpose per channel group, concatenated on the channel axis.
+    groups = ctx.attr("groups", 1) or 1
+
+    def one(xg, wg):
+        return jax.lax.conv_transpose(
+            xg, wg, strides=strides, padding=pad, rhs_dilation=dilations,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            transpose_kernel=True, use_consistent_padding=True)
+
+    if groups == 1:
+        out = one(x, w)
+    else:
+        cg = x.shape[1] // groups
+        out = jnp.concatenate(
+            [one(x[:, g * cg:(g + 1) * cg], w[g * cg:(g + 1) * cg])
+             for g in range(groups)], axis=1)
+    ctx.set_output("Output", out)
+
+
+def _infer_conv3d_transpose(op, block):
+    x = block.var(op.input("Input")[0])
+    w = block.var(op.input("Filter")[0])
+    if x.shape is None or w.shape is None:
+        raise ShapeInferenceSkip()
+    s = op.attr("strides", [1, 1, 1])
+    p = op.attr("paddings", [0, 0, 0])
+    d = op.attr("dilations", [1, 1, 1])
+    n = x.shape[0]
+    spatial = x.shape[2:]
+    _, oc = w.shape[0], w.shape[1]  # filter layout (C_in, C_out/groups, ...)
+    ks = w.shape[2:]
+
+    def osize(i, k, st, pd, dl):
+        if i == -1:
+            return -1
+        return (i - 1) * st - 2 * pd + dl * (k - 1) + 1
+
+    out = block.var(op.output("Output")[0])
+    out.shape = (n, oc * (op.attr("groups", 1) or 1)) + tuple(
+        osize(spatial[i], ks[i], s[i], p[i], d[i]) for i in range(3))
+    out.dtype = x.dtype
+
+
+@register_op("conv3d_transpose", infer_shape=_infer_conv3d_transpose,
+             amp_cast=("Input", "Filter"))
+def conv3d_transpose_lower(ctx):
+    """NCDHW transposed 3-D convolution (reference
+    ``conv_transpose_op.cc:314`` registers conv3d_transpose); filter
+    layout (C_in, C_out, kd, kh, kw), same as conv2d_transpose."""
+    x = ctx.input("Input")
+    w = ctx.input("Filter")
+    s = tuple(ctx.attr("strides", [1, 1, 1]))
+    p = ctx.attr("paddings", [0, 0, 0])
+    d = tuple(ctx.attr("dilations", [1, 1, 1]))
+    pad = [(p[i], p[i]) for i in range(3)]
+    # gradient-of-conv semantics + per-group transposes — see
+    # conv2d_transpose_lower
+    groups = ctx.attr("groups", 1) or 1
+
+    def one(xg, wg):
+        return jax.lax.conv_transpose(
+            xg, wg, strides=s, padding=pad, rhs_dilation=d,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            transpose_kernel=True, use_consistent_padding=True)
+
+    if groups == 1:
+        out = one(x, w)
+    else:
+        cg = x.shape[1] // groups
+        out = jnp.concatenate(
+            [one(x[:, g * cg:(g + 1) * cg], w[g * cg:(g + 1) * cg])
+             for g in range(groups)], axis=1)
     ctx.set_output("Output", out)
 
 

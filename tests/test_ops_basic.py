@@ -382,3 +382,108 @@ class TestDropoutInference(OpTest):
 
     def test_output(self):
         self.check_output()
+
+
+def _conv3d_transpose_np(x, w, strides, paddings, dilations):
+    """Naive summation reference for NCDHW transposed conv, filter
+    (C_in, C_out, kd, kh, kw) — mirrors the reference semantics of
+    conv_transpose_op.cc:314 at loop level."""
+    n, ci, di, hi, wi = x.shape
+    _, co, kd, kh, kw = w.shape
+    sd, sh, sw = strides
+    pd, ph, pw = paddings
+    dd, dh, dw = dilations
+    od = (di - 1) * sd - 2 * pd + dd * (kd - 1) + 1
+    oh = (hi - 1) * sh - 2 * ph + dh * (kh - 1) + 1
+    ow = (wi - 1) * sw - 2 * pw + dw * (kw - 1) + 1
+    out = np.zeros((n, co, od + 2 * pd, oh + 2 * ph, ow + 2 * pw),
+                   x.dtype)
+    for b in range(n):
+        for c in range(ci):
+            for z in range(di):
+                for y in range(hi):
+                    for t in range(wi):
+                        patch = np.einsum(
+                            "odhw->odhw",
+                            w[c] * x[b, c, z, y, t])
+                        out[b, :, z * sd:z * sd + dd * (kd - 1) + 1:dd,
+                            y * sh:y * sh + dh * (kh - 1) + 1:dh,
+                            t * sw:t * sw + dw * (kw - 1) + 1:dw] += patch
+    if pd or ph or pw:
+        out = out[:, :, pd:out.shape[2] - pd, ph:out.shape[3] - ph,
+                  pw:out.shape[4] - pw]
+    return out
+
+
+class TestConv3DTranspose(OpTest):
+    op_type = "conv3d_transpose"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(21)
+        x = rng.uniform(-1, 1, (2, 3, 3, 4, 4)).astype("float32")
+        w = rng.uniform(-1, 1, (3, 2, 2, 3, 3)).astype("float32")
+        attrs = {"strides": [2, 2, 2], "paddings": [1, 1, 1],
+                 "dilations": [1, 1, 1], "groups": 1}
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = attrs
+        self.outputs = {"Output": _conv3d_transpose_np(
+            x, w, attrs["strides"], attrs["paddings"],
+            attrs["dilations"])}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.02)
+
+
+class TestConv2DTransposeAsymmetric(OpTest):
+    """k=2, p=1 — the case where transposed-side and forward-side padding
+    interpretations diverge (regression for use_consistent_padding)."""
+    op_type = "conv2d_transpose"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(22)
+        x = rng.uniform(-1, 1, (2, 3, 5, 5)).astype("float32")
+        w = rng.uniform(-1, 1, (3, 4, 2, 2)).astype("float32")
+        attrs = {"strides": [2, 2], "paddings": [1, 1],
+                 "dilations": [1, 1], "groups": 1}
+        want = _conv3d_transpose_np(
+            x[:, :, None], w[:, :, None],
+            [1] + attrs["strides"], [0] + attrs["paddings"],
+            [1] + attrs["dilations"])[:, :, 0]
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = attrs
+        self.outputs = {"Output": want}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.02)
+
+
+class TestConv2DTransposeGrouped(OpTest):
+    """groups=2: conv_transpose runs one per-group deconv, concatenated
+    on channels (jax.lax.conv_transpose has no feature_group_count)."""
+    op_type = "conv2d_transpose"
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(23)
+        x = rng.uniform(-1, 1, (1, 4, 3, 3)).astype("float32")
+        w = rng.uniform(-1, 1, (4, 3, 2, 2)).astype("float32")
+        attrs = {"strides": [2, 2], "paddings": [0, 0],
+                 "dilations": [1, 1], "groups": 2}
+        parts = []
+        for g in range(2):
+            parts.append(_conv3d_transpose_np(
+                x[:, 2 * g:2 * g + 2, None], w[2 * g:2 * g + 2, :, None],
+                [1, 2, 2], [0, 0, 0], [1, 1, 1])[:, :, 0])
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = attrs
+        self.outputs = {"Output": np.concatenate(parts, axis=1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
