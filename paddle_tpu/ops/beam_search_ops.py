@@ -146,3 +146,191 @@ def beam_search_decode_lower(ctx: LowerContext):
     seqs = jnp.moveaxis(toks[::-1], 0, -1)          # [B, K, T]
     ctx.set_output("SentenceIds", seqs)
     ctx.set_output("SentenceScores", jnp.asarray(scores, jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# cross_entropy_over_beam — training against multi-step beam expansions
+# (reference ``paddle/gserver/layers/CrossEntropyOverBeam.cpp:1-393``).
+#
+# E search steps ("expansions"), each a triple:
+#   Scores[i]  candidate scores, a (nested-for-i>0) LoD sequence [N_i, 1];
+#   Ids[i]     [R_i, beam_size] selected within-row candidate ids, -1 pad
+#              (kmax_seq_score output); the rows of expansion i+1
+#              correspond 1:1, in row-major order, with the non-(-1)
+#              slots of Ids[i];
+#   Gold[i]    [batch] the ground-truth candidate id per sequence.
+#
+# Per sequence: follow the gold id through the expansions until it falls
+# off the beam (step t); every complete path through the first t
+# expansions is a candidate, gold is appended as an extra path when it
+# fell off; the cost is softmax cross-entropy over the summed path
+# scores with gold as the hard label.  (Where the reference indexes the
+# parent-candidate matrix by sub-sequence row directly — its own
+# TODO(caoying) admits the -1-padding mismatch — this implementation maps
+# rows through the enumerated non-(-1) slots, which is the layout its
+# test generator produces.)
+# ---------------------------------------------------------------------------
+
+def _beam_cost_one_seq(scores, row_starts, ids, golds, beam_size):
+    """Cost + per-expansion score-gradients for ONE sequence.
+
+    ``scores[i]``: 1-D candidate scores at expansion i; ``row_starts[i]``:
+    offset of each beam row's segment inside ``scores[i]``; ``ids[i]``:
+    [R_i, beam_size] (-1 padded); ``golds[i]``: int gold id.
+    """
+    E = len(scores)
+    gold_rows, gold_col, valid = [], -1, 0
+    for i in range(E):
+        if i:
+            prev_flat = ids[i - 1].reshape(-1)
+            slot = gold_rows[-1] * beam_size + gold_col
+            gold_rows.append(int(np.count_nonzero(prev_flat[:slot] != -1)))
+        else:
+            gold_rows.append(0)
+        valid = i + 1
+        hit = np.nonzero(ids[i][gold_rows[-1]] == golds[i])[0]
+        if hit.size == 0:
+            gold_col = -1
+            break
+        gold_col = int(hit[0])
+    gold_extra = gold_col == -1
+    last = valid - 1
+
+    slots_last = np.argwhere(ids[last] != -1)       # row-major
+    n_paths = len(slots_last) + (1 if gold_extra else 0)
+    path_rows = np.zeros((valid, n_paths), np.int64)
+    parents = [int(r) for r, _ in slots_last]
+    for p, (r, c) in enumerate(slots_last):
+        path_rows[last, p] = ids[last][r, c] + row_starts[last][r]
+    if gold_extra:
+        path_rows[last, -1] = golds[last] + \
+            row_starts[last][gold_rows[last]]
+        parents.append(gold_rows[last])
+        gold_path = n_paths - 1
+    else:
+        flat = ids[last].reshape(-1)
+        goff = gold_rows[last] * beam_size + gold_col
+        gold_path = int(np.count_nonzero(flat[:goff] != -1))
+
+    n_real = len(slots_last)
+    for i in range(last - 1, -1, -1):
+        slots_i = np.argwhere(ids[i] != -1)
+        for p in range(n_real):
+            r, c = slots_i[parents[p]]
+            path_rows[i, p] = ids[i][r, c] + row_starts[i][r]
+            parents[p] = int(r)
+        if gold_extra:
+            path_rows[i, -1] = golds[i] + row_starts[i][gold_rows[i]]
+            parents[-1] = gold_rows[i]
+
+    path_scores = np.zeros(n_paths, np.float64)
+    for i in range(valid):
+        path_scores += scores[i][path_rows[i]]
+    z = path_scores - path_scores.max()
+    p = np.exp(z)
+    p /= p.sum()
+    cost = -float(np.log(max(p[gold_path], 1e-30)))
+
+    dp = p.copy()
+    dp[gold_path] -= 1.0
+    grads = [np.zeros_like(scores[i], dtype=np.float64)
+             for i in range(E)]
+    for i in range(valid):
+        np.add.at(grads[i], path_rows[i], dp)
+    return cost, grads
+
+
+def _ceob_split(ctx):
+    """Slice the batched LoD inputs into per-sequence views; returns
+    (batch, beam_size, per_seq) where per_seq[j] = (scores, row_starts,
+    ids, golds)."""
+    score_names = ctx.op.input("Scores")
+    id_names = ctx.op.input("Ids")
+    gold_names = ctx.op.input("Gold")
+    E = len(score_names)
+    if not (len(id_names) == len(gold_names) == E):
+        raise ValueError("cross_entropy_over_beam wants E (Scores, Ids, "
+                         "Gold) triples")
+    scores = [np.asarray(ctx.env[n], np.float64).reshape(-1)
+              for n in score_names]
+    ids = [np.asarray(ctx.env[n]) for n in id_names]
+    golds = [np.asarray(ctx.env[n]).reshape(-1) for n in gold_names]
+    beam_size = ids[0].shape[1]
+
+    lods = [ctx.aux.get("lod", {}).get(n) for n in score_names]
+    if lods[0] is None:
+        raise ValueError("cross_entropy_over_beam: Scores[0] needs a "
+                         "1-level LoD (one segment per sequence)")
+    starts0 = np.asarray(lods[0][-1] if len(lods[0]) == 1 else lods[0][0])
+    batch = len(starts0) - 1
+
+    per_seq = []
+    for j in range(batch):
+        seq_scores, seq_starts, seq_ids, seq_golds = [], [], [], []
+        for i in range(E):
+            if i == 0:
+                lo, hi = int(starts0[j]), int(starts0[j + 1])
+                seq_scores.append(scores[0][lo:hi])
+                seq_starts.append(np.zeros(1, np.int64))
+                seq_ids.append(ids[0][j:j + 1])
+            else:
+                lod = lods[i]
+                if lod is None or len(lod) < 2:
+                    raise ValueError(
+                        f"cross_entropy_over_beam: Scores[{i}] must be a "
+                        f"2-level nested sequence")
+                outer = np.asarray(lod[0])
+                inner = np.asarray(lod[1])
+                sub_lo, sub_hi = int(outer[j]), int(outer[j + 1])
+                row_lo = int(inner[sub_lo])
+                seq_scores.append(scores[i][row_lo:int(inner[sub_hi])])
+                seq_starts.append(
+                    np.asarray(inner[sub_lo:sub_hi], np.int64) - row_lo)
+                seq_ids.append(ids[i][sub_lo:sub_hi])
+            seq_golds.append(int(golds[i][j]))
+        per_seq.append((seq_scores, seq_starts, seq_ids, seq_golds))
+    return batch, beam_size, per_seq, score_names
+
+
+def _ceob_grad_maker(op, block, no_grad_set):
+    from paddle_tpu.framework import grad_var_name
+    score_names = op.input("Scores")
+    g_scores = [grad_var_name(n) for n in score_names]
+    desc = {"type": "cross_entropy_over_beam_grad",
+            "inputs": {"Scores": list(score_names),
+                       "Ids": list(op.input("Ids")),
+                       "Gold": list(op.input("Gold")),
+                       "Out@GRAD": [grad_var_name(op.output("Out")[0])]},
+            "outputs": {"Scores@GRAD": g_scores},
+            "attrs": dict(op.attrs)}
+    return [desc], dict(zip(score_names, g_scores))
+
+
+@register_op("cross_entropy_over_beam", host=True,
+             grad_maker=_ceob_grad_maker)
+def cross_entropy_over_beam_lower(ctx: LowerContext):
+    batch, beam_size, per_seq, _ = _ceob_split(ctx)
+    costs = np.zeros((batch, 1), np.float32)
+    for j, (s, st, i_, g) in enumerate(per_seq):
+        costs[j, 0], _ = _beam_cost_one_seq(s, st, i_, g, beam_size)
+    ctx.set_output("Out", jnp.asarray(costs))
+
+
+@register_op("cross_entropy_over_beam_grad", no_gradient=True, host=True)
+def cross_entropy_over_beam_grad_lower(ctx: LowerContext):
+    batch, beam_size, per_seq, score_names = _ceob_split(ctx)
+    g_out = np.asarray(ctx.env[ctx.op.input("Out@GRAD")[0]],
+                       np.float64).reshape(-1)
+    full = [np.zeros(np.asarray(ctx.env[n]).reshape(-1).shape, np.float64)
+            for n in score_names]
+    offs = [0] * len(score_names)
+    for j, (s, st, i_, g) in enumerate(per_seq):
+        _, grads = _beam_cost_one_seq(s, st, i_, g, beam_size)
+        for i, gr in enumerate(grads):
+            full[i][offs[i]:offs[i] + len(gr)] += gr * g_out[j]
+            offs[i] += len(gr)
+    for name, gname, arr in zip(score_names,
+                                ctx.op.output("Scores@GRAD"), full):
+        shape = np.asarray(ctx.env[name]).shape
+        ctx.outputs[gname] = jnp.asarray(
+            arr.reshape(shape).astype(np.float32))

@@ -41,6 +41,7 @@ __all__ = [
     "pooling_layer", "last_seq", "first_seq", "expand_layer",
     "repeat_layer", "seq_reshape_layer", "seq_slice_layer",
     "sub_seq_layer", "sub_nested_seq_layer", "kmax_seq_score_layer",
+    "cross_entropy_over_beam", "BeamInput",
     "ctc_layer", "warp_ctc_layer",
     # elementwise / math
     "addto_layer", "interpolation_layer", "bilinear_interp_layer",
@@ -1479,3 +1480,38 @@ def multibox_loss_layer(input_loc, input_conf, priorbox, label, num_classes,
                      neg_pos_ratio=neg_pos_ratio,
                      neg_overlap=neg_overlap)
     return _named(F.mean(out), name)
+
+
+class BeamInput:
+    """One beam-expansion triple for :func:`cross_entropy_over_beam`
+    (reference ``layers.py`` BeamInput)."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+def cross_entropy_over_beam(input, name=None):
+    """Cross entropy over multi-step beam expansions — the
+    learning-to-search criterion (reference ``layers.py`` over
+    ``CrossEntropyOverBeam.cpp:1-393``).  ``input`` is one
+    :class:`BeamInput` or a list of them, one per search step; returns
+    the per-sequence cost [batch, 1] (wrap with sum_cost / mean to
+    scalarize).  Gradients flow into every ``candidate_scores`` input."""
+    if isinstance(input, BeamInput):
+        input = [input]
+    for beam in input:
+        if not isinstance(beam, BeamInput):
+            raise TypeError("cross_entropy_over_beam wants BeamInput "
+                            "objects")
+    from paddle_tpu.layer_helper import LayerHelper
+    helper = LayerHelper("cross_entropy_over_beam", name=name)
+    out = helper.create_tmp_variable(dtype="float32")
+    helper.append_op(
+        type="cross_entropy_over_beam",
+        inputs={"Scores": [b.candidate_scores for b in input],
+                "Ids": [b.selected_candidates for b in input],
+                "Gold": [b.gold for b in input]},
+        outputs={"Out": [out]}, attrs={})
+    return _named(out, name)

@@ -265,3 +265,131 @@ def test_mt_beam_decode_nondegenerate():
     assert acc > 0.6, (acc, top[:2], want[:2])
     # beams come back best-first
     assert np.all(np.diff(scores, axis=1) <= 1e-5)
+
+
+class TestCrossEntropyOverBeam:
+    """Training criterion over beam expansions (reference
+    CrossEntropyOverBeam.cpp:1-393)."""
+
+    def _run_cost(self, feeds, n_expansions, lod_levels, fetch_grads=()):
+        import paddle_tpu.trainer_config_helpers as tch
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            beams = []
+            for i in range(n_expansions):
+                sc = layers.data(f"sc{i}", shape=[-1, 1], dtype="float32",
+                                 append_batch_size=False,
+                                 lod_level=lod_levels[i])
+                sc.stop_gradient = False
+                ids = layers.data(f"ids{i}", shape=[-1, -1], dtype="int64",
+                                  append_batch_size=False)
+                gold = layers.data(f"g{i}", shape=[-1], dtype="int64",
+                                   append_batch_size=False)
+                beams.append(tch.BeamInput(sc, ids, gold))
+            cost = tch.cross_entropy_over_beam(beams)
+            loss = layers.reduce_sum(cost)
+            fluid.append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        outs = exe.run(main, feed=feeds,
+                       fetch_list=[cost.name] + list(fetch_grads))
+        return [np.asarray(o) for o in outs]
+
+    def test_single_expansion_is_softmax_ce_over_candidates(self):
+        # one sequence, 4 candidates, beam picks ids [2, 0]; gold id 2.
+        # paths = the selected candidates; cost = -log softmax over their
+        # scores at gold's slot
+        sc = np.array([[0.1], [0.9], [0.4], [0.3]], "float32")
+        feeds = {"sc0": (sc, [[0, 4]]),
+                 "ids0": np.array([[2, 0]], "int64"),
+                 "g0": np.array([2], "int64")}
+        (cost,) = self._run_cost(feeds, 1, [1])
+        z = np.array([0.4, 0.1])  # scores of selected ids 2, 0
+        want = -np.log(np.exp(z[0]) / np.exp(z).sum())
+        np.testing.assert_allclose(cost.reshape(()), want, rtol=1e-5)
+
+    def test_gold_off_beam_becomes_extra_path(self):
+        # gold id 3 NOT among selected [2, 0] -> appended as extra path
+        sc = np.array([[0.1], [0.9], [0.4], [0.3]], "float32")
+        feeds = {"sc0": (sc, [[0, 4]]),
+                 "ids0": np.array([[2, 0]], "int64"),
+                 "g0": np.array([3], "int64")}
+        (cost,) = self._run_cost(feeds, 1, [1])
+        z = np.array([0.4, 0.1, 0.3])  # selected + appended gold
+        want = -np.log(np.exp(z[2]) / np.exp(z).sum())
+        np.testing.assert_allclose(cost.reshape(()), want, rtol=1e-5)
+
+    def test_two_expansions_path_scores(self):
+        # seq with 3 first-step candidates, beam_size 2 selects [1, 0];
+        # expansion 1: one sub-seq per selected candidate (2 sub-seqs,
+        # 2 candidates each); second beam selects [0, 1] from gold row.
+        # gold path: step0 id 1 (row select), step1 id 0.
+        sc0 = np.array([[0.5], [1.0], [0.2]], "float32")
+        ids0 = np.array([[1, 0]], "int64")
+        g0 = np.array([1], "int64")
+        # 2 sub-seqs, rows: [a0 a1 | b0 b1]
+        sc1 = np.array([[0.3], [0.7], [0.9], [0.1]], "float32")
+        ids1 = np.array([[0, 1], [1, -1]], "int64")  # per sub-seq picks
+        g1 = np.array([0], "int64")
+        feeds = {"sc0": (sc0, [[0, 3]]),
+                 "ids0": ids0, "g0": g0,
+                 "sc1": (sc1, [[0, 2], [0, 2, 4]]),
+                 "ids1": ids1, "g1": g1}
+        (cost,) = self._run_cost(feeds, 2, [1, 2])
+        # paths (slots of ids1 row-major): (row0,id0)=1.0+0.3,
+        # (row0,id1)=1.0+0.7, (row1,id1)=0.5+0.1; gold = first
+        z = np.array([1.3, 1.7, 0.6])
+        want = -np.log(np.exp(z[0]) / np.exp(z).sum())
+        np.testing.assert_allclose(cost.reshape(()), want, rtol=1e-5)
+
+    def test_gradients_numeric(self):
+        # central differences on every candidate score, single expansion
+        sc = np.array([[0.1], [0.9], [0.4], [0.3]], "float32")
+        feeds = {"sc0": (sc, [[0, 4]]),
+                 "ids0": np.array([[2, 0, 1]], "int64"),
+                 "g0": np.array([0], "int64")}
+        cost, grad = self._run_cost(feeds, 1, [1],
+                                    fetch_grads=["sc0@GRAD"])
+        eps = 1e-3
+        for r in range(4):
+            up, dn = sc.copy(), sc.copy()
+            up[r, 0] += eps
+            dn[r, 0] -= eps
+            cu = self._run_cost({**feeds, "sc0": (up, [[0, 4]])},
+                                1, [1])[0]
+            cd = self._run_cost({**feeds, "sc0": (dn, [[0, 4]])},
+                                1, [1])[0]
+            num = (cu.sum() - cd.sum()) / (2 * eps)
+            np.testing.assert_allclose(grad[r, 0], num, atol=1e-3)
+
+    def test_trains_through_kmax_selection(self):
+        """A legacy-DSL config: network scores -> kmax_seq_score beam ->
+        cross_entropy_over_beam; the gold candidate's score must rise."""
+        import paddle_tpu.trainer_config_helpers as tch
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[-1, 4], dtype="float32",
+                            append_batch_size=False, lod_level=1)
+            h = layers.fc(x, 1, bias_attr=False,
+                          param_attr=fluid.ParamAttr("ceob_w"))
+            h.lod_level = 1
+            sel = tch.kmax_seq_score_layer(h, beam_size=3)
+            gold = layers.data("gold", shape=[-1], dtype="int64",
+                               append_batch_size=False)
+            cost = tch.cross_entropy_over_beam(
+                tch.BeamInput(candidate_scores=h,
+                              selected_candidates=sel, gold=gold))
+            loss = layers.reduce_sum(cost)
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xv = rng.rand(6, 4).astype("f")
+        lod = [[0, 3, 6]]
+        gv = np.array([1, 2], "int64")
+        losses = []
+        for _ in range(25):
+            (lv,) = exe.run(main, feed={"x": (xv, lod), "gold": gv},
+                            fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(())))
+        assert losses[-1] < losses[0] * 0.7, losses
