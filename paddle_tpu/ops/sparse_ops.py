@@ -150,34 +150,48 @@ _lookup("nce").grad_lower = _nce_grad_lower
 # the reference registers both as CPU kernels for pserver sharding)
 # ---------------------------------------------------------------------------
 
-@register_op("split_ids", no_gradient=True, host=True)
+@register_op("split_ids", no_gradient=True)
 def split_ids_lower(ctx):
-    """Partition ids by ``id % num_shards`` (reference split_ids_op.cc)."""
-    ids = np.asarray(ctx.input("Ids")).reshape(-1)
+    """Partition ids by ``id % num_shards`` (reference split_ids_op.cc).
+
+    TPU-traced (fully compiled, no host cliff): every shard output keeps
+    the STATIC input length [N, 1], with out-of-shard slots = -1 — the
+    same padding convention as kmax_seq_score.  The reference's CPU
+    kernel emits exact-length lists instead; with static shapes the
+    padded form is the whole-block-compilable equivalent.
+    """
+    ids = ctx.input("Ids").reshape(-1)
     out_names = ctx.op.output("Out")
     n_shard = len(out_names)
     for i, name in enumerate(out_names):
-        part = ids[ids % n_shard == i]
-        ctx.outputs[name] = jnp.asarray(part.reshape(-1, 1))
+        mask = (ids % n_shard) == i
+        ctx.outputs[name] = jnp.where(mask, ids, -1).reshape(-1, 1)
 
 
-@register_op("split_selected_rows", no_gradient=True, host=True,
+@register_op("split_selected_rows", no_gradient=True,
              selected_rows_inputs=("X",))
 def split_selected_rows_lower(ctx):
     """Split rows into height sections (reference
     split_selected_rows_op.cc); each output is a SelectedRows whose row
-    indices are local to its section."""
+    indices are local to its section.
+
+    TPU-traced: each output keeps all N (row, value) pairs; rows outside
+    the section map to the section height — an out-of-range index that
+    every scatter consumer (``to_dense``, sparse optimizer updates)
+    drops, which is jax's default OOB-scatter semantics.  Static shapes,
+    no host round-trip.
+    """
     x = ctx.input("X")
     sections = ctx.attr("height_sections")
     out_names = ctx.op.output("Out")
     if not is_selected_rows(x):
         x = SelectedRows(jnp.arange(x.shape[0], dtype=jnp.int32), x,
                          x.shape[0])
-    rows = np.asarray(x.rows)
-    vals = np.asarray(x.value)
+    rows = x.rows
     offset = 0
     for name, h in zip(out_names, sections):
-        m = (rows >= offset) & (rows < offset + h)
-        ctx.outputs[name] = SelectedRows(
-            jnp.asarray(rows[m] - offset), jnp.asarray(vals[m]), int(h))
+        in_sec = (rows >= offset) & (rows < offset + h)
+        local = jnp.where(in_sec, rows - offset, h)
+        ctx.outputs[name] = SelectedRows(local.astype(jnp.int32),
+                                         x.value, int(h))
         offset += h

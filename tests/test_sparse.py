@@ -164,9 +164,15 @@ class TestSplitIds:
         exe = fluid.Executor()
         got = exe.run(fluid.default_main_program(), feed={"ids": ids},
                       fetch_list=outs)
-        assert sorted(np.asarray(got[0]).reshape(-1).tolist()) == [0, 3, 9]
-        assert sorted(np.asarray(got[1]).reshape(-1).tolist()) == [4, 7]
-        assert sorted(np.asarray(got[2]).reshape(-1).tolist()) == [2]
+        # traced lowering keeps static [N, 1] shapes with -1 padding in
+        # out-of-shard slots (kmax_seq_score convention)
+        def shard(i):
+            flat = np.asarray(got[i]).reshape(-1)
+            return sorted(flat[flat >= 0].tolist())
+
+        assert shard(0) == [0, 3, 9]
+        assert shard(1) == [4, 7]
+        assert shard(2) == [2]
 
 
 class TestSplitSelectedRows:
