@@ -1,0 +1,396 @@
+"""IR-level pipeline partitioning: split a Program's op list into P
+balanced stages and run it as a GPipe pipeline (VERDICT r3 item 3 —
+completes ``parallel/pipeline.py``'s primitive into a framework feature).
+
+The reference has no pipeline parallelism; SURVEY.md §2.8 names PP as a
+beyond-reference row.  Design:
+
+* ``split_program``: walk the global block's ops in program order,
+  weight them with the same analytic FLOP model the benchmarks use
+  (conv/matmul dominate), and cut at the P-quantiles of cumulative
+  cost.  Any cut is legal: everything produced before the cut and
+  consumed after it becomes part of the boundary *carrier*.
+* Stages are NON-homogeneous (different ops, params, shapes).  Each
+  stage's parameters are flat-packed into one f32 vector; the P vectors
+  are padded to a common length and stacked [P, Lp] — sharded over the
+  ``pipe`` mesh axis, so each device stores only its own stage's
+  weights.  Inside ``shard_map`` a ``lax.switch`` on the device's stage
+  index unpacks its slice and runs its stage's traced IR ops.
+* Activations/feeds cross boundaries the same way: a flat f32 carrier
+  of uniform (max-boundary) length.  Integer feeds ride the carrier as
+  exact f32 (vocab ids < 2^24).
+* Microbatches feed STAGE 0 ONLY (the refinement pipeline.py:70-73
+  names): the [M, L0] ingest tensor is sharded over ``pipe`` in
+  contiguous blocks of B = M/P; after every B ticks the local blocks
+  rotate one hop toward stage 0 on the ICI ring, arriving exactly when
+  stage 0 needs them — devices never hold the full microbatch set.
+* The whole schedule is differentiable: ``jax.grad`` w.r.t. the packed
+  [P, Lp] buffer yields the reverse pipeline, and ``unpack_grads``
+  scatters it back to named parameters (parameters used by several
+  stages get their contributions summed).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework import Parameter
+
+try:
+    from jax import shard_map
+    _SM_CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+    _SM_CHECK_KW = "check_rep"
+
+__all__ = ["pipeline_transpiler", "PipelinedProgram"]
+
+_SKIP = ("feed", "fetch")
+
+
+def _op_cost(op, block):
+    """Analytic op weight (same accounting as bench_resnet/bench.py)."""
+    try:
+        if op.type in ("conv2d", "depthwise_conv2d"):
+            filt = block.var(op.input("Filter")[0])
+            out = block.var(op.output("Output")[0])
+            co, ci, kh, kw = filt.shape
+            n, _, ho, wo = out.shape
+            return 2 * n * ho * wo * co * ci * kh * kw
+        if op.type in ("mul", "matmul"):
+            x = block.var(op.input("X")[0])
+            y = block.var(op.input("Y")[0])
+            k, n = y.shape[-2], y.shape[-1]
+            m = int(np.prod([d for d in x.shape if d and d > 0])) // max(
+                int(k), 1)
+            return 2 * m * int(k) * int(n)
+        if op.type == "scaled_dot_product_attention":
+            q = block.var(op.input("Q")[0])
+            b, h, s, d = q.shape
+            return 4 * b * h * s * s * d
+    except Exception:
+        pass
+    return 1
+
+
+def _all_input_names(op):
+    return [n for vs in op.inputs.values() for n in vs]
+
+
+def _all_output_names(op):
+    return [n for vs in op.outputs.values() for n in vs]
+
+
+def split_program(program, n_stages, feed_names, fetch_names):
+    """Balanced cut points + per-stage op/param/boundary metadata."""
+    block = program.global_block()
+    ops = [op for op in block.ops if op.type not in _SKIP]
+    for op in ops:
+        for a in op.attrs.values():
+            if a.__class__.__name__ == "Block":
+                raise ValueError(
+                    f"pipeline_transpiler: op {op.type!r} carries a "
+                    f"sub-block; control flow inside a pipelined program "
+                    f"is not supported — pipeline the flat region only")
+
+    costs = [_op_cost(op, block) for op in ops]
+    total = float(sum(costs))
+    # cut after reaching each quantile of cumulative cost
+    cuts, acc, next_q = [], 0.0, 1
+    for i, c in enumerate(costs):
+        acc += c
+        if next_q < n_stages and acc >= total * next_q / n_stages:
+            cuts.append(i + 1)
+            next_q += 1
+    while len(cuts) < n_stages - 1:   # degenerate tails
+        cuts.append(len(ops))
+    stage_ops = []
+    lo = 0
+    for cut in cuts + [len(ops)]:
+        stage_ops.append(ops[lo:cut])
+        lo = cut
+
+    def is_param(name):
+        v = block.var(name) if name in block.vars else None
+        return v is not None and (isinstance(v, Parameter)
+                                  or getattr(v, "persistable", False))
+
+    produced_by = {}
+    for s, sops in enumerate(stage_ops):
+        for op in sops:
+            for n in _all_output_names(op):
+                produced_by.setdefault(n, s)
+
+    stage_params = []
+    for sops in stage_ops:
+        names = []
+        for op in sops:
+            for n in _all_input_names(op):
+                if is_param(n) and n not in names:
+                    names.append(n)
+        stage_params.append(names)
+
+    # boundary b carries everything still needed past it and produced
+    # before it: inputs of stage >= b ops, plus fetch targets already
+    # produced (they must ride through to the final boundary); feeds
+    # count as produced before stage 0
+    feed_set = set(feed_names)
+    boundaries = []
+    for b in range(n_stages + 1):
+        need = set()
+        for n in fetch_names:
+            src = produced_by.get(n)
+            if b == n_stages or (src is not None and src < b):
+                need.add(n)
+        for s in range(b, n_stages):
+            for op in stage_ops[s]:
+                for n in _all_input_names(op):
+                    if is_param(n):
+                        continue
+                    src = produced_by.get(n)
+                    if (src is None and n in feed_set) or \
+                            (src is not None and src < b):
+                        need.add(n)
+        boundaries.append(sorted(need))
+    return block, stage_ops, stage_params, boundaries
+
+
+class _Layout:
+    """Flat-packing layout for a list of named tensors."""
+
+    def __init__(self, names, shapes, dtypes):
+        self.names = list(names)
+        self.shapes = [tuple(s) for s in shapes]
+        self.dtypes = list(dtypes)
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.offsets = np.cumsum([0] + self.sizes).tolist()
+        self.length = self.offsets[-1]
+
+    def pack(self, values):
+        flats = [jnp.ravel(values[n]).astype(jnp.float32)
+                 for n in self.names]
+        if not flats:
+            return jnp.zeros((0,), jnp.float32)
+        return jnp.concatenate(flats)
+
+    def unpack(self, vec):
+        out = {}
+        for n, shape, dtype, off, size in zip(
+                self.names, self.shapes, self.dtypes, self.offsets,
+                self.sizes):
+            out[n] = jax.lax.slice(vec, (off,), (off + size,)) \
+                .reshape(shape).astype(dtype)
+        return out
+
+
+class PipelinedProgram:
+    """A Program split into P pipeline stages; call :meth:`run` (or
+    differentiate :meth:`loss_fn`) with per-microbatch feeds."""
+
+    def __init__(self, program, n_stages, feed_names, fetch_names, mesh,
+                 axis="pipe"):
+        from paddle_tpu.ops import registry as _registry
+        self._registry = _registry
+        self.mesh = mesh
+        self.axis = axis
+        self.n_stages = n_stages
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        (self.block, self.stage_ops, self.stage_param_names,
+         self.boundaries) = split_program(program, n_stages, feed_names,
+                                          fetch_names)
+        for sops in self.stage_ops:
+            for op in sops:
+                opdef = _registry.lookup(op.type)
+                if opdef is not None and opdef.uses_rng:
+                    raise ValueError(
+                        f"pipeline_transpiler: op {op.type!r} uses the "
+                        f"rng stream; run with dropout/sampling disabled "
+                        f"in the pipelined region")
+
+    # -- layouts (need var shapes; resolved against scope values) -------
+    def _var_meta(self, name, scope_vals):
+        v = self.block.var(name) if name in self.block.vars else None
+        if name in scope_vals:
+            arr = np.asarray(scope_vals[name])
+            return arr.shape, arr.dtype
+        if v is None or v.shape is None:
+            raise ValueError(f"pipeline_transpiler: no shape for {name!r}")
+        shape = tuple(int(d) for d in v.shape)
+        return shape, np.dtype(v.dtype if v.dtype != "bfloat16"
+                               else np.float32)
+
+    def build(self, scope, microbatch_feeds):
+        """Finalize layouts from the startup-initialized ``scope`` and a
+        SAMPLE microbatch feed dict (fixes the microbatch shapes)."""
+        sample = {k: np.asarray(v) for k, v in microbatch_feeds.items()}
+        self._param_layouts = []
+        self._param_values = []
+        for names in self.stage_param_names:
+            vals = {n: np.asarray(scope.find_var(n)) for n in names}
+            lay = _Layout(names, [vals[n].shape for n in names],
+                          [vals[n].dtype for n in names])
+            self._param_layouts.append(lay)
+            self._param_values.append(vals)
+
+        self._carrier_layouts = []
+        for b, names in enumerate(self.boundaries):
+            shapes, dtypes = [], []
+            for n in names:
+                if n in sample:
+                    shapes.append(sample[n].shape)
+                    dtypes.append(sample[n].dtype)
+                else:
+                    s, d = self._var_meta(n, {})
+                    shapes.append(s)
+                    dtypes.append(d)
+            self._carrier_layouts.append(_Layout(names, shapes, dtypes))
+        self.carrier_len = max(l.length for l in self._carrier_layouts)
+        self.param_len = max((l.length for l in self._param_layouts),
+                             default=0)
+        # packed parameter buffer [P, Lp]
+        rows = []
+        for lay, vals in zip(self._param_layouts, self._param_values):
+            vec = np.zeros(self.param_len, np.float32)
+            flat = np.concatenate(
+                [np.asarray(vals[n], np.float32).ravel()
+                 for n in lay.names]) if lay.names else \
+                np.zeros(0, np.float32)
+            vec[:flat.size] = flat
+            rows.append(vec)
+        self.packed_params = jnp.asarray(np.stack(rows))
+        return self
+
+    def pack_microbatch(self, feed):
+        lay = self._carrier_layouts[0]
+        vec = lay.pack({k: jnp.asarray(v) for k, v in feed.items()})
+        pad = self.carrier_len - lay.length
+        return jnp.pad(vec, (0, pad)) if pad else vec
+
+    def unpack_outputs(self, vec):
+        lay = self._carrier_layouts[-1]
+        return lay.unpack(vec[:lay.length])
+
+    def unpack_grads(self, packed_grads):
+        """[P, Lp] grads -> {param_name: grad} (multi-stage placements
+        summed)."""
+        out = {}
+        g = np.asarray(packed_grads)
+        for s, lay in enumerate(self._param_layouts):
+            vals = lay.unpack(jnp.asarray(g[s][:lay.length]))
+            for n, v in vals.items():
+                out[n] = out.get(n, 0) + np.asarray(v, np.float64)
+        return out
+
+    # -- stage functions ------------------------------------------------
+    def _stage_branch(self, s):
+        """carrier [L] -> carrier [L] for stage ``s``, given its packed
+        param vector; traced IR ops via the op registry."""
+        in_lay = self._carrier_layouts[s]
+        out_lay = self._carrier_layouts[s + 1]
+        p_lay = self._param_layouts[s]
+        ops = self.stage_ops[s]
+        registry = self._registry
+        block = self.block
+
+        def branch(pvec, carrier):
+            env = p_lay.unpack(pvec[:p_lay.length] if p_lay.length
+                               else pvec[:0])
+            env.update(in_lay.unpack(carrier[:in_lay.length]))
+            aux = {"rng_counter": 0, "amp": False, "interpret": False,
+                   "lod": {}, "block": block}
+            for op in ops:
+                opdef = registry.resolve_lowering(op.type)
+                ctx = registry.LowerContext(op, env, block, rng_key=None,
+                                            training=True, aux=aux)
+                opdef.lower(ctx)
+                env.update(ctx.outputs)
+            out = out_lay.pack(env)
+            pad = self.carrier_len - out_lay.length
+            return jnp.pad(out, (0, pad)) if pad else out
+
+        return branch
+
+    # -- the pipelined schedule ----------------------------------------
+    def run_fn(self):
+        """Returns ``fn(packed_params [P, Lp], xs [M, L]) -> [M, L]``
+        (final-boundary carriers per microbatch), jit/grad-able."""
+        P = self.n_stages
+        axis = self.axis
+        mesh = self.mesh
+        branches = [self._stage_branch(s) for s in range(P)]
+        L = self.carrier_len
+
+        def per_device(params_local, xs_local):
+            my_stage = jax.lax.axis_index(axis)
+            pvec = params_local[0]
+            B = xs_local.shape[0]          # M / P ingest block
+            M = B * P
+            n_ticks = M + P - 1
+            outer = math.ceil(n_ticks / B)
+            perm_fwd = [(i, (i + 1) % P) for i in range(P)]
+            perm_ingest = [((i + 1) % P, i) for i in range(P)]
+
+            def run_stage(carrier):
+                return jax.lax.switch(
+                    my_stage, [lambda c, b=b: b(pvec, c)
+                               for b in branches], carrier)
+
+            def tick(t, state):
+                buf, received, outputs = state
+                mb_idx = t - my_stage
+                active = (mb_idx >= 0) & (mb_idx < M)
+                fresh = jax.lax.dynamic_index_in_dim(
+                    buf, jnp.mod(t, B), axis=0, keepdims=False)
+                inp = jnp.where(my_stage == 0, fresh, received)
+                # double-where: bubble ticks must not FEED garbage into
+                # the stage — a zero carrier can produce inf/nan (e.g. a
+                # loss normalizer dividing by a zero token count) whose
+                # cotangent poisons the masked output's gradient
+                inp = jnp.where(active, inp, jnp.ones_like(inp))
+                out = run_stage(inp)
+                out = jnp.where(active, out, jnp.zeros_like(out))
+                outputs = jax.lax.cond(
+                    active & (my_stage == P - 1),
+                    lambda o: jax.lax.dynamic_update_index_in_dim(
+                        o, out, jnp.clip(mb_idx, 0, M - 1), axis=0),
+                    lambda o: o, outputs)
+                received = jax.lax.ppermute(out, axis, perm_fwd)
+                return buf, received, outputs
+
+            received = jnp.zeros((L,), jnp.float32)
+            outputs = jnp.zeros((M, L), jnp.float32)
+            buf = xs_local
+            t0 = 0
+            for _ in range(outer):
+                def inner(i, state, t0=t0):
+                    return tick(t0 + i, state)
+                buf, received, outputs = jax.lax.fori_loop(
+                    0, B, inner, (buf, received, outputs))
+                # rotate ingest blocks one hop toward stage 0: after k
+                # rotations device 0 holds block k, exactly when ticks
+                # [kB, (k+1)B) consume it
+                buf = jax.lax.ppermute(buf, axis, perm_ingest)
+                t0 += B
+            return jax.lax.psum(outputs, axis)
+
+        from jax.sharding import PartitionSpec as PS
+        fn = shard_map(per_device, mesh=mesh,
+                       in_specs=(PS(axis), PS(axis)), out_specs=PS(),
+                       **{_SM_CHECK_KW: False})
+        return fn
+
+
+def pipeline_transpiler(program, n_stages, feed_names, fetch_names,
+                        mesh, axis="pipe"):
+    """Split ``program`` into ``n_stages`` balanced pipeline stages.
+
+    Returns a :class:`PipelinedProgram`; call ``.build(scope,
+    sample_microbatch)`` after running the startup program, then
+    ``.run_fn()`` for the differentiable pipelined step."""
+    return PipelinedProgram(program, n_stages, feed_names, fetch_names,
+                            mesh, axis)

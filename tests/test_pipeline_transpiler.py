@@ -1,0 +1,119 @@
+"""IR-level pipeline partitioning (parallel/pipeline_transpiler.py):
+a REAL transformer Program split into 4 balanced stages, run as a GPipe
+pipeline on a 4-device 'pipe' mesh, with loss and parameter-gradient
+equality against the unsplit program (VERDICT r3 item 3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models import transformer as T
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.pipeline_transpiler import pipeline_transpiler
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs 4 virtual devices")
+
+P_STAGES, M_MB, MB, SEQ = 4, 4, 4, 8
+
+
+def _tiny_hp():
+    hp = T.ModelHyperParams()
+    hp.d_model, hp.d_inner_hid, hp.n_layer = 32, 64, 2
+    hp.n_head, hp.d_key, hp.d_value = 2, 16, 16
+    hp.src_vocab_size = hp.trg_vocab_size = 64
+    hp.max_length = SEQ * 2
+    hp.dropout = 0.0
+    return hp
+
+
+class TestPipelineTranspiler:
+    def _build(self):
+        hp = _tiny_hp()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            avg_cost, feeds = T.transformer(MB, SEQ, SEQ, hp)
+        return hp, main, startup, avg_cost, list(feeds)
+
+    def test_split_is_balanced_and_covering(self):
+        _, main, _, avg_cost, feed_names = self._build()
+        from paddle_tpu.parallel.pipeline_transpiler import split_program
+        block, stage_ops, stage_params, boundaries = split_program(
+            main, P_STAGES, feed_names, [avg_cost.name])
+        n_ops = sum(len(s) for s in stage_ops)
+        assert n_ops == sum(1 for op in block.ops
+                            if op.type not in ("feed", "fetch"))
+        assert all(len(s) > 0 for s in stage_ops), \
+            [len(s) for s in stage_ops]
+        # every boundary is a (possibly empty) cut through live values;
+        # the first carries only feeds, the last only the fetch targets
+        assert set(boundaries[0]) <= set(feed_names)
+        assert boundaries[-1] == [avg_cost.name]
+
+    def test_pipelined_loss_and_grads_match_unsplit_program(self):
+        hp, main, startup, avg_cost, feed_names = self._build()
+        mesh = make_mesh((P_STAGES,), ("pipe",),
+                         devices=jax.devices()[:P_STAGES])
+        scope = fluid.Scope()
+        rng_batches = [T.fake_batch(MB, SEQ, SEQ, hp, seed=97 + i)
+                       for i in range(M_MB)]
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+
+            pt = pipeline_transpiler(main, P_STAGES, feed_names,
+                                     [avg_cost.name], mesh)
+            pt.build(scope, rng_batches[0])
+            xs = jnp.stack([pt.pack_microbatch(b) for b in rng_batches])
+            run = jax.jit(pt.run_fn())
+
+            outs = run(pt.packed_params, xs)     # [M, L]
+            pp_losses = [float(pt.unpack_outputs(outs[i])[avg_cost.name]
+                               .reshape(()))
+                         for i in range(M_MB)]
+
+            # unsplit reference: one executor run per microbatch
+            want_losses = []
+            for b in rng_batches:
+                (lv,) = exe.run(main, feed=b, fetch_list=[avg_cost.name])
+                want_losses.append(float(np.asarray(lv).reshape(())))
+        np.testing.assert_allclose(pp_losses, want_losses, rtol=2e-4,
+                                   atol=1e-5)
+
+        # gradient equality: d sum_mb(loss_mb) / d params
+        slot_lay = pt._carrier_layouts[-1]
+        off = slot_lay.offsets[slot_lay.names.index(avg_cost.name)]
+
+        def total_loss(packed):
+            outs = run(packed, xs)
+            return jnp.sum(outs[:, off])
+
+        g_packed = jax.grad(total_loss)(pt.packed_params)
+        got = pt.unpack_grads(g_packed)
+
+        grad_main = main.clone()
+        with fluid.program_guard(grad_main):
+            cost_var = grad_main.global_block().var(avg_cost.name)
+            fluid.append_backward(cost_var)
+        param_names = sorted({n for names in pt.stage_param_names
+                              for n in names
+                              if grad_main.global_block().has_var(
+                                  n + "@GRAD")})
+        assert param_names, "no trainable params found"
+        want = {n: 0.0 for n in param_names}
+        with fluid.scope_guard(scope):
+            for b in rng_batches:
+                gvals = exe.run(grad_main, feed=b,
+                                fetch_list=[n + "@GRAD"
+                                            for n in param_names])
+                for n, g in zip(param_names, gvals):
+                    want[n] = want[n] + np.asarray(g, np.float64)
+        checked = 0
+        for n in param_names:
+            np.testing.assert_allclose(
+                got[n], want[n], rtol=2e-3, atol=2e-5,
+                err_msg=f"grad mismatch for {n}")
+            checked += 1
+        assert checked >= 10  # the split must cover many params
