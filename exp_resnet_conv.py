@@ -108,10 +108,9 @@ def bench(fn, x, *rest):
     """Profile-based timing: wall clocks on this backend are poisoned by
     ~2.7ms dispatch and ~100ms sync latencies, so run the op ITERS times
     inside one jitted scan under a named_scope and read the actual device
-    time off the xplane trace (same machinery as profiler.compiled_op_table)."""
-    import collections
-    import glob as _glob
+    time off the xplane trace (profiler.scope_device_seconds)."""
     import tempfile
+    from paddle_tpu.profiler import scope_device_seconds
     os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION",
                           "python")
 
@@ -131,24 +130,10 @@ def bench(fn, x, *rest):
     np.asarray(many(x, *rest))
     jax.profiler.stop_trace()
 
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    total_ps = 0
-    for path in _glob.glob(td + "/**/*.xplane.pb", recursive=True):
-        xs_ = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xs_.ParseFromString(f.read())
-        for plane in xs_.planes:
-            if "TPU" not in plane.name and "tpu" not in plane.name:
-                continue
-            ev_meta = plane.event_metadata
-            for line in plane.lines:
-                for ev in line.events:
-                    name = ev_meta[ev.metadata_id].display_name or                         ev_meta[ev.metadata_id].name
-                    if _SCOPE in name:
-                        total_ps += ev.duration_ps
-    if total_ps == 0:
+    total = scope_device_seconds(td, _SCOPE)
+    if total == 0:
         raise RuntimeError("no device events matched the scope")
-    return total_ps / 1e12 / ITERS
+    return total / ITERS
 
 
 def main():

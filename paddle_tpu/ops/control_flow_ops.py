@@ -411,11 +411,48 @@ def _lod_to_lengths(lod, level=0):
     return [splits[i + 1] - splits[i] for i in range(len(splits) - 1)]
 
 
+class DynRankTable:
+    """Rank table over RUNTIME lengths (bucketed dynamic-LoD mode).
+
+    The static RankTable sorts rows by decreasing length so active rows
+    form a prefix; sorting a traced quantity is impossible AND
+    unnecessary here — the TPU lowerings keep the full batch and mask
+    per-row by length anyway, so the dyn table keeps ORIGINAL order
+    (identity indices) and carries the traced splits plus static bounds.
+    """
+
+    def __init__(self, splits, num_seqs, cap, n_rows):
+        self.splits = splits            # [B+1] traced int32
+        self.num_seqs = int(num_seqs)   # static batch size
+        self.cap = int(cap)             # static maxlen bucket
+        self.n_rows = int(n_rows)       # static padded row bucket of X
+
+    @property
+    def lengths_arr(self):
+        return self.splits[1:] - self.splits[:-1]
+
+
+def _is_dyn_lod(lod):
+    from paddle_tpu.lod import DynLoD
+    return isinstance(lod, DynLoD)
+
+
 @register_op("lod_rank_table", infer_shape=_infer_skip, no_gradient=True)
 def lod_rank_table_lower(ctx: LowerContext):
     lod = ctx.input_lod("X")
     x = ctx.input("X")
     level = ctx.attr("level", 0)
+    out_name = ctx.op.output("Out")[0]
+    if _is_dyn_lod(lod):
+        if level != 0:
+            raise NotImplementedError(
+                "lod_rank_table over a non-zero lod level is not "
+                "supported in bucketed dynamic-LoD mode — the bucketed "
+                "feed carries a single (deepest) level of row splits")
+        ctx.outputs[out_name] = DynRankTable(
+            lod.splits(ctx.env).astype(jnp.int32), lod.num_seqs,
+            lod.maxlen_bucket, x.shape[0])
+        return
     if lod is None:
         # dense [B, T, ...] input: every row has length T
         lengths = [x.shape[1] if x.ndim > 1 else 1] * x.shape[0]
@@ -423,13 +460,16 @@ def lod_rank_table_lower(ctx: LowerContext):
         lengths = _lod_to_lengths(lod, level)
     items = sorted(enumerate(lengths), key=lambda p: -p[1])
     table = RankTable(items)
-    out_name = ctx.op.output("Out")[0]
     ctx.outputs[out_name] = table
 
 
 @register_op("max_sequence_len", infer_shape=_infer_skip, no_gradient=True)
 def max_sequence_len_lower(ctx: LowerContext):
     table = ctx.input("RankTable")
+    if isinstance(table, DynRankTable):
+        ctx.set_output("Out", jnp.max(table.lengths_arr)
+                       .astype(jnp.int32).reshape(1))
+        return
     ctx.set_output("Out", jnp.asarray([max(table.lengths)], jnp.int32))
 
 
@@ -446,6 +486,21 @@ def lod_tensor_to_array_lower(ctx: LowerContext):
     x = ctx.input("X")
     table = ctx.input("RankTable")
     lod = ctx.input_lod("X")
+    if isinstance(table, DynRankTable):
+        # bucketed mode: traced splits, static T bound = the lod bucket;
+        # ONE batched gather [cap, B] (an unrolled per-step loop would
+        # emit O(cap) HLO ops — exactly wrong for long-sequence buckets)
+        starts = table.splits[:-1]
+        lengths = table.lengths_arr
+        ts = jnp.arange(table.cap)
+        idx = jnp.clip(starts[None, :] + ts[:, None], 0,
+                       x.shape[0] - 1)                      # [cap, B]
+        mask = (ts[:, None] < lengths[None, :]).astype(x.dtype)
+        data = x[idx] * mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+        out_name = ctx.op.output("Out")[0]
+        ctx.outputs[out_name] = TensorArray(
+            data, jnp.max(lengths).astype(jnp.int32))
+        return
     lengths = table.lengths
     indices = table.indices
     max_len = max(lengths) if lengths else 0
@@ -489,6 +544,26 @@ def array_to_lod_tensor_lower(ctx: LowerContext):
     one)."""
     arr = ctx.input("X")
     table = ctx.input("RankTable")
+    if isinstance(table, DynRankTable):
+        # restore padded-ragged rows [n_rows, ...] with the SAME runtime
+        # splits (identity order — the dyn table never sorted)
+        from paddle_tpu.lod import DynLoD, SPLITS_SUFFIX
+        data = arr.data                       # [cap, B, ...]
+        splits = table.splits
+        r = jnp.arange(table.n_rows)
+        seg = jnp.clip(jnp.searchsorted(splits[1:], r, side="right")
+                       .astype(jnp.int32), 0, table.num_seqs - 1)
+        t = jnp.clip(r - splits[seg], 0, data.shape[0] - 1)
+        gathered = data[t, seg]
+        valid = (r < splits[-1]).reshape(
+            (-1,) + (1,) * (gathered.ndim - 1))
+        out_name = ctx.op.output("Out")[0]
+        ctx.set_output("Out", jnp.where(valid, gathered, 0))
+        name = out_name + SPLITS_SUFFIX
+        ctx.outputs[name] = splits
+        ctx.set_output_lod("Out", DynLoD(name, table.num_seqs,
+                                         table.cap))
+        return
     lengths = table.lengths
     indices = table.indices
     data = arr.data  # [cap, B, ...]
@@ -517,7 +592,8 @@ def shrink_rnn_memory_lower(ctx: LowerContext):
     x = ctx.input("X")
     table = ctx.input("RankTable")
     i = ctx.input("I")
-    lengths = jnp.asarray(table.lengths, jnp.int32)
+    lengths = table.lengths_arr if isinstance(table, DynRankTable) \
+        else jnp.asarray(table.lengths, jnp.int32)
     step = jnp.asarray(i).reshape(()).astype(jnp.int32)
     active = (lengths > step).astype(x.dtype)
     mask = active.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
@@ -529,6 +605,10 @@ def shrink_rnn_memory_lower(ctx: LowerContext):
 def reorder_lod_tensor_by_rank_lower(ctx: LowerContext):
     x = ctx.input("X")
     table = ctx.input("RankTable")
+    if isinstance(table, DynRankTable):
+        # dyn tables keep original order — reorder is the identity
+        ctx.set_output("Out", x)
+        return
     ctx.set_output("Out", x[jnp.asarray(table.indices)])
 
 

@@ -181,7 +181,24 @@ def _infer_mean(op, block):
 
 @register_op("mean", infer_shape=_infer_mean)
 def mean_lower(ctx):
-    ctx.set_output("Out", jnp.mean(ctx.input("X")).reshape(1))
+    x = ctx.input("X")
+    lod = ctx.input_lod("X")
+    from paddle_tpu.lod import DynLoD
+    if isinstance(lod, DynLoD):
+        # bucketed dynamic-LoD rows: average over the REAL rows only —
+        # rows past splits[-1] are zero padding (their values, e.g. the
+        # clamped cross-entropy of an all-zero softmax row, are noise)
+        splits = lod.splits(ctx.env)
+        n_real = splits[-1]
+        r = jnp.arange(x.shape[0])
+        mask = (r < n_real).astype(x.dtype).reshape(
+            (-1,) + (1,) * (x.ndim - 1))
+        per_row = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
+        total = jnp.sum(x * mask)
+        count = jnp.maximum(n_real.astype(x.dtype) * per_row, 1)
+        ctx.set_output("Out", (total / count).reshape(1))
+        return
+    ctx.set_output("Out", jnp.mean(x).reshape(1))
 
 
 @register_op("minus", infer_shape=infer_shape_unary())

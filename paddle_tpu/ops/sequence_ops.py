@@ -184,21 +184,62 @@ def sequence_expand_lower(ctx: LowerContext):
     x_lod = ctx.input_lod("X")
     y_lod = _require_lod(ctx, "Y")
     if _is_dyn(y_lod):
-        # bucketed mode, dense-x case (the attention-context pattern:
-        # one row per sequence broadcast back over its tokens); ragged-x
-        # sub-sequence expansion has data-dependent output rows
-        if x_lod is not None:
-            raise NotImplementedError(
-                "sequence_expand with a ragged X is not supported in "
-                "bucketed dynamic-LoD mode")
-        y_arr = ctx.input("Y")
-        n = y_arr.shape[0]
-        seg, _, num, _, valid = _segment_tables(ctx, y_lod, n)
-        safe = jnp.minimum(seg, num - 1)
-        out = jnp.where(valid[(...,) + (None,) * (x.ndim - 1)],
-                        x[safe], 0)
+        if x_lod is None:
+            # dense-x case (the attention-context pattern: one row per
+            # sequence broadcast back over its tokens)
+            y_arr = ctx.input("Y")
+            n = y_arr.shape[0]
+            seg, _, num, _, valid = _segment_tables(ctx, y_lod, n)
+            safe = jnp.minimum(seg, num - 1)
+            out = jnp.where(valid[(...,) + (None,) * (x.ndim - 1)],
+                            x[safe], 0)
+            ctx.set_output("Out", out)
+            ctx.set_output_lod("Out", y_lod)
+            return
+        # ragged-x expansion (the beam-expansion pattern: repeat each x
+        # sub-sequence r_i times, r_i from y's lod).  Static-shape
+        # dialect: output rows are bounded by n_x_rows * rep_cap and the
+        # output lod gets B * rep_cap sequence slots — slot (i, k) is
+        # seq i's k-th repeat, EMPTY (zero length) when k >= r_i, so the
+        # real rows stay contiguous and in reference order; only the
+        # sequence table carries padding entries.
+        from paddle_tpu.lod import DynLoD, SPLITS_SUFFIX
+        if _is_dyn(x_lod):
+            x_splits = x_lod.splits(ctx.env).astype(jnp.int32)
+            bx = x_lod.num_seqs
+            x_cap = x_lod.maxlen_bucket
+        else:
+            x_splits = jnp.asarray(np.asarray(x_lod[0], np.int32))
+            bx = len(x_lod[0]) - 1
+            x_cap = int(max(np.diff(np.asarray(x_lod[0])), default=0))
+        y_splits = y_lod.splits(ctx.env).astype(jnp.int32)
+        rep_cap = y_lod.maxlen_bucket
+        if y_lod.num_seqs != bx:
+            raise ValueError(
+                f"sequence_expand: X has {bx} sequences but Y has "
+                f"{y_lod.num_seqs}")
+        len_x = x_splits[1:] - x_splits[:-1]          # [B]
+        rep = y_splits[1:] - y_splits[:-1]            # [B]
+        n_slots = bx * rep_cap
+        slot_i = jnp.arange(n_slots) // rep_cap
+        slot_k = jnp.arange(n_slots) % rep_cap
+        slot_len = jnp.where(slot_k < rep[slot_i], len_x[slot_i], 0)
+        out_splits = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(slot_len).astype(jnp.int32)])
+        n_out = int(x.shape[0]) * rep_cap
+        r = jnp.arange(n_out)
+        slot = jnp.clip(jnp.searchsorted(out_splits[1:], r, side="right")
+                        .astype(jnp.int32), 0, n_slots - 1)
+        t = r - out_splits[slot]
+        src = jnp.clip(x_splits[slot // rep_cap] + t, 0, x.shape[0] - 1)
+        valid = (r < out_splits[-1]).reshape(
+            (-1,) + (1,) * (x.ndim - 1))
+        out = jnp.where(valid, x[src], 0)
+        name = ctx.op.output("Out")[0] + SPLITS_SUFFIX
+        ctx.outputs[name] = out_splits
         ctx.set_output("Out", out)
-        ctx.set_output_lod("Out", y_lod)
+        ctx.set_output_lod("Out", DynLoD(name, n_slots, x_cap))
         return
     ref_level = ctx.attr("ref_level", -1)
     if ref_level == -1:

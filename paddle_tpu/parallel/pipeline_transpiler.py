@@ -229,13 +229,13 @@ class PipelinedProgram:
         SAMPLE microbatch feed dict (fixes the microbatch shapes)."""
         sample = {k: np.asarray(v) for k, v in microbatch_feeds.items()}
         self._param_layouts = []
-        self._param_values = []
+        param_values = []     # local: only needed to build packed_params
         for names in self.stage_param_names:
             vals = {n: np.asarray(scope.find_var(n)) for n in names}
             lay = _Layout(names, [vals[n].shape for n in names],
                           [vals[n].dtype for n in names])
             self._param_layouts.append(lay)
-            self._param_values.append(vals)
+            param_values.append(vals)
 
         self._carrier_layouts = []
         for b, names in enumerate(self.boundaries):
@@ -254,7 +254,7 @@ class PipelinedProgram:
                              default=0)
         # packed parameter buffer [P, Lp]
         rows = []
-        for lay, vals in zip(self._param_layouts, self._param_values):
+        for lay, vals in zip(self._param_layouts, param_values):
             vec = np.zeros(self.param_len, np.float32)
             flat = np.concatenate(
                 [np.asarray(vals[n], np.float32).ravel()

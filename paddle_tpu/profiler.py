@@ -179,12 +179,12 @@ def parse_op_scope(hlo_op_name):
     return op_type, tag
 
 
-def compiled_op_table(trace_dir, sorted_key="total"):
-    """Aggregate a jax.profiler trace (xplane protos under ``trace_dir``)
-    into per-IR-op device time, keyed by the named_scope labels the
-    executor emitted.  Returns (table_string, rows) where rows =
-    [(op_type, calls, total_seconds)] sorted descending."""
-    import collections
+def iter_trace_events(trace_dir):
+    """Yield ``(name_candidates, duration_ps)`` for every device event in
+    a jax.profiler trace (xplane protos under ``trace_dir``).  The scope
+    label appears either in the event name or in the tf_op/long_name stat
+    depending on the backend — callers match against ALL candidates.
+    Shared by :func:`compiled_op_table` and the benchmark harnesses."""
     import glob as _glob
 
     try:
@@ -192,8 +192,6 @@ def compiled_op_table(trace_dir, sorted_key="total"):
     except ImportError:  # pragma: no cover
         from tsl.profiler.protobuf import xplane_pb2  # type: ignore
 
-    agg = collections.Counter()
-    calls = collections.Counter()
     paths = _glob.glob(str(trace_dir) + "/**/*.xplane.pb", recursive=True)
     for path in paths:
         xs = xplane_pb2.XSpace()
@@ -205,8 +203,6 @@ def compiled_op_table(trace_dir, sorted_key="total"):
             for line in plane.lines:
                 for ev in line.events:
                     m = evmeta[ev.metadata_id]
-                    # scope appears either in the event name or in the
-                    # tf_op/long_name stat (backend-dependent)
                     cands = [m.name, getattr(m, "display_name", "")]
                     for st in list(ev.stats) + list(m.stats):
                         sname = statmeta[st.metadata_id].name
@@ -216,12 +212,37 @@ def compiled_op_table(trace_dir, sorted_key="total"):
                             elif st.ref_value:
                                 cands.append(
                                     statmeta[st.ref_value].name)
-                    for c in cands:
-                        parsed = parse_op_scope(c)
-                        if parsed is not None:
-                            agg[parsed[0]] += ev.duration_ps / 1e12
-                            calls[parsed[0]] += 1
-                            break
+                    yield cands, ev.duration_ps
+
+
+def scope_device_seconds(trace_dir, substring):
+    """Total device seconds of events whose any name candidate contains
+    ``substring`` — the micro-benchmark counterpart of
+    :func:`compiled_op_table` (wall clocks on this backend are poisoned
+    by dispatch/sync latency; device time is the ground truth)."""
+    total_ps = 0
+    for cands, dur in iter_trace_events(trace_dir):
+        if any(substring in c for c in cands):
+            total_ps += dur
+    return total_ps / 1e12
+
+
+def compiled_op_table(trace_dir, sorted_key="total"):
+    """Aggregate a jax.profiler trace (xplane protos under ``trace_dir``)
+    into per-IR-op device time, keyed by the named_scope labels the
+    executor emitted.  Returns (table_string, rows) where rows =
+    [(op_type, calls, total_seconds)] sorted descending."""
+    import collections
+
+    agg = collections.Counter()
+    calls = collections.Counter()
+    for cands, dur in iter_trace_events(trace_dir):
+        for c in cands:
+            parsed = parse_op_scope(c)
+            if parsed is not None:
+                agg[parsed[0]] += dur / 1e12
+                calls[parsed[0]] += 1
+                break
     rows = sorted(((t, calls[t], s) for t, s in agg.items()),
                   key=lambda r: r[1 if sorted_key == "calls" else 2],
                   reverse=True)
