@@ -61,16 +61,18 @@ def conv_bn_relu(params, name, x, stride, nhwc, relu=True):
         dn = ("NCHW", "HWIO", "NCHW")
     k = w.shape[0]
     pad = "SAME" if k > 1 else "VALID"
+    # bf16 in/out (a f32 preferred output would make the conv vjp mix
+    # dtypes, which lax rejects; the MXU accumulates f32 internally);
+    # BN math in f32
     out = jax.lax.conv_general_dilated(
-        x, w, (stride, stride), pad, dimension_numbers=dn,
-        preferred_element_type=jnp.float32)
+        x, w, (stride, stride), pad, dimension_numbers=dn)
     caxis = 3 if nhwc else 1
     shape = [1, 1, 1, 1]
     shape[caxis] = -1
     # inference-style folded BN (scale+shift); training-BN statistics are
     # elementwise reductions that fuse either way and don't change the
     # layout question
-    out = out * params[name + ".g"].reshape(shape) \
+    out = out.astype(jnp.float32) * params[name + ".g"].reshape(shape) \
         + params[name + ".b"].reshape(shape)
     if relu:
         out = jnp.maximum(out, 0.0)
@@ -79,11 +81,13 @@ def conv_bn_relu(params, name, x, stride, nhwc, relu=True):
 
 def resnet50(params, x, nhwc):
     x = conv_bn_relu(params, "stem", x, 2, nhwc)
-    caxis = 3 if nhwc else 1
     window = [1, 3, 3, 1] if nhwc else [1, 1, 3, 3]
     strides = [1, 2, 2, 1] if nhwc else [1, 1, 2, 2]
-    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides,
-                              "SAME")
+    # pool in f32 with a literal -inf init: the max-pool monoid matcher
+    # (which makes reduce_window differentiable) wants the literal
+    x = jax.lax.reduce_window(
+        x.astype(jnp.float32), -jnp.inf, jax.lax.max, window, strides,
+        "SAME").astype(jnp.bfloat16)
     cin = 64
     for stage, n in BLOCKS.items():
         width = 64 * 2 ** (stage - 2)
@@ -141,12 +145,28 @@ def main():
             float(out[0])
 
         dt, trials = measure_trials(run_once, n_trials=5)
-        mfu = flops_fwd * 3 / dt / 197e12
+
+        # ground truth: total DEVICE seconds of one step off the xplane
+        # trace (wall clock carries ~100ms of dispatch+sync latency)
+        import os as _os
+        import tempfile
+        _os.environ.setdefault(
+            "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+        from paddle_tpu.profiler import iter_trace_events
+        td = tempfile.mkdtemp()
+        jax.profiler.start_trace(td)
+        run_once()
+        jax.profiler.stop_trace()
+        dev_s = sum(dur for _, dur in iter_trace_events(
+            td, device_only=True)) / 1e12
+
+        mfu = flops_fwd * 3 / dev_s / 197e12
         print(json.dumps({
             "layout": "NHWC" if nhwc else "NCHW",
             "step_ms": round(dt * 1e3, 1),
-            "img_per_s": round(BATCH / dt, 1),
-            "mfu_3x": round(mfu, 3),
+            "device_ms": round(dev_s * 1e3, 1),
+            "img_per_s_device": round(BATCH / dev_s, 1),
+            "mfu_3x_device": round(mfu, 3),
             "trials_ms": [round(t * 1e3, 1) for t in trials],
         }))
         sys.stdout.flush()

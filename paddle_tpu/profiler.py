@@ -179,12 +179,14 @@ def parse_op_scope(hlo_op_name):
     return op_type, tag
 
 
-def iter_trace_events(trace_dir):
-    """Yield ``(name_candidates, duration_ps)`` for every device event in
-    a jax.profiler trace (xplane protos under ``trace_dir``).  The scope
+def iter_trace_events(trace_dir, device_only=False):
+    """Yield ``(name_candidates, duration_ps)`` for every event in a
+    jax.profiler trace (xplane protos under ``trace_dir``).  The scope
     label appears either in the event name or in the tf_op/long_name stat
     depending on the backend — callers match against ALL candidates.
-    Shared by :func:`compiled_op_table` and the benchmark harnesses."""
+    ``device_only`` restricts to accelerator planes (``/device:...``) so
+    host Python-tracer events cannot pollute device-time sums.  Shared by
+    :func:`compiled_op_table` and the benchmark harnesses."""
     import glob as _glob
 
     try:
@@ -198,6 +200,8 @@ def iter_trace_events(trace_dir):
         with open(path, "rb") as f:
             xs.ParseFromString(f.read())
         for plane in xs.planes:
+            if device_only and not plane.name.startswith("/device:"):
+                continue
             statmeta = plane.stat_metadata
             evmeta = plane.event_metadata
             for line in plane.lines:
@@ -221,7 +225,7 @@ def scope_device_seconds(trace_dir, substring):
     :func:`compiled_op_table` (wall clocks on this backend are poisoned
     by dispatch/sync latency; device time is the ground truth)."""
     total_ps = 0
-    for cands, dur in iter_trace_events(trace_dir):
+    for cands, dur in iter_trace_events(trace_dir, device_only=True):
         if any(substring in c for c in cands):
             total_ps += dur
     return total_ps / 1e12
