@@ -131,6 +131,8 @@ def bench(fn, x, *rest):
     jax.profiler.stop_trace()
 
     total = scope_device_seconds(td, _SCOPE)
+    import shutil
+    shutil.rmtree(td, ignore_errors=True)
     if total == 0:
         raise RuntimeError("no device events matched the scope")
     return total / ITERS

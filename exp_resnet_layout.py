@@ -53,6 +53,9 @@ def init_params(rng):
     return {k: jnp.asarray(v) for k, v in params.items()}
 
 
+REAL_BN = False    # set by main(): training-BN statistics variant
+
+
 def conv_bn_relu(params, name, x, stride, nhwc, relu=True):
     w = params[name + ".w"].astype(jnp.bfloat16)
     if nhwc:
@@ -72,7 +75,13 @@ def conv_bn_relu(params, name, x, stride, nhwc, relu=True):
     # inference-style folded BN (scale+shift); training-BN statistics are
     # elementwise reductions that fuse either way and don't change the
     # layout question
-    out = out.astype(jnp.float32) * params[name + ".g"].reshape(shape) \
+    out = out.astype(jnp.float32)
+    if REAL_BN:
+        axes = (0, 1, 2) if nhwc else (0, 2, 3)
+        mean = jnp.mean(out, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(out - mean), axis=axes, keepdims=True)
+        out = (out - mean) * jax.lax.rsqrt(var + 1e-5)
+    out = out * params[name + ".g"].reshape(shape) \
         + params[name + ".b"].reshape(shape)
     if relu:
         out = jnp.maximum(out, 0.0)
@@ -127,11 +136,17 @@ def make_step(nhwc):
 
 
 def main():
+    global REAL_BN, BATCH
     rng = np.random.RandomState(0)
-    params = init_params(rng)
-    labels = jnp.asarray(rng.randint(0, 1000, BATCH))
-    flops_fwd = 7.72e9 * BATCH      # analytic conv+fc fwd GFLOPs/img
-    for nhwc in (False, True):
+    variants = [
+        # (batch, nhwc, real_bn)
+        (256, False, False), (256, True, False),
+        (256, True, True), (512, True, False),
+    ]
+    for BATCH, nhwc, REAL_BN in variants:
+        params = init_params(rng)
+        labels = jnp.asarray(rng.randint(0, 1000, BATCH))
+        flops_fwd = 7.72e9 * BATCH  # analytic conv+fc fwd GFLOPs/img
         x = jnp.asarray(rng.rand(BATCH, 224, 224, 3).astype("float32"))
         if not nhwc:
             x = jnp.transpose(x, (0, 3, 1, 2))
@@ -152,17 +167,19 @@ def main():
         import tempfile
         _os.environ.setdefault(
             "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
-        from paddle_tpu.profiler import iter_trace_events
+        from paddle_tpu.profiler import device_busy_seconds
+        import shutil
         td = tempfile.mkdtemp()
         jax.profiler.start_trace(td)
         run_once()
         jax.profiler.stop_trace()
-        dev_s = sum(dur for _, dur in iter_trace_events(
-            td, device_only=True)) / 1e12
+        dev_s = device_busy_seconds(td)
+        shutil.rmtree(td, ignore_errors=True)
 
         mfu = flops_fwd * 3 / dev_s / 197e12
         print(json.dumps({
             "layout": "NHWC" if nhwc else "NCHW",
+            "batch": BATCH, "real_bn": REAL_BN,
             "step_ms": round(dt * 1e3, 1),
             "device_ms": round(dev_s * 1e3, 1),
             "img_per_s_device": round(BATCH / dev_s, 1),

@@ -21,7 +21,8 @@ from jax.sharding import PartitionSpec as P
 from paddle_tpu.framework import default_main_program, default_startup_program
 from paddle_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
-__all__ = ["DistributeTranspiler", "DistributedSpec", "round_robin_split"]
+__all__ = ["DistributeTranspiler", "DistributedSpec",
+           "round_robin_split", "hash_name_split"]
 
 
 class DistributedSpec:
@@ -31,6 +32,7 @@ class DistributedSpec:
     def __init__(self):
         self.param_specs = {}   # name -> PartitionSpec
         self.grad_specs = {}
+        self.placement = {}     # name -> home shard (reference eplist)
         self.num_shards = 1
 
     def spec_for(self, name):
@@ -42,6 +44,18 @@ def round_robin_split(params, num_shards):
     shards = [[] for _ in range(num_shards)]
     for i, p in enumerate(params):
         shards[i % num_shards].append(p)
+    return shards
+
+
+def hash_name_split(params, num_shards):
+    """reference ``distributed_splitter.py:16`` hash_name: stable
+    name-hash placement (md5, not Python's salted ``hash``)."""
+    import hashlib
+    shards = [[] for _ in range(num_shards)]
+    for p in params:
+        name = p.name if hasattr(p, "name") else str(p)
+        h = int(hashlib.md5(name.encode()).hexdigest()[:8], 16)
+        shards[h % num_shards].append(p)
     return shards
 
 
@@ -84,6 +98,19 @@ class DistributeTranspiler:
                 dist_tables.add(op.input("W")[0])
 
         params = block.all_parameters()
+        # the reference's eplist: split_method (round_robin / hash_name)
+        # assigns each parameter a home shard.  Under GSPMD that degree
+        # of freedom is only bookkeeping — tensors are either sharded
+        # over the axis or replicated — but the placement map is kept
+        # for parity/debugging (``placement()``), and the reference's
+        # PARAM-BLOCK SPLITTING (distributed_splitter cuts big params
+        # into ~8k-element blocks spread over pservers) is subsumed by
+        # sharding dim 0 over ``mesh_axis``: GSPMD tiles the parameter
+        # across devices exactly as the block split spread it across
+        # pservers, with the all-reduce/all-gather exchange implicit.
+        shards = split_method(params, num_shards)
+        self.spec.placement = {
+            p.name: k for k, part in enumerate(shards) for p in part}
         for p in params:
             first_dim_shards = (p.shape and len(p.shape) >= 1 and
                                 p.shape[0] is not None and p.shape[0] > 0)
@@ -97,6 +124,10 @@ class DistributeTranspiler:
             else:
                 self.spec.param_specs[p.name] = P()
         return self
+
+    def placement(self):
+        """name -> home-shard id, the reference's ``eplist`` analog."""
+        return dict(self.spec.placement)
 
     def param_shardings(self):
         """The plan as ``ParallelExecutor(param_shardings=...)`` rules:

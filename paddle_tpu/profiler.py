@@ -179,32 +179,24 @@ def parse_op_scope(hlo_op_name):
     return op_type, tag
 
 
-def iter_trace_events(trace_dir, device_only=False):
+def iter_trace_events(trace_dir, device_only=False, exclude_async=False):
     """Yield ``(name_candidates, duration_ps)`` for every event in a
     jax.profiler trace (xplane protos under ``trace_dir``).  The scope
     label appears either in the event name or in the tf_op/long_name stat
     depending on the backend — callers match against ALL candidates.
     ``device_only`` restricts to accelerator planes (``/device:...``) so
-    host Python-tracer events cannot pollute device-time sums.  Shared by
-    :func:`compiled_op_table` and the benchmark harnesses."""
-    import glob as _glob
-
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except ImportError:  # pragma: no cover
-        from tsl.profiler.protobuf import xplane_pb2  # type: ignore
-
-    paths = _glob.glob(str(trace_dir) + "/**/*.xplane.pb", recursive=True)
-    for path in paths:
-        xs = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xs.ParseFromString(f.read())
-        for plane in xs.planes:
+    host Python-tracer events cannot pollute device-time sums;
+    ``exclude_async`` drops 'Async XLA Ops' lines, whose overlapping DMA
+    durations multi-count wall time.  Shared by :func:`compiled_op_table`
+    and the benchmark harnesses."""
+    for plane in _iter_xplanes(trace_dir):
             if device_only and not plane.name.startswith("/device:"):
                 continue
             statmeta = plane.stat_metadata
             evmeta = plane.event_metadata
             for line in plane.lines:
+                if exclude_async and "async" in line.name.lower():
+                    continue
                 for ev in line.events:
                     m = evmeta[ev.metadata_id]
                     cands = [m.name, getattr(m, "display_name", "")]
@@ -219,13 +211,54 @@ def iter_trace_events(trace_dir, device_only=False):
                     yield cands, ev.duration_ps
 
 
+def _iter_xplanes(trace_dir):
+    """Yield every plane of every xplane proto under ``trace_dir``."""
+    import glob as _glob
+
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError:  # pragma: no cover
+        from tsl.profiler.protobuf import xplane_pb2  # type: ignore
+
+    for path in _glob.glob(str(trace_dir) + "/**/*.xplane.pb",
+                           recursive=True):
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        yield from xs.planes
+
+
+def device_busy_seconds(trace_dir):
+    """Busy device seconds of a trace: per accelerator plane, the op
+    timeline is the line named 'XLA Ops' (span lines like 'Steps' /
+    'XLA Modules' include on-device idle gaps, and 'Async XLA Ops' holds
+    OVERLAPPING DMA copies whose durations multi-count wall time).  Falls
+    back to the max non-async line sum when no 'XLA Ops' line exists."""
+    busy = 0.0
+    for plane in _iter_xplanes(trace_dir):
+        if not plane.name.startswith("/device:"):
+            continue
+        sums = {}
+        for line in plane.lines:
+            if "async" in line.name.lower():
+                continue
+            sums[line.name] = sums.get(line.name, 0) + sum(
+                ev.duration_ps for ev in line.events)
+        if "XLA Ops" in sums:
+            busy += sums["XLA Ops"] / 1e12
+        elif sums:
+            busy += max(sums.values()) / 1e12
+    return busy
+
+
 def scope_device_seconds(trace_dir, substring):
     """Total device seconds of events whose any name candidate contains
     ``substring`` — the micro-benchmark counterpart of
     :func:`compiled_op_table` (wall clocks on this backend are poisoned
     by dispatch/sync latency; device time is the ground truth)."""
     total_ps = 0
-    for cands, dur in iter_trace_events(trace_dir, device_only=True):
+    for cands, dur in iter_trace_events(trace_dir, device_only=True,
+                                        exclude_async=True):
         if any(substring in c for c in cands):
             total_ps += dur
     return total_ps / 1e12
@@ -240,7 +273,9 @@ def compiled_op_table(trace_dir, sorted_key="total"):
 
     agg = collections.Counter()
     calls = collections.Counter()
-    for cands, dur in iter_trace_events(trace_dir):
+    # exclude_async: overlapping DMA durations otherwise inflate per-op
+    # totals past wall time (the r3 ResNet conv attribution suffered this)
+    for cands, dur in iter_trace_events(trace_dir, exclude_async=True):
         for c in cands:
             parsed = parse_op_scope(c)
             if parsed is not None:

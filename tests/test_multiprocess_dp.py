@@ -90,23 +90,25 @@ def test_two_process_dp_matches_single_process(tmp_path):
 
     procs = []
     outs = []
-    for rank in range(2):
-        out = tmp_path / f"rank{rank}.npz"
-        outs.append(out)
-        procs.append(subprocess.Popen(
-            [sys.executable, str(worker), "--rank", str(rank),
-             "--nproc", "2", "--coordinator", coordinator,
-             "--out", str(out)],
-            cwd=repo_root, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True))
-    for p in procs:
-        try:
+    try:
+        for rank in range(2):
+            out = tmp_path / f"rank{rank}.npz"
+            outs.append(out)
+            procs.append(subprocess.Popen(
+                [sys.executable, str(worker), "--rank", str(rank),
+                 "--nproc", "2", "--coordinator", coordinator,
+                 "--out", str(out)],
+                cwd=repo_root, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        for p in procs:
             stdout, stderr = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+            assert p.returncode == 0, stderr[-2000:]
+    finally:
+        # a failed rank must not orphan its peer (it would sit on the
+        # coordinator port waiting for distributed init)
+        for q in procs:
+            if q.poll() is None:
                 q.kill()
-            raise
-        assert p.returncode == 0, stderr[-2000:]
 
     # single-process reference over the identical program + batches
     single = tmp_path / "single.npz"
