@@ -40,7 +40,7 @@ with fluid.scope_guard(scope):
     exe.run_steps(main_prog, feed=stacked, fetch_list=[avg_cost.name],
                   steps=STEPS)
     jax.profiler.stop_trace()
-    table, rows = profiler.compiled_op_table(td)
+    _, rows = profiler.compiled_op_table(td)
     busy = profiler.device_busy_seconds(td)
     import shutil
     shutil.rmtree(td, ignore_errors=True)

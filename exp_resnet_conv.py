@@ -109,10 +109,7 @@ def bench(fn, x, *rest):
     ~2.7ms dispatch and ~100ms sync latencies, so run the op ITERS times
     inside one jitted scan under a named_scope and read the actual device
     time off the xplane trace (profiler.scope_device_seconds)."""
-    import tempfile
-    from paddle_tpu.profiler import scope_device_seconds
-    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION",
-                          "python")
+    from paddle_tpu.profiler import measure_device_seconds
 
     @jax.jit
     def many(x, *rest):

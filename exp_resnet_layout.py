@@ -163,18 +163,8 @@ def main():
 
         # ground truth: total DEVICE seconds of one step off the xplane
         # trace (wall clock carries ~100ms of dispatch+sync latency)
-        import os as _os
-        import tempfile
-        _os.environ.setdefault(
-            "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
-        from paddle_tpu.profiler import device_busy_seconds
-        import shutil
-        td = tempfile.mkdtemp()
-        jax.profiler.start_trace(td)
-        run_once()
-        jax.profiler.stop_trace()
-        dev_s = device_busy_seconds(td)
-        shutil.rmtree(td, ignore_errors=True)
+        from paddle_tpu.profiler import measure_device_seconds
+        dev_s = measure_device_seconds(run_once)
 
         mfu = flops_fwd * 3 / dev_s / 197e12
         print(json.dumps({

@@ -190,25 +190,54 @@ def iter_trace_events(trace_dir, device_only=False, exclude_async=False):
     durations multi-count wall time.  Shared by :func:`compiled_op_table`
     and the benchmark harnesses."""
     for plane in _iter_xplanes(trace_dir):
-            if device_only and not plane.name.startswith("/device:"):
+        if device_only and not plane.name.startswith("/device:"):
+            continue
+        statmeta = plane.stat_metadata
+        evmeta = plane.event_metadata
+        for line in plane.lines:
+            if exclude_async and "async" in line.name.lower():
                 continue
-            statmeta = plane.stat_metadata
-            evmeta = plane.event_metadata
-            for line in plane.lines:
-                if exclude_async and "async" in line.name.lower():
-                    continue
-                for ev in line.events:
-                    m = evmeta[ev.metadata_id]
-                    cands = [m.name, getattr(m, "display_name", "")]
-                    for st in list(ev.stats) + list(m.stats):
-                        sname = statmeta[st.metadata_id].name
-                        if sname in ("tf_op", "long_name", "name"):
-                            if st.str_value:
-                                cands.append(st.str_value)
-                            elif st.ref_value:
-                                cands.append(
-                                    statmeta[st.ref_value].name)
-                    yield cands, ev.duration_ps
+            for ev in line.events:
+                m = evmeta[ev.metadata_id]
+                cands = [m.name, getattr(m, "display_name", "")]
+                for st in list(ev.stats) + list(m.stats):
+                    sname = statmeta[st.metadata_id].name
+                    if sname in ("tf_op", "long_name", "name"):
+                        if st.str_value:
+                            cands.append(st.str_value)
+                        elif st.ref_value:
+                            cands.append(
+                                statmeta[st.ref_value].name)
+                yield cands, ev.duration_ps
+
+
+def measure_device_seconds(fn, scope=None):
+    """Run ``fn()`` under a jax.profiler trace and return its DEVICE
+    seconds — total busy time, or only events matching the ``scope``
+    substring when given.  Owns the trace-dir lifecycle and the
+    pure-python protobuf env the xplane parser needs; wall clocks on
+    this backend carry dispatch/sync latencies, so this is the shared
+    measurement harness for the bench scripts (exp_resnet_*.py)."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION",
+                          "python")
+    td = tempfile.mkdtemp(prefix="pttrace_")
+    jax.profiler.start_trace(td)
+    try:
+        fn()
+    finally:
+        jax.profiler.stop_trace()
+    try:
+        if scope is not None:
+            return scope_device_seconds(td, scope)
+        return device_busy_seconds(td)
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
 
 
 def _iter_xplanes(trace_dir):
