@@ -3,6 +3,8 @@ reference ships under ``benchmark/fluid/`` (mnist, resnet, vgg,
 machine_translation/transformer, stacked_dynamic_lstm) — re-built on the
 TPU-native layers API."""
 
-from paddle_tpu.models import resnet, transformer, vgg, mnist
+from paddle_tpu.models import (resnet, transformer, vgg, mnist,
+                               seq2seq, stacked_lstm)
 
-__all__ = ["resnet", "transformer", "vgg", "mnist"]
+__all__ = ["resnet", "transformer", "vgg", "mnist",
+           "seq2seq", "stacked_lstm"]

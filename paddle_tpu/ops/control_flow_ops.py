@@ -609,7 +609,27 @@ def reorder_lod_tensor_by_rank_lower(ctx: LowerContext):
         # dyn tables keep original order — reorder is the identity
         ctx.set_output("Out", x)
         return
-    ctx.set_output("Out", x[jnp.asarray(table.indices)])
+    lod = ctx.input_lod("X")
+    if lod is None:
+        # dense [B, ...]: one row per sequence
+        ctx.set_output("Out", x[jnp.asarray(table.indices)])
+        return
+    # ragged input: reorder whole SUB-SEQUENCES into rank-table order
+    # (indexing rows by sequence ids would interleave unrelated rows)
+    if len(lod) > 1:
+        raise NotImplementedError(
+            "reorder_lod_tensor_by_rank over a nested (multi-level) LoD "
+            "tensor: level-0 splits index level-1 entries, not rows — "
+            "flatten the nesting (sequence_reshape / sub_nested_seq) "
+            "before reordering")
+    splits = np.asarray(lod[0])
+    rows = []
+    new_splits = [0]
+    for orig in table.indices:
+        rows.extend(range(int(splits[orig]), int(splits[orig + 1])))
+        new_splits.append(len(rows))
+    ctx.set_output("Out", x[jnp.asarray(np.asarray(rows, np.int32))])
+    ctx.set_output_lod("Out", [new_splits])
 
 
 # ---------------------------------------------------------------------------

@@ -218,3 +218,26 @@ class TestDynamicRNN:
         assert np.isfinite(res[0]).all()
         grad_mag = sum(float(np.abs(g).sum()) for g in res[1:])
         assert grad_mag > 0
+
+
+def test_reorder_lod_tensor_by_rank_ragged():
+    """Regression (r4): reordering a RAGGED tensor by rank table must move
+    whole sub-sequences, not index rows by sequence id."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[-1, 1], dtype="float32",
+                        append_batch_size=False, lod_level=1)
+        y = layers.data(name="y", shape=[-1, 1], dtype="float32",
+                        append_batch_size=False, lod_level=1)
+        table = layers.lod_rank_table(y)
+        out = layers.reorder_lod_tensor_by_rank(x, table)
+    exe = fluid.Executor()
+    exe.run(startup)
+    # y lengths [1, 3, 2] -> rank order (desc length): seq1, seq2, seq0
+    yv = np.zeros((6, 1), "f")
+    y_lod = [[0, 1, 4, 6]]
+    xv = np.arange(6, dtype="f").reshape(6, 1)  # same lod as y
+    (ov,) = exe.run(main, feed={"x": (xv, y_lod), "y": (yv, y_lod)},
+                    fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(ov).reshape(-1),
+                               [1, 2, 3, 4, 5, 0])
