@@ -1,0 +1,52 @@
+"""Probe: span-vs-sum of the XLA Ops line for a run_steps trace, and how
+many files/planes the trace dir holds (validates device_busy accounting)."""
+import os, tempfile, glob
+os.environ["PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION"] = "python"
+import numpy as np
+import jax
+import paddle_tpu as fluid
+from paddle_tpu.models import resnet as R
+
+BATCH, STEPS = 256, 2
+main_prog, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main_prog, startup):
+    avg_cost, acc, feeds = R.resnet_train_program(BATCH)
+    fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9) \
+        .minimize(avg_cost)
+main_prog.amp = True
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    batches = [{"image": rng.rand(BATCH, 3, 224, 224).astype("float32"),
+                "label": rng.randint(0, 1000, (BATCH, 1)).astype("int64")}
+               for _ in range(STEPS)]
+    stacked = {k: jax.device_put(np.stack([b[k] for b in batches]))
+               for k in batches[0]}
+    exe.run_steps(main_prog, feed=stacked, fetch_list=[avg_cost.name],
+                  steps=STEPS)
+    td = tempfile.mkdtemp()
+    jax.profiler.start_trace(td)
+    exe.run_steps(main_prog, feed=stacked, fetch_list=[avg_cost.name],
+                  steps=STEPS)
+    jax.profiler.stop_trace()
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+files = glob.glob(td + "/**/*.xplane.pb", recursive=True)
+print("xplane files:", len(files))
+for p in files:
+    xs = xplane_pb2.XSpace()
+    xs.ParseFromString(open(p, "rb").read())
+    for plane in xs.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        for line in plane.lines:
+            if not line.events:
+                continue
+            total = sum(ev.duration_ps for ev in line.events)
+            t0 = min(ev.offset_ps for ev in line.events)
+            t1 = max(ev.offset_ps + ev.duration_ps for ev in line.events)
+            print(f"  {os.path.basename(p)[:20]} plane={plane.name} "
+                  f"line={line.name!r} n={len(line.events)} "
+                  f"sum={total/1e9:.1f}ms span={(t1-t0)/1e9:.1f}ms")

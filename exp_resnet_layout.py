@@ -127,9 +127,12 @@ def loss_fn(params, x, labels, nhwc):
 def make_step(nhwc):
     @jax.jit
     def step(params, x, labels):
-        l, g = jax.value_and_grad(loss_fn)(params, x, labels, nhwc)
-        new = jax.tree_util.tree_map(lambda p, gr: p - 0.1 * gr,
-                                     params, g)
+        # named_scope: device-time reads match THIS program's events only
+        # (the shared chip's tracer also records other tenants)
+        with jax.named_scope("resnet_train_step"):
+            l, g = jax.value_and_grad(loss_fn)(params, x, labels, nhwc)
+            new = jax.tree_util.tree_map(lambda p, gr: p - 0.1 * gr,
+                                         params, g)
         return l, new
 
     return step
@@ -164,7 +167,8 @@ def main():
         # ground truth: total DEVICE seconds of one step off the xplane
         # trace (wall clock carries ~100ms of dispatch+sync latency)
         from paddle_tpu.profiler import measure_device_seconds
-        dev_s = measure_device_seconds(run_once)
+        dev_s = measure_device_seconds(run_once,
+                                       scope="resnet_train_step")
 
         mfu = flops_fwd * 3 / dev_s / 197e12
         print(json.dumps({

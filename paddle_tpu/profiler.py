@@ -262,7 +262,14 @@ def device_busy_seconds(trace_dir):
     timeline is the line named 'XLA Ops' (span lines like 'Steps' /
     'XLA Modules' include on-device idle gaps, and 'Async XLA Ops' holds
     OVERLAPPING DMA copies whose durations multi-count wall time).  Falls
-    back to the max non-async line sum when no 'XLA Ops' line exists."""
+    back to the max non-async line sum when no 'XLA Ops' line exists.
+
+    SHARED-CHIP caveat (measured, exp_probe_trace.py): the device tracer
+    records EVERY program on the chip during the window — other tenants'
+    modules included — so this total can exceed your own program's time.
+    When that matters, wrap your computation in ``jax.named_scope`` and
+    use :func:`scope_device_seconds` / :func:`measure_device_seconds`
+    with ``scope=``, which foreign events cannot match."""
     busy = 0.0
     for plane in _iter_xplanes(trace_dir):
         if not plane.name.startswith("/device:"):
