@@ -32,21 +32,20 @@ with fluid.scope_guard(scope):
                   steps=STEPS)
     jax.profiler.stop_trace()
 
-from tensorflow.tsl.profiler.protobuf import xplane_pb2
-files = glob.glob(td + "/**/*.xplane.pb", recursive=True)
-print("xplane files:", len(files))
-for p in files:
-    xs = xplane_pb2.XSpace()
-    xs.ParseFromString(open(p, "rb").read())
-    for plane in xs.planes:
-        if not plane.name.startswith("/device:"):
+from paddle_tpu.profiler import _iter_xplanes
+print("xplane files:",
+      len(glob.glob(td + "/**/*.xplane.pb", recursive=True)))
+for plane in _iter_xplanes(td):
+    if not plane.name.startswith("/device:"):
+        continue
+    for line in plane.lines:
+        if not line.events:
             continue
-        for line in plane.lines:
-            if not line.events:
-                continue
-            total = sum(ev.duration_ps for ev in line.events)
-            t0 = min(ev.offset_ps for ev in line.events)
-            t1 = max(ev.offset_ps + ev.duration_ps for ev in line.events)
-            print(f"  {os.path.basename(p)[:20]} plane={plane.name} "
-                  f"line={line.name!r} n={len(line.events)} "
-                  f"sum={total/1e9:.1f}ms span={(t1-t0)/1e9:.1f}ms")
+        total = sum(ev.duration_ps for ev in line.events)
+        t0 = min(ev.offset_ps for ev in line.events)
+        t1 = max(ev.offset_ps + ev.duration_ps for ev in line.events)
+        print(f"  plane={plane.name} line={line.name!r} "
+              f"n={len(line.events)} sum={total/1e9:.1f}ms "
+              f"span={(t1-t0)/1e9:.1f}ms")
+import shutil
+shutil.rmtree(td, ignore_errors=True)

@@ -125,15 +125,15 @@ def multi_head_attention(queries, keys, values, d_key, d_value, d_model,
 
     # the VMEM-fused kernel wins once the [S,S] score tensor dominates HBM
     # traffic; crossover is workload-dependent, so the threshold is a knob
-    # (PADDLE_TPU_FLASH_MIN_S; default 512, measured v5e r4).  The
-    # ISOLATED kernel now beats composed XLA even at S=256 (single-pass
-    # small-S kernels, BENCH_ATTENTION.md), but IN-MODEL at S=256 the
-    # composed path still wins (bench A/B + per-op profile): the pallas
-    # custom call pins a [B,H,S,D] layout that costs ~15ms/step of HBM
-    # transposes which XLA otherwise folds into the projection matmuls,
-    # and the call boundary splits fusion clusters (~11ms extra matmul
-    # time) — more than the kernel's ~5ms advantage at D=64, where QK^T
-    # can at best half-fill the MXU's 128-deep systolic array.
+    # (PADDLE_TPU_FLASH_MIN_S; default 512 = the measured v5e DEVICE-time
+    # crossover, BENCH_ATTENTION.md r4: S=256 flash 0.73x of composed,
+    # S=512 1.42x, S=2048 2.77x, S=4096 composed OOMs).  At S=256 the
+    # composed path also wins IN-MODEL for extra reasons (bench A/B +
+    # per-op profile): the pallas custom call pins a [B,H,S,D] layout
+    # costing ~15ms/step of HBM transposes which XLA otherwise folds
+    # into the projection matmuls, and the call boundary splits fusion
+    # clusters (~11ms) — at D=64, QK^T can at best half-fill the MXU's
+    # 128-deep systolic array while the [S,S] round-trip is still cheap.
     import os
     flash_min_s = int(os.environ.get("PADDLE_TPU_FLASH_MIN_S", "512"))
     use_flash = use_flash and (k.shape[2] >= flash_min_s)
