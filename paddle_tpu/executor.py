@@ -572,7 +572,7 @@ class Executor:
         from paddle_tpu import profiler as _profiler
         interpret = _has_host_ops(
             block, dyn=_lod_buckets_enabled(program))
-        if interpret:
+        if interpret and not getattr(program, "expect_host_ops", False):
             _warn_host_op_cliff(program, block)
         interpret = interpret or _profiler.op_profiling_enabled()
 

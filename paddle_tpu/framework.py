@@ -427,6 +427,10 @@ class Program:
         # mixed precision (bf16 compute, f32 master weights).  None = defer
         # to the PADDLE_TPU_AMP env var; True/False = explicit per-program.
         self.amp = None
+        # programs that deliberately carry host ops (metrics, decoding,
+        # persistence) set this to suppress the host-op-cliff warning —
+        # it stays on for programs that hit the cliff unexpectedly
+        self.expect_host_ops = False
 
     # -- blocks ------------------------------------------------------------
     def global_block(self):
@@ -461,7 +465,6 @@ class Program:
         ``is_test`` attr (dropout/batch_norm behave in inference mode),
         mirroring reference ``Program.clone`` semantics."""
         p = Program.from_dict(self.to_dict())
-        p.random_seed = self.random_seed
         self._copy_param_attrs_to(p)
         if for_test:
             for blk in p.blocks:
@@ -526,13 +529,15 @@ class Program:
     def to_dict(self):
         return {"blocks": [b.to_dict() for b in self.blocks],
                 "random_seed": self.random_seed,
-                "amp": self.amp}
+                "amp": self.amp,
+                "expect_host_ops": self.expect_host_ops}
 
     @staticmethod
     def from_dict(d):
         p = Program()
         p.random_seed = d.get("random_seed", 0)
         p.amp = d.get("amp")
+        p.expect_host_ops = d.get("expect_host_ops", False)
         # create all blocks first so sub-block attrs can resolve
         for bd in d["blocks"][1:]:
             b = Block(p, bd["idx"], parent_idx=bd["parent_idx"])

@@ -15,7 +15,7 @@ from paddle_tpu.layers import nn
 __all__ = [
     "prior_box", "multi_box_head", "bipartite_match", "target_assign",
     "detection_output", "ssd_loss", "detection_map", "iou_similarity",
-    "box_coder", "roi_pool",
+    "box_coder", "roi_pool", "scale_sub_region",
 ]
 
 
@@ -354,3 +354,17 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     box.stop_gradient = True
     var.stop_gradient = True
     return mbox_locs, mbox_confs, box, var
+
+
+def scale_sub_region(x, indices, value=1.0):
+    """Scale a per-sample [C,H,W] sub-region of ``x`` [N,C,H,W] by
+    ``value``; ``indices`` [N, 6] holds one-based inclusive
+    (c0, c1, h0, h1, w0, w1) ranges (reference
+    ``gserver/layers/ScaleSubRegionLayer.cpp:1``)."""
+    helper = LayerHelper("scale_sub_region", **locals())
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(type="scale_sub_region",
+                     inputs={"X": x, "Indices": indices},
+                     outputs={"Out": out},
+                     attrs={"value": float(value)})
+    return out

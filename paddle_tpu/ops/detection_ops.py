@@ -792,3 +792,43 @@ def detection_map_lower(ctx):
                else np.zeros((0, 2), np.float32))
         ctx.set_output(slot, jnp.asarray(arr))
         ctx.set_output_lod(slot, [starts])
+
+
+# ---------------------------------------------------------------------------
+# scale_sub_region (reference gserver/layers/ScaleSubRegionLayer.cpp:1,
+# function/ScaleSubRegionOp.cpp:22 — legacy v2 only; no fluid op exists
+# upstream)
+# ---------------------------------------------------------------------------
+
+def _infer_scale_sub_region(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    out.shape = x.shape
+    out.dtype = x.dtype
+
+
+@register_op("scale_sub_region", infer_shape=_infer_scale_sub_region,
+             no_grad_inputs=("Indices",))
+def scale_sub_region_lower(ctx):
+    """Multiply a per-sample [C,H,W] sub-region of X by attr ``value``.
+
+    ``Indices`` is [N, 6]: one-based ranges ``(c0, c1, h0, h1, w0, w1)``,
+    inclusive on both ends (the reference iterates ``c = c0-1 .. c1-1``).
+    The reference's per-element CPU loop becomes a dense boolean mask from
+    three broadcasted aranges — one fused select on TPU, and the backward
+    (auto-vjp) is the same select applied to the cotangent.
+    """
+    x = ctx.input("X")                      # [N, C, H, W]
+    idx = ctx.input("Indices").astype(jnp.int32)
+    value = float(ctx.attr("value", 1.0))
+    _, c, h, w = x.shape
+
+    def in_range(size, lo, hi):             # [N, size]
+        r = jnp.arange(size)
+        return (r[None, :] >= (lo - 1)[:, None]) & \
+               (r[None, :] <= (hi - 1)[:, None])
+
+    mask = (in_range(c, idx[:, 0], idx[:, 1])[:, :, None, None]
+            & in_range(h, idx[:, 2], idx[:, 3])[:, None, :, None]
+            & in_range(w, idx[:, 4], idx[:, 5])[:, None, None, :])
+    ctx.set_output("Out", jnp.where(mask, x * value, x))

@@ -52,6 +52,7 @@ __all__ = [
     "pad_layer", "conv_shift_layer", "block_expand_layer", "maxout_layer",
     "multiplex_layer", "prelu_layer", "gated_unit_layer",
     "switch_order_layer", "crop_layer", "clip_layer", "resize_layer",
+    "row_conv_layer", "scale_sub_region_layer",
     "scale_shift_layer", "factorization_machine", "upsample_layer",
     # norm
     "sum_to_one_norm_layer", "row_l2_norm_layer", "img_cmrnorm_layer",
@@ -1152,6 +1153,29 @@ def crop_layer(input, offset, axis=2, shape=None, name=None,
 
 def clip_layer(input, min, max, name=None):
     return _named(F.clip(input, min=min, max=max), name)
+
+
+def row_conv_layer(input, context_len, act=None, name=None, param_attr=None,
+                   layer_attr=None):
+    """Lookahead (row) convolution (reference ``layers.py:6690`` over
+    ``gserver/layers/RowConvLayer.cpp``); ``context_len`` is the lookahead
+    step count plus one.  Shim over the fluid op
+    (``ops/sequence_ops.py`` row_conv)."""
+    out = F.row_conv(input, future_context_size=context_len - 1,
+                     param_attr=param_attr)
+    return _named(_apply_act(out, act), name)
+
+
+def scale_sub_region_layer(input, indices, value, name=None):
+    """Multiply a per-sample sub-region by ``value`` (reference
+    ``layers.py:7493`` over ``gserver/layers/ScaleSubRegionLayer.cpp``).
+    ``input`` is a dense [N, C, H, W] variable (the legacy flattened
+    row-vector + frame-size convention is replaced by real shapes);
+    ``indices`` [N, 6] holds one-based inclusive
+    (c0, c1, h0, h1, w0, w1) ranges."""
+    from paddle_tpu.layers.detection import scale_sub_region
+    return _named(scale_sub_region(input, indices, value=float(value)),
+                  name)
 
 
 def resize_layer(input, size, name=None):

@@ -65,6 +65,11 @@ class OpTest:
             op_outputs.setdefault(slot, []).append(name)
         block.append_op(type=self.op_type, inputs=op_inputs,
                         outputs=op_outputs, attrs=dict(self.attrs))
+        # testing a host op IS the point here — don't warn about the cliff
+        from paddle_tpu.ops import registry as _registry
+        opdef = _registry.lookup(self.op_type)
+        if opdef is not None and opdef.host:
+            program.expect_host_ops = True
         return program, feed
 
     # -- forward check -----------------------------------------------------

@@ -360,3 +360,33 @@ class TestSSDHeadTraining:
             losses.append(float(np.asarray(lv).reshape(-1)[0]))
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0] * 0.8, losses
+
+
+class TestScaleSubRegionOp(OpTest):
+    """Mirrors reference function/ScaleSubRegionOpTest.cpp +
+    test_scale_sub_region_layer config test: one-based inclusive CHW
+    ranges, region scaled by ``value``, identity elsewhere."""
+    op_type = "scale_sub_region"
+
+    def setup(self):
+        rng = np.random.RandomState(7)
+        x = rng.rand(3, 4, 5, 6).astype("float32")
+        idx = np.array([[1, 2, 1, 3, 2, 4],
+                        [2, 4, 2, 5, 1, 6],
+                        [3, 3, 1, 1, 1, 1]], np.float32)
+        value = 2.5
+        out = x.copy()
+        for n in range(3):
+            c0, c1, h0, h1, w0, w1 = idx[n].astype(int)
+            out[n, c0 - 1:c1, h0 - 1:h1, w0 - 1:w1] *= value
+        self.inputs = {"X": x, "Indices": idx}
+        self.outputs = {"Out": out}
+        self.attrs = {"value": value}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out")

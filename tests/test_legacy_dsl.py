@@ -786,3 +786,50 @@ class TestProjectionWeightSharing:
                     {"img": iv, "filt": np.ones((1, 1), "f")}, [out.name])
         np.testing.assert_allclose(np.asarray(o), iv.reshape(2, 16),
                                    rtol=1e-6)
+
+
+class TestRowConvAndScaleSubRegionShims:
+    def test_row_conv_layer(self):
+        """DSL shim over the fluid row_conv op (reference layers.py:6690);
+        context_len = lookahead + 1, out[t] = sum_j w[j] * x[t+j]."""
+        from paddle_tpu.initializer import NumpyArrayInitializer
+        from paddle_tpu.param_attr import ParamAttr
+        rng = np.random.RandomState(3)
+        wv = rng.rand(3, 4).astype("float32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = tch.data_layer("x", 4, type=dt.dense_vector_sequence(4))
+            out = tch.row_conv_layer(
+                x, context_len=3,
+                param_attr=ParamAttr(initializer=NumpyArrayInitializer(wv)))
+        xv = rng.rand(7, 4).astype("float32")
+        lod = [[0, 4, 7]]
+        (o,) = _run(main, startup, {"x": (xv, lod)}, [out.name])
+        want = np.zeros_like(xv)
+        for lo, hi in [(0, 4), (4, 7)]:
+            for t in range(lo, hi):
+                for j in range(3):
+                    if t + j < hi:
+                        want[t] += wv[j] * xv[t + j]
+        np.testing.assert_allclose(np.asarray(o), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_scale_sub_region_layer(self):
+        """DSL shim over the scale_sub_region op (reference
+        layers.py:7493 / ScaleSubRegionLayer.cpp)."""
+        rng = np.random.RandomState(4)
+        xv = rng.rand(2, 2, 3, 3).astype("float32")
+        idx = np.array([[1, 1, 1, 2, 1, 3],
+                        [2, 2, 2, 3, 2, 2]], np.float32)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = F.data(name="x", shape=[2, 2, 3, 3],
+                       append_batch_size=False)
+            ind = F.data(name="ind", shape=[2, 6],
+                         append_batch_size=False)
+            out = tch.scale_sub_region_layer(x, ind, value=3.0)
+        (o,) = _run(main, startup, {"x": xv, "ind": idx}, [out.name])
+        want = xv.copy()
+        want[0, 0:1, 0:2, 0:3] *= 3.0
+        want[1, 1:2, 1:3, 1:2] *= 3.0
+        np.testing.assert_allclose(np.asarray(o), want, rtol=1e-6)
