@@ -9,25 +9,38 @@ beyond-reference row.  Design:
   weight them with the same analytic FLOP model the benchmarks use
   (conv/matmul dominate), and cut at the P-quantiles of cumulative
   cost.  Any cut is legal: everything produced before the cut and
-  consumed after it becomes part of the boundary *carrier*.
+  consumed after it becomes part of the boundary *carrier*.  Ops that
+  carry sub-blocks (while/cond/DynamicRNN) are atomic — they are never
+  split across a cut, and their lowerings recurse into their sub-block
+  the same way the executor's ``lower_block`` does.
 * Stages are NON-homogeneous (different ops, params, shapes).  Each
-  stage's parameters are flat-packed into one f32 vector; the P vectors
-  are padded to a common length and stacked [P, Lp] — sharded over the
-  ``pipe`` mesh axis, so each device stores only its own stage's
-  weights.  Inside ``shard_map`` a ``lax.switch`` on the device's stage
-  index unpacks its slice and runs its stage's traced IR ops.
-* Activations/feeds cross boundaries the same way: a flat f32 carrier
-  of uniform (max-boundary) length.  Integer feeds ride the carrier as
-  exact f32 (vocab ids < 2^24).
+  stage's parameters are flat-packed into TYPED LANES — one flat vector
+  per dtype class (``f32``, ``bf16``, ``i32``) — padded to a common
+  per-lane length and stacked [P, L_lane], sharded over the ``pipe``
+  mesh axis so each device stores only its own stage's weights.  Inside
+  ``shard_map`` a ``lax.switch`` on the device's stage index unpacks
+  its slices and runs its stage's traced IR ops.
+* Activations/feeds cross boundaries the same way: one flat carrier per
+  lane of uniform (max-boundary) length.  Integer values ride the i32
+  lane EXACTLY (the r4 design packed them as f32, silently rounding
+  ids >= 2^24); bf16 values keep bf16 width on the wire; floats ride
+  f32.  Lanes that no boundary/parameter uses are dropped from the
+  pytree, so ``jax.grad`` over the packed params needs ``allow_int``
+  only when an integer parameter actually exists.
 * Microbatches feed STAGE 0 ONLY (the refinement pipeline.py:70-73
-  names): the [M, L0] ingest tensor is sharded over ``pipe`` in
-  contiguous blocks of B = M/P; after every B ticks the local blocks
+  names): the per-lane [M, L] ingest tensors are sharded over ``pipe``
+  in contiguous blocks of B = M/P; after every B ticks the local blocks
   rotate one hop toward stage 0 on the ICI ring, arriving exactly when
   stage 0 needs them — devices never hold the full microbatch set.
 * The whole schedule is differentiable: ``jax.grad`` w.r.t. the packed
-  [P, Lp] buffer yields the reverse pipeline, and ``unpack_grads``
-  scatters it back to named parameters (parameters used by several
-  stages get their contributions summed).
+  lane dict yields the reverse pipeline, and ``unpack_grads`` scatters
+  it back to named parameters (parameters used by several stages get
+  their contributions summed).
+* AMP: the stage branches honor the program's mixed-precision flag
+  (``Program.amp``), so a bf16-AMP program pipelines with the same op-
+  level cast discipline as the executor.  A boundary cut inserts an
+  exact bf16→f32→bf16 round-trip for values that are bf16 at runtime
+  (value-preserving; see test_pipeline_transpiler.py AMP parity).
 """
 
 from __future__ import annotations
@@ -50,6 +63,28 @@ except ImportError:  # pragma: no cover
 __all__ = ["pipeline_transpiler", "PipelinedProgram"]
 
 _SKIP = ("feed", "fetch")
+
+_LANE_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16, "i32": jnp.int32}
+
+
+def _lane_of(dtype):
+    """Which carrier lane a dtype rides: bf16 keeps its width, other
+    floats ride f32 (f16 upcast losslessly; f64 is already f32 under
+    JAX's default x64-off), ints/bools ride i32 exactly."""
+    name = str(np.dtype(dtype).name) if not isinstance(dtype, str) \
+        else dtype
+    if name == "bfloat16":
+        return "bf16"
+    if name.startswith("float"):
+        return "f32"
+    return "i32"
+
+
+def _np_dtype(dtype):
+    """np dtype for restore; 'bfloat16' restores via jnp."""
+    if str(dtype) == "bfloat16":
+        return jnp.bfloat16
+    return np.dtype(dtype)
 
 
 def _op_cost(op, block):
@@ -74,28 +109,40 @@ def _op_cost(op, block):
             return 4 * b * h * s * s * d
     except Exception:
         pass
-    return 1
+    # sub-block ops (while/cond/DynamicRNN) are atomic: weigh them by
+    # their body so the quantile cuts see the FLOPs inside
+    inner = sum(_op_cost(sub, blk)
+                for blk in _sub_blocks(op) for sub in blk.ops)
+    return 1 + inner
 
 
-def _all_input_names(op):
-    return [n for vs in op.inputs.values() for n in vs]
+def _all_input_names(op, recurse=False):
+    names = [n for vs in op.inputs.values() for n in vs]
+    if recurse:
+        for blk in _sub_blocks(op):
+            for sub in blk.ops:
+                names += _all_input_names(sub, recurse=True)
+    return names
 
 
-def _all_output_names(op):
-    return [n for vs in op.outputs.values() for n in vs]
+def _all_output_names(op, recurse=False):
+    names = [n for vs in op.outputs.values() for n in vs]
+    if recurse:
+        for blk in _sub_blocks(op):
+            for sub in blk.ops:
+                names += _all_output_names(sub, recurse=True)
+    return names
+
+
+def _sub_blocks(op):
+    return [a for a in op.attrs.values()
+            if a.__class__.__name__ == "Block"]
 
 
 def split_program(program, n_stages, feed_names, fetch_names):
     """Balanced cut points + per-stage op/param/boundary metadata."""
     block = program.global_block()
     ops = [op for op in block.ops if op.type not in _SKIP]
-    for op in ops:
-        for a in op.attrs.values():
-            if a.__class__.__name__ == "Block":
-                raise ValueError(
-                    f"pipeline_transpiler: op {op.type!r} carries a "
-                    f"sub-block; control flow inside a pipelined program "
-                    f"is not supported — pipeline the flat region only")
 
     costs = [_op_cost(op, block) for op in ops]
     total = float(sum(costs))
@@ -125,11 +172,13 @@ def split_program(program, n_stages, feed_names, fetch_names):
             for n in _all_output_names(op):
                 produced_by.setdefault(n, s)
 
+    # sub-block ops are atomic; their inner reads of outer params/vars
+    # count toward the owning stage (recurse=True)
     stage_params = []
     for sops in stage_ops:
         names = []
         for op in sops:
-            for n in _all_input_names(op):
+            for n in _all_input_names(op, recurse=True):
                 if is_param(n) and n not in names:
                     names.append(n)
         stage_params.append(names)
@@ -144,11 +193,14 @@ def split_program(program, n_stages, feed_names, fetch_names):
         need = set()
         for n in fetch_names:
             src = produced_by.get(n)
-            if b == n_stages or (src is not None and src < b):
+            # a fetched feed (src None) must ride EVERY boundary — no
+            # stage re-produces it, wherever its consumers sit
+            if b == n_stages or (src is not None and src < b) or \
+                    (src is None and n in feed_set):
                 need.add(n)
         for s in range(b, n_stages):
             for op in stage_ops[s]:
-                for n in _all_input_names(op):
+                for n in _all_input_names(op, recurse=True):
                     if is_param(n):
                         continue
                     src = produced_by.get(n)
@@ -156,61 +208,104 @@ def split_program(program, n_stages, feed_names, fetch_names):
                             (src is not None and src < b):
                         need.add(n)
         boundaries.append(sorted(need))
+
+    # carriers are flat dense vectors; a TensorArray (or reader/channel)
+    # cannot cross a cut.  The cut placement is cost-driven, so reject
+    # loudly with the remedy instead of crashing in _Layout.pack.
+    for b, names in enumerate(boundaries[1:-1], start=1):
+        for n in names:
+            v = block.var(n) if n in block.vars else None
+            vtype = getattr(v, "type", None)
+            if vtype in ("tensor_array", "reader", "channel"):
+                raise ValueError(
+                    f"pipeline_transpiler: the cut before stage {b} "
+                    f"would carry {n!r} (a {vtype}) across the "
+                    f"boundary; keep its producers and consumers in "
+                    f"one stage — fewer stages, or hoist the "
+                    f"control-flow region so the quantile cut lands "
+                    f"outside it")
     return block, stage_ops, stage_params, boundaries
 
 
 class _Layout:
-    """Flat-packing layout for a list of named tensors."""
+    """Typed flat-packing layout for a list of named tensors: one flat
+    vector per dtype lane (f32 / bf16 / i32); ``pack`` -> {lane: vec},
+    ``unpack`` restores original dtypes/shapes."""
 
     def __init__(self, names, shapes, dtypes):
         self.names = list(names)
         self.shapes = [tuple(s) for s in shapes]
         self.dtypes = list(dtypes)
+        self.lanes = [_lane_of(d) for d in self.dtypes]
         self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
-        self.offsets = np.cumsum([0] + self.sizes).tolist()
-        self.length = self.offsets[-1]
+        self.offsets = []          # per-name offset within its lane
+        self.lengths = {}          # lane -> total length
+        for lane, size in zip(self.lanes, self.sizes):
+            self.offsets.append(self.lengths.get(lane, 0))
+            self.lengths[lane] = self.lengths.get(lane, 0) + size
 
-    def pack(self, values):
-        flats = [jnp.ravel(values[n]).astype(jnp.float32)
-                 for n in self.names]
-        if not flats:
-            return jnp.zeros((0,), jnp.float32)
-        return jnp.concatenate(flats)
+    def pack(self, values, lanes):
+        """values {name: array} -> {lane: flat vec} over ``lanes``."""
+        flats = {lane: [] for lane in lanes}
+        for n, lane in zip(self.names, self.lanes):
+            flats[lane].append(
+                jnp.ravel(values[n]).astype(_LANE_DTYPES[lane]))
+        return {
+            lane: (jnp.concatenate(fs) if fs
+                   else jnp.zeros((0,), _LANE_DTYPES[lane]))
+            for lane, fs in flats.items()}
 
-    def unpack(self, vec):
+    def unpack(self, vecs):
+        """{lane: vec} -> {name: array} with original dtype/shape."""
         out = {}
-        for n, shape, dtype, off, size in zip(
-                self.names, self.shapes, self.dtypes, self.offsets,
-                self.sizes):
-            out[n] = jax.lax.slice(vec, (off,), (off + size,)) \
-                .reshape(shape).astype(dtype)
+        for n, shape, dtype, lane, off, size in zip(
+                self.names, self.shapes, self.dtypes, self.lanes,
+                self.offsets, self.sizes):
+            out[n] = jax.lax.slice(vecs[lane], (off,), (off + size,)) \
+                .reshape(shape).astype(_np_dtype(dtype))
         return out
 
 
+def _pad_lanes(vecs, lengths):
+    return {
+        lane: (jnp.pad(v, (0, lengths[lane] - v.shape[0]))
+               if v.shape[0] < lengths[lane] else v)
+        for lane, v in vecs.items()}
+
+
 class PipelinedProgram:
-    """A Program split into P pipeline stages; call :meth:`run` (or
-    differentiate :meth:`loss_fn`) with per-microbatch feeds."""
+    """A Program split into P pipeline stages; call :meth:`run_fn` (or
+    differentiate through it) with per-microbatch feeds."""
 
     def __init__(self, program, n_stages, feed_names, fetch_names, mesh,
                  axis="pipe"):
         from paddle_tpu.ops import registry as _registry
+        from paddle_tpu.executor import _amp_enabled
         self._registry = _registry
         self.mesh = mesh
         self.axis = axis
         self.n_stages = n_stages
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
+        self.amp = _amp_enabled(program)
         (self.block, self.stage_ops, self.stage_param_names,
          self.boundaries) = split_program(program, n_stages, feed_names,
                                           fetch_names)
+
+        def check_rng(op):
+            opdef = _registry.lookup(op.type)
+            if opdef is not None and opdef.uses_rng:
+                raise ValueError(
+                    f"pipeline_transpiler: op {op.type!r} uses the "
+                    f"rng stream; run with dropout/sampling disabled "
+                    f"in the pipelined region")
+            for blk in _sub_blocks(op):
+                for sub in blk.ops:
+                    check_rng(sub)
+
         for sops in self.stage_ops:
             for op in sops:
-                opdef = _registry.lookup(op.type)
-                if opdef is not None and opdef.uses_rng:
-                    raise ValueError(
-                        f"pipeline_transpiler: op {op.type!r} uses the "
-                        f"rng stream; run with dropout/sampling disabled "
-                        f"in the pipelined region")
+                check_rng(op)
 
     # -- layouts (need var shapes; resolved against scope values) -------
     def _var_meta(self, name, scope_vals):
@@ -221,8 +316,7 @@ class PipelinedProgram:
         if v is None or v.shape is None:
             raise ValueError(f"pipeline_transpiler: no shape for {name!r}")
         shape = tuple(int(d) for d in v.shape)
-        return shape, np.dtype(v.dtype if v.dtype != "bfloat16"
-                               else np.float32)
+        return shape, v.dtype
 
     def build(self, scope, microbatch_feeds):
         """Finalize layouts from the startup-initialized ``scope`` and a
@@ -238,7 +332,7 @@ class PipelinedProgram:
             param_values.append(vals)
 
         self._carrier_layouts = []
-        for b, names in enumerate(self.boundaries):
+        for names in self.boundaries:
             shapes, dtypes = [], []
             for n in names:
                 if n in sample:
@@ -249,86 +343,150 @@ class PipelinedProgram:
                     shapes.append(s)
                     dtypes.append(d)
             self._carrier_layouts.append(_Layout(names, shapes, dtypes))
-        self.carrier_len = max(l.length for l in self._carrier_layouts)
-        self.param_len = max((l.length for l in self._param_layouts),
-                             default=0)
-        # packed parameter buffer [P, Lp]
-        rows = []
+
+        # active lanes: fixed pytree structure across boundaries/stages
+        self.carrier_lanes = tuple(
+            lane for lane in _LANE_DTYPES
+            if any(lay.lengths.get(lane) for lay in self._carrier_layouts))
+        if not self.carrier_lanes:
+            self.carrier_lanes = ("f32",)
+        self.param_lanes = tuple(
+            lane for lane in _LANE_DTYPES
+            if any(lay.lengths.get(lane) for lay in self._param_layouts))
+        if not self.param_lanes:
+            self.param_lanes = ("f32",)
+        self.carrier_len = {
+            lane: max(lay.lengths.get(lane, 0)
+                      for lay in self._carrier_layouts)
+            for lane in self.carrier_lanes}
+        self.param_len = {
+            lane: max(lay.lengths.get(lane, 0)
+                      for lay in self._param_layouts)
+            for lane in self.param_lanes}
+
+        # packed parameter buffers {lane: [P, L_lane]}
+        rows = {lane: [] for lane in self.param_lanes}
         for lay, vals in zip(self._param_layouts, param_values):
-            vec = np.zeros(self.param_len, np.float32)
-            flat = np.concatenate(
-                [np.asarray(vals[n], np.float32).ravel()
-                 for n in lay.names]) if lay.names else \
-                np.zeros(0, np.float32)
-            vec[:flat.size] = flat
-            rows.append(vec)
-        self.packed_params = jnp.asarray(np.stack(rows))
+            vecs = lay.pack(vals, self.param_lanes)
+            padded = _pad_lanes(vecs, self.param_len)
+            for lane in self.param_lanes:
+                rows[lane].append(np.asarray(padded[lane]))
+        self.packed_params = {
+            lane: jnp.asarray(np.stack(rows[lane]))
+            for lane in self.param_lanes}
         return self
 
     def pack_microbatch(self, feed):
+        """feed dict -> {lane: [L_lane]} carrier for boundary 0."""
         lay = self._carrier_layouts[0]
-        vec = lay.pack({k: jnp.asarray(v) for k, v in feed.items()})
-        pad = self.carrier_len - lay.length
-        return jnp.pad(vec, (0, pad)) if pad else vec
+        vecs = lay.pack({k: jnp.asarray(v) for k, v in feed.items()},
+                        self.carrier_lanes)
+        return _pad_lanes(vecs, self.carrier_len)
 
-    def unpack_outputs(self, vec):
+    def stack_microbatches(self, feeds):
+        """[feed dicts] -> {lane: [M, L_lane]} ingest tensors."""
+        packed = [self.pack_microbatch(f) for f in feeds]
+        return {lane: jnp.stack([p[lane] for p in packed])
+                for lane in self.carrier_lanes}
+
+    def unpack_outputs(self, vecs):
+        """One final-boundary carrier {lane: [L_lane]} -> fetch dict."""
         lay = self._carrier_layouts[-1]
-        return lay.unpack(vec[:lay.length])
+        return lay.unpack({lane: vecs[lane][:lay.lengths.get(lane, 0)]
+                           for lane in self.carrier_lanes})
+
+    def select_fetch(self, outs, name):
+        """{lane: [M, L]} stacked outputs -> [M, ...] values of one
+        fetch target (lane-aware replacement for manual offset math)."""
+        lay = self._carrier_layouts[-1]
+        i = lay.names.index(name)
+        lane, off, size = lay.lanes[i], lay.offsets[i], lay.sizes[i]
+        sl = outs[lane][:, off:off + size]
+        return sl.reshape((sl.shape[0],) + lay.shapes[i]) \
+            .astype(_np_dtype(lay.dtypes[i]))
 
     def unpack_grads(self, packed_grads):
-        """[P, Lp] grads -> {param_name: grad} (multi-stage placements
-        summed)."""
+        """{lane: [P, L]} grads -> {param_name: grad} (multi-stage
+        placements summed; integer-lane cotangents — float0 under
+        ``jax.grad(..., allow_int=True)`` — are skipped)."""
         out = {}
-        g = np.asarray(packed_grads)
         for s, lay in enumerate(self._param_layouts):
-            vals = lay.unpack(jnp.asarray(g[s][:lay.length]))
-            for n, v in vals.items():
+            for n, shape, dtype, lane, off, size in zip(
+                    lay.names, lay.shapes, lay.dtypes, lay.lanes,
+                    lay.offsets, lay.sizes):
+                if lane == "i32":
+                    continue
+                g = packed_grads.get(lane)
+                if g is None:
+                    continue
+                ga = np.asarray(g[s])
+                if ga.dtype == object or ga.size == 0:  # float0 / empty
+                    continue
+                v = ga[off:off + size].reshape(shape)
                 out[n] = out.get(n, 0) + np.asarray(v, np.float64)
         return out
 
     # -- stage functions ------------------------------------------------
     def _stage_branch(self, s):
-        """carrier [L] -> carrier [L] for stage ``s``, given its packed
-        param vector; traced IR ops via the op registry."""
+        """carrier {lane: [L]} -> carrier {lane: [L]} for stage ``s``,
+        given its packed param vectors; traced IR ops via the op
+        registry (sub-block ops recurse through executor.lower_block)."""
         in_lay = self._carrier_layouts[s]
         out_lay = self._carrier_layouts[s + 1]
         p_lay = self._param_layouts[s]
         ops = self.stage_ops[s]
         registry = self._registry
         block = self.block
+        amp = self.amp
+        carrier_lanes = self.carrier_lanes
+        carrier_len = self.carrier_len
 
-        def branch(pvec, carrier):
-            env = p_lay.unpack(pvec[:p_lay.length] if p_lay.length
-                               else pvec[:0])
-            env.update(in_lay.unpack(carrier[:in_lay.length]))
-            aux = {"rng_counter": 0, "amp": False, "interpret": False,
-                   "lod": {}, "block": block}
+        def branch(pvecs, carrier):
+            env = p_lay.unpack(
+                {lane: pvecs.get(lane, jnp.zeros((0,),
+                                                 _LANE_DTYPES[lane]))
+                 [:p_lay.lengths.get(lane, 0)]
+                 for lane in set(p_lay.lanes)})
+            env.update(in_lay.unpack(
+                {lane: carrier[lane][:in_lay.lengths.get(lane, 0)]
+                 for lane in set(in_lay.lanes)}))
+            from paddle_tpu.executor import lower_block
+            aux = {"rng_counter": 0, "amp": amp, "interpret": False,
+                   "lod": {}, "block": block, "lower_block": lower_block}
             for op in ops:
                 opdef = registry.resolve_lowering(op.type)
                 ctx = registry.LowerContext(op, env, block, rng_key=None,
                                             training=True, aux=aux)
                 opdef.lower(ctx)
                 env.update(ctx.outputs)
-            out = out_lay.pack(env)
-            pad = self.carrier_len - out_lay.length
-            return jnp.pad(out, (0, pad)) if pad else out
+            out = out_lay.pack(env, carrier_lanes)
+            return _pad_lanes(out, carrier_len)
 
         return branch
 
     # -- the pipelined schedule ----------------------------------------
-    def run_fn(self):
-        """Returns ``fn(packed_params [P, Lp], xs [M, L]) -> [M, L]``
-        (final-boundary carriers per microbatch), jit/grad-able."""
+    def run_fn(self, data_axis=None):
+        """Returns ``fn(packed_params {lane: [P, Lp]}, xs {lane: [M, L]})
+        -> {lane: [M, L]}`` (final-boundary carriers per microbatch),
+        jit/grad-able (``allow_int=True`` if an integer param exists).
+
+        ``data_axis``: optional mesh axis name for dp x pp composition —
+        microbatches are sharded over ``(data_axis, pipe_axis)`` and each
+        data row runs an independent pipeline over its own microbatch
+        block (params replicated across rows); outputs come back stacked
+        in global microbatch order."""
         P = self.n_stages
         axis = self.axis
         mesh = self.mesh
         branches = [self._stage_branch(s) for s in range(P)]
+        lanes = self.carrier_lanes
         L = self.carrier_len
 
         def per_device(params_local, xs_local):
             my_stage = jax.lax.axis_index(axis)
-            pvec = params_local[0]
-            B = xs_local.shape[0]          # M / P ingest block
+            pvecs = {lane: params_local[lane][0]
+                     for lane in params_local}
+            B = next(iter(xs_local.values())).shape[0]  # M / P block
             M = B * P
             n_ticks = M + P - 1
             outer = math.ceil(n_ticks / B)
@@ -337,33 +495,46 @@ class PipelinedProgram:
 
             def run_stage(carrier):
                 return jax.lax.switch(
-                    my_stage, [lambda c, b=b: b(pvec, c)
+                    my_stage, [lambda c, b=b: b(pvecs, c)
                                for b in branches], carrier)
 
             def tick(t, state):
                 buf, received, outputs = state
                 mb_idx = t - my_stage
                 active = (mb_idx >= 0) & (mb_idx < M)
-                fresh = jax.lax.dynamic_index_in_dim(
-                    buf, jnp.mod(t, B), axis=0, keepdims=False)
-                inp = jnp.where(my_stage == 0, fresh, received)
+                fresh = {
+                    lane: jax.lax.dynamic_index_in_dim(
+                        buf[lane], jnp.mod(t, B), axis=0, keepdims=False)
+                    for lane in lanes}
+                inp = {lane: jnp.where(my_stage == 0, fresh[lane],
+                                       received[lane])
+                       for lane in lanes}
                 # double-where: bubble ticks must not FEED garbage into
                 # the stage — a zero carrier can produce inf/nan (e.g. a
                 # loss normalizer dividing by a zero token count) whose
                 # cotangent poisons the masked output's gradient
-                inp = jnp.where(active, inp, jnp.ones_like(inp))
+                inp = {lane: jnp.where(active, v, jnp.ones_like(v))
+                       for lane, v in inp.items()}
                 out = run_stage(inp)
-                out = jnp.where(active, out, jnp.zeros_like(out))
+                out = {lane: jnp.where(active, v, jnp.zeros_like(v))
+                       for lane, v in out.items()}
                 outputs = jax.lax.cond(
                     active & (my_stage == P - 1),
-                    lambda o: jax.lax.dynamic_update_index_in_dim(
-                        o, out, jnp.clip(mb_idx, 0, M - 1), axis=0),
+                    lambda o: {
+                        lane: jax.lax.dynamic_update_index_in_dim(
+                            o[lane], out[lane],
+                            jnp.clip(mb_idx, 0, M - 1), axis=0)
+                        for lane in lanes},
                     lambda o: o, outputs)
-                received = jax.lax.ppermute(out, axis, perm_fwd)
+                received = {
+                    lane: jax.lax.ppermute(out[lane], axis, perm_fwd)
+                    for lane in lanes}
                 return buf, received, outputs
 
-            received = jnp.zeros((L,), jnp.float32)
-            outputs = jnp.zeros((M, L), jnp.float32)
+            received = {lane: jnp.zeros((L[lane],), _LANE_DTYPES[lane])
+                        for lane in lanes}
+            outputs = {lane: jnp.zeros((M, L[lane]), _LANE_DTYPES[lane])
+                       for lane in lanes}
             buf = xs_local
             t0 = 0
             for _ in range(outer):
@@ -374,13 +545,22 @@ class PipelinedProgram:
                 # rotate ingest blocks one hop toward stage 0: after k
                 # rotations device 0 holds block k, exactly when ticks
                 # [kB, (k+1)B) consume it
-                buf = jax.lax.ppermute(buf, axis, perm_ingest)
+                buf = {lane: jax.lax.ppermute(buf[lane], axis,
+                                              perm_ingest)
+                       for lane in lanes}
                 t0 += B
-            return jax.lax.psum(outputs, axis)
+            return {lane: jax.lax.psum(outputs[lane], axis)
+                    for lane in lanes}
 
         from jax.sharding import PartitionSpec as PS
+        mb_axes = (data_axis, axis) if data_axis else axis
+        param_specs = {lane: PS(axis) for lane in self.param_lanes}
+        xs_specs = {lane: PS(mb_axes) for lane in lanes}
+        out_specs = {lane: PS(data_axis) if data_axis else PS()
+                     for lane in lanes}
         fn = shard_map(per_device, mesh=mesh,
-                       in_specs=(PS(axis), PS(axis)), out_specs=PS(),
+                       in_specs=(param_specs, xs_specs),
+                       out_specs=out_specs,
                        **{_SM_CHECK_KW: False})
         return fn
 
