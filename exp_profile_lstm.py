@@ -1,0 +1,47 @@
+"""Device-time decomposition of the stacked-LSTM batch (r5): wall vs
+device, per-IR-op table — where do 151 ms/batch go?"""
+import os
+import tempfile
+import time
+
+os.environ["PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION"] = "python"
+
+import numpy as np
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import profiler
+from paddle_tpu.models.stacked_lstm import stacked_lstm_net, fake_batch
+
+DICT, EMB, HIDDEN, LAYERS, BATCH, SEQ = 30000, 512, 256, 2, 64, 100
+N = 8
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    avg_cost, acc, _ = stacked_lstm_net(DICT, emb_dim=EMB,
+                                        hidden_dim=HIDDEN, n_layers=LAYERS)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+main.lod_buckets = True
+
+feeds = [fake_batch(BATCH, SEQ, DICT, seed=i) for i in range(N)]
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe = fluid.Executor()
+    exe.run(startup)
+    for b in feeds:
+        exe.run(main, feed=b, fetch_list=[avg_cost.name])
+    t0 = time.perf_counter()
+    for b in feeds:
+        exe.run(main, feed=b, fetch_list=[avg_cost.name])
+    wall = (time.perf_counter() - t0) / N
+    td = tempfile.mkdtemp(prefix="lstmprof_")
+    jax.profiler.start_trace(td)
+    for b in feeds:
+        exe.run(main, feed=b, fetch_list=[avg_cost.name])
+    jax.profiler.stop_trace()
+    dev = profiler.scope_device_seconds(td, "ptop_") / N
+    _, rows = profiler.compiled_op_table(td)
+    print(f"wall {wall * 1e3:.1f} ms/batch   device(ptop) "
+          f"{dev * 1e3:.1f} ms/batch")
+    for op, calls, sec in rows[:14]:
+        print(f"  {op:30s} {calls:6d} {sec * 1e3 / N:9.3f} ms/batch")

@@ -346,13 +346,65 @@ class Executor:
         device = self._feed_device()
         per_step_feed = {}
         const_feed = {}
+
+        def is_lod_pair(v):
+            return isinstance(v, tuple) and len(v) == 2 and \
+                isinstance(v[1], (list, tuple))
+
         for name, value in feed.items():
-            if isinstance(value, tuple) and len(value) == 2 and \
-                    isinstance(value[1], (list, tuple)):
+            if isinstance(value, list) and value and \
+                    all(is_lod_pair(v) for v in value):
+                # per-step ragged batches: bucketed mode pads the whole
+                # window to ONE bucket signature and threads the
+                # row-splits through the device-side loop as data — the
+                # streaming-LoD counterpart of the stacked dense feed
+                if not _lod_buckets_enabled(program):
+                    raise ValueError(
+                        f"run_steps got per-step LoD feeds for {name!r}; "
+                        f"enable bucketed mode (program.lod_buckets = "
+                        f"True) so the window shares one executable")
+                if len(value) != steps:
+                    raise ValueError(
+                        f"run_steps: {name!r} has {len(value)} ragged "
+                        f"batches for {steps} steps")
+                from paddle_tpu.lod import (bucket_ragged_feed,
+                                            next_bucket, SPLITS_SUFFIX)
+                var = block.var(name) if block.has_var(name) else None
+                dtype = var.dtype if var is not None else None
+                rows = [np.asarray(v[0]).shape[0] for v in value]
+                mls = []
+                n_seqs = set()
+                for _, lod in value:
+                    sp = np.asarray(lod[-1], np.int64)
+                    lens = sp[1:] - sp[:-1]
+                    mls.append(int(lens.max()) if len(lens) else 0)
+                    n_seqs.add(len(sp) - 1)
+                if len(n_seqs) != 1:
+                    raise ValueError(
+                        f"run_steps: {name!r} batches disagree on "
+                        f"sequence count {sorted(n_seqs)}")
+                nb = next_bucket(max(max(rows), 1))
+                tb = next_bucket(max(max(mls), 1))
+                padded_steps, splits_steps = [], []
+                meta = None
+                for v, lod in value:
+                    padded, splits, meta = bucket_ragged_feed(
+                        name, np.asarray(v), lod, n_bucket=nb,
+                        t_bucket=tb)
+                    padded_steps.append(padded)
+                    splits_steps.append(splits)
+                per_step_feed[name] = _as_device_array(
+                    np.stack(padded_steps), dtype, device)
+                per_step_feed[name + SPLITS_SUFFIX] = _as_device_array(
+                    np.stack(splits_steps), "int32", device)
+                scope.set_lod(name, meta)
+                continue
+            if is_lod_pair(value):
                 raise ValueError(
-                    f"run_steps does not support LoD feeds (got one for "
-                    f"{name!r}); bucket/pad ragged batches and use run(), "
-                    f"or feed dense arrays")
+                    f"run_steps does not support a single LoD feed (got "
+                    f"one for {name!r}); pass a LIST of per-step "
+                    f"(value, lod) batches under program.lod_buckets, "
+                    f"or bucket/pad ragged batches and use run()")
             var = block.var(name) if block.has_var(name) else None
             dtype = var.dtype if var is not None else None
             arr = _as_device_array(value, dtype, device)

@@ -290,10 +290,17 @@ def dropout(x, dropout_prob, is_test=False, seed=None, name=None):
     return out
 
 
-def softmax(input, use_cudnn=True, name=None):
+def softmax(input, use_cudnn=True, name=None, bias=None):
+    """Last-axis softmax; ``bias`` optionally fuses an additive mask
+    (broadcastable, e.g. [B,1,1,S] padding / [1,1,S,S] causal) into the
+    op so attention scores need not materialize in f32 (see
+    ops/nn_ops.py softmax_lower)."""
     helper = LayerHelper("softmax", name=name)
     out = helper.create_tmp_variable(input.dtype)
-    helper.append_op(type="softmax", inputs={"X": [input]},
+    inputs = {"X": [input]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op(type="softmax", inputs=inputs,
                      outputs={"Out": [out]})
     return out
 

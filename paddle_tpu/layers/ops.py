@@ -15,7 +15,10 @@ _ACTIVATIONS = [
     "hard_shrink", "thresholded_relu", "gelu", "sin", "cos",
 ]
 
-_UNARY_OPS = _ACTIVATIONS + ["sign", "cumsum", "softmax", "log_softmax"]
+# NOTE: softmax is NOT generated here — layers/nn.py defines the real
+# wrapper (optional fused Bias input); generating it too would shadow
+# that one through the star-import order in layers/__init__.py
+_UNARY_OPS = _ACTIVATIONS + ["sign", "cumsum", "log_softmax"]
 
 
 def _make_wrapper(op_type):

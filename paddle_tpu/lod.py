@@ -78,9 +78,13 @@ class DynLoD:
         self._unsupported()
 
 
-def bucket_ragged_feed(name, value, lod):
+def bucket_ragged_feed(name, value, lod, n_bucket=None, t_bucket=None):
     """(value [N, ...], single-level lod) -> (padded value [N_b, ...],
-    splits int32 [B+1], meta tuple for the scope lod slot)."""
+    splits int32 [B+1], meta tuple for the scope lod slot).
+
+    ``n_bucket``/``t_bucket`` force a common bucket — run_steps pads a
+    WINDOW of per-step batches to one signature so the whole window
+    rides one executable."""
     splits = np.asarray(lod[-1], dtype=np.int64)
     n = int(splits[-1])
     if value.shape[0] != n:
@@ -88,8 +92,10 @@ def bucket_ragged_feed(name, value, lod):
             f"feed {name!r}: lod rows {n} != value rows {value.shape[0]}")
     lengths = splits[1:] - splits[:-1]
     maxlen = int(lengths.max()) if len(lengths) else 0
-    n_bucket = next_bucket(max(n, 1))
-    t_bucket = next_bucket(max(maxlen, 1))
+    if n_bucket is None:
+        n_bucket = next_bucket(max(n, 1))
+    if t_bucket is None:
+        t_bucket = next_bucket(max(maxlen, 1))
     padded = np.zeros((n_bucket,) + value.shape[1:], dtype=value.dtype)
     padded[:n] = value
     meta = ("dyn", len(splits) - 1, t_bucket)

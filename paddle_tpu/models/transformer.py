@@ -151,11 +151,17 @@ def multi_head_attention(queries, keys, values, d_key, d_value, d_model,
                                      scale=scale)
     else:
         product = layers.matmul(q, k, transpose_y=True, alpha=scale)
+        # fold the mask into the softmax op: under bf16 AMP the [B,H,S,S]
+        # scores then stay bf16 in HBM (an f32 add would otherwise promote
+        # and double the attention hot spot's traffic); softmax itself
+        # computes in f32 internally
+        bias = None
         if k_mask is not None:
-            product = product + _shared_padding_bias(k_mask)
+            bias = _shared_padding_bias(k_mask)
         if causal:
-            product = product + _shared_causal_bias(q.block, q.shape[2])
-        weights = layers.softmax(product)
+            cb = _shared_causal_bias(q.block, q.shape[2])
+            bias = cb if bias is None else bias + cb
+        weights = layers.softmax(product, bias=bias)
         if dropout_rate:
             weights = layers.dropout(weights, dropout_prob=dropout_rate)
         ctx = layers.matmul(weights, v)

@@ -9,6 +9,8 @@ the MXU) instead of the reference's im2col+GEMM / cuDNN split.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -445,8 +447,14 @@ def _infer_layer_norm(op, block):
     y.dtype = x.dtype
 
 
-@register_op("layer_norm", infer_shape=_infer_layer_norm)
+@register_op("layer_norm", infer_shape=_infer_layer_norm,
+             amp_cast=("X",))
 def layer_norm_lower(ctx):
+    """Under bf16 AMP the input (and hence the output, cast back to
+    X's dtype) is bf16, keeping the transformer residual stream bf16
+    end-to-end — the statistics are still computed in f32 below.  An
+    f32-promoted residual stream doubles the HBM traffic of every
+    LN/add pair (measured: exp_transformer_ceiling.py)."""
     x = ctx.input("X")
     begin = ctx.attr("begin_norm_axis", 1)
     eps = ctx.attr("epsilon", 1e-5)
@@ -554,9 +562,55 @@ def dropout_lower(ctx):
 # softmax / log_softmax  (reference softmax_op.cc: normalizes the last dim)
 # ---------------------------------------------------------------------------
 
-@register_op("softmax", infer_shape=infer_shape_unary())
+@register_op("softmax", infer_shape=infer_shape_unary(),
+             no_grad_inputs=("Bias",))
 def softmax_lower(ctx):
-    ctx.set_output("Out", jax.nn.softmax(ctx.input("X"), axis=-1))
+    """Last-axis softmax with an optional fused additive ``Bias``
+    (attention masks).  Internally f32, output in X's dtype: under bf16
+    AMP the [B,H,S,S] score tensor then stays bf16 in HBM — the bias
+    add and the f32 upcast fuse into the reduction passes instead of
+    materializing an f32 score tensor (reference softmax_op.cc is plain
+    f32; the fused-bias form is the TPU redesign of the transformer's
+    ``scores + mask`` pattern)."""
+    x = ctx.input("X")
+    bias = ctx.input("Bias")
+    if bias is not None and x.ndim == 4 and \
+            os.environ.get("PADDLE_TPU_FUSED_SOFTMAX", "0") == "1":
+        # attention-shaped: the Pallas single-pass kernel — measured
+        # SLOWER in-model than the XLA path below (138.9 vs 132.7 ms/step
+        # Transformer-base r5: the custom call splits the matmul/softmax
+        # fusion clusters, the same effect that gates flash attention to
+        # S >= 512) — kept as an opt-in experiment
+        from paddle_tpu.ops.attention_ops import (fused_softmax,
+                                                  _use_interpret)
+        B, H, Sq, Sk = x.shape
+        row_bias = tri_bias = None
+        ok = True
+        if bias.ndim == 4 and bias.shape[1] == 1 and \
+                bias.shape[2] == 1 and bias.shape[3] == Sk:
+            row_bias = bias.reshape(bias.shape[0], Sk)
+            if bias.shape[0] not in (1, B):
+                ok = False
+            elif bias.shape[0] == 1:
+                row_bias = jnp.broadcast_to(row_bias, (B, Sk))
+        elif bias.ndim == 4 and bias.shape[0] == 1 and \
+                bias.shape[1] == 1 and bias.shape[2] == Sq and \
+                bias.shape[3] == Sk:
+            tri_bias = bias.reshape(Sq, Sk)
+        else:
+            ok = False
+        if ok:
+            ctx.set_output("Out", fused_softmax(
+                x, row_bias, tri_bias, _use_interpret()))
+            return
+    out_dtype = x.dtype
+    if bias is not None:
+        # add in X's dtype: under bf16 AMP the materialization candidate
+        # between the softmax reduction passes is then bf16, not f32
+        # (-1e9 is representable in bf16; exp/sum still run in f32)
+        x = x + bias.astype(x.dtype)
+    ctx.set_output("Out", jax.nn.softmax(
+        x.astype(jnp.float32), axis=-1).astype(out_dtype))
 
 
 @register_op("log_softmax", infer_shape=infer_shape_unary())

@@ -23,8 +23,9 @@ beyond-reference row.  Design:
 * Activations/feeds cross boundaries the same way: one flat carrier per
   lane of uniform (max-boundary) length.  Integer values ride the i32
   lane EXACTLY (the r4 design packed them as f32, silently rounding
-  ids >= 2^24); bf16 values keep bf16 width on the wire; floats ride
-  f32.  Lanes that no boundary/parameter uses are dropped from the
+  ids >= 2^24; host-side int64 values beyond int32 range are rejected
+  loudly rather than wrapped); bf16 values keep bf16 width on the
+  wire; floats ride f32.  Lanes that no boundary/parameter uses are dropped from the
   pytree, so ``jax.grad`` over the packed params needs ``allow_int``
   only when an integer parameter actually exists.
 * Microbatches feed STAGE 0 ONLY (the refinement pipeline.py:70-73
@@ -212,18 +213,21 @@ def split_program(program, n_stages, feed_names, fetch_names):
     # carriers are flat dense vectors; a TensorArray (or reader/channel)
     # cannot cross a cut.  The cut placement is cost-driven, so reject
     # loudly with the remedy instead of crashing in _Layout.pack.
-    for b, names in enumerate(boundaries[1:-1], start=1):
+    for b, names in enumerate(boundaries):
         for n in names:
             v = block.var(n) if n in block.vars else None
             vtype = getattr(v, "type", None)
             if vtype in ("tensor_array", "reader", "channel"):
+                where = ("the feed carrier" if b == 0 else
+                         "the fetch carrier" if b == len(boundaries) - 1
+                         else f"the cut before stage {b}")
                 raise ValueError(
-                    f"pipeline_transpiler: the cut before stage {b} "
-                    f"would carry {n!r} (a {vtype}) across the "
-                    f"boundary; keep its producers and consumers in "
-                    f"one stage — fewer stages, or hoist the "
-                    f"control-flow region so the quantile cut lands "
-                    f"outside it")
+                    f"pipeline_transpiler: {where} would carry {n!r} "
+                    f"(a {vtype}), which cannot ride a flat carrier; "
+                    f"keep its producers and consumers in one stage "
+                    f"and fetch/feed dense tensors only — fewer "
+                    f"stages, or hoist the control-flow region so the "
+                    f"quantile cut lands outside it")
     return block, stage_ops, stage_params, boundaries
 
 
@@ -245,11 +249,24 @@ class _Layout:
             self.lengths[lane] = self.lengths.get(lane, 0) + size
 
     def pack(self, values, lanes):
-        """values {name: array} -> {lane: flat vec} over ``lanes``."""
+        """values {name: array} -> {lane: flat vec} over ``lanes``.
+
+        Host-side (numpy) int64 values are range-checked before riding
+        the i32 lane — a >= 2^31 id must fail loudly, not wrap (traced
+        in-stage values are already i32 under JAX's default x64-off)."""
         flats = {lane: [] for lane in lanes}
         for n, lane in zip(self.names, self.lanes):
+            v = values[n]
+            if lane == "i32" and isinstance(v, np.ndarray) and \
+                    v.dtype == np.int64 and v.size and \
+                    (v.max() > np.iinfo(np.int32).max or
+                     v.min() < np.iinfo(np.int32).min):
+                raise ValueError(
+                    f"pipeline_transpiler: {n!r} holds int64 values "
+                    f"outside int32 range; the i32 carrier lane cannot "
+                    f"carry them exactly")
             flats[lane].append(
-                jnp.ravel(values[n]).astype(_LANE_DTYPES[lane]))
+                jnp.ravel(v).astype(_LANE_DTYPES[lane]))
         return {
             lane: (jnp.concatenate(fs) if fs
                    else jnp.zeros((0,), _LANE_DTYPES[lane]))
@@ -377,9 +394,14 @@ class PipelinedProgram:
         return self
 
     def pack_microbatch(self, feed):
-        """feed dict -> {lane: [L_lane]} carrier for boundary 0."""
+        """feed dict -> {lane: [L_lane]} carrier for boundary 0.
+
+        Values pass to ``pack`` RAW (numpy) — converting to jnp first
+        would silently wrap int64 to int32 under x64-off before the
+        range guard could fire."""
         lay = self._carrier_layouts[0]
-        vecs = lay.pack({k: jnp.asarray(v) for k, v in feed.items()},
+        vecs = lay.pack({k: np.asarray(v) if not hasattr(v, "aval")
+                         else v for k, v in feed.items()},
                         self.carrier_lanes)
         return _pad_lanes(vecs, self.carrier_len)
 

@@ -5,18 +5,31 @@ SURVEY.md §4.5)."""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# PADDLE_TPU_TEST_TPU=1 runs the selected tests ON the real chip (the
+# reference's dual-place OpTest discipline, op_test.py:290) — everything
+# else pins the 8-device virtual CPU platform.
+_ON_TPU = os.environ.get("PADDLE_TPU_TEST_TPU") == "1"
+
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
 # the axon sitecustomize force-sets jax_platforms="axon,cpu" via
 # jax.config.update at interpreter boot; override it back before any
 # backend initializes so tests run on the 8-device virtual CPU platform.
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
+else:
+    # dual-place discipline: the suite's tolerances/convergence targets
+    # are f32-derived, so the chip pass runs matmuls at full f32
+    # precision (TPU default is bf16 passes — enough to sink e.g. the
+    # sentiment test's parity-style toy task)
+    jax.config.update("jax_default_matmul_precision", "highest")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

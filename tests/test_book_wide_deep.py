@@ -11,6 +11,7 @@ Two modes:
 """
 
 import numpy as np
+import pytest
 
 import jax
 from jax.sharding import PartitionSpec as P
@@ -81,6 +82,8 @@ class TestWideDeepSparse:
         assert np.mean(losses[-10:]) < np.mean(losses[:10]), losses
 
 
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 (virtual) devices")
 class TestWideDeepDistributed:
     def test_vocab_sharded_embedding_on_mesh(self):
         rng = np.random.RandomState(1)
