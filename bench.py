@@ -67,9 +67,17 @@ def measure_trials(run_once, n_trials=None):
 
 def main():
     import os
-    if os.environ.get("PADDLE_TPU_BENCH_MODEL", "transformer") == "resnet":
-        import bench_resnet
-        bench_resnet.main()
+    model = os.environ.get("PADDLE_TPU_BENCH_MODEL", "transformer") \
+        or "transformer"
+    if model != "transformer":
+        import importlib
+        modules = {"resnet": "bench_resnet", "lstm": "bench_lstm",
+                   "seq2seq": "bench_seq2seq"}
+        if model not in modules:
+            raise SystemExit(
+                f"PADDLE_TPU_BENCH_MODEL={model!r}: valid values are "
+                f"transformer, {', '.join(modules)}")
+        importlib.import_module(modules[model]).main()
         return
     import jax
     # optional precision override (measured per-chip; f32 already uses the

@@ -63,7 +63,11 @@ def main():
     if on_tpu:
         batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", "256"))
         image_shape, class_dim, depth = (3, 224, 224), 1000, 50
-        warmup_calls, steps = 2, 8
+        # 24 steps/dispatch: this container's tunnel costs ~100 ms per
+        # dispatch+sync round trip, which at 8 steps inflated the wall
+        # by ~13 ms/step (BENCH_RESNET_CEILING.md r5 addendum)
+        warmup_calls, steps = 2, int(
+            os.environ.get("PADDLE_TPU_BENCH_STEPS", "24"))
     else:  # tiny smoke config for dev machines
         batch, image_shape, class_dim, depth = 4, (3, 32, 32), 10, 18
         warmup_calls, steps = 1, 2
@@ -105,6 +109,11 @@ def main():
 
         dt, trial_dts = measure_trials(run_once)
         loss = np.asarray(last[0][0])[-1]
+        # tenant-proof whole-step device time (executor pt_step scope)
+        from paddle_tpu import profiler
+        dev_s = profiler.measure_device_seconds(run_once,
+                                                scope="pt_step") \
+            if on_tpu else 0.0
 
     images = batch * steps
     images_per_sec = images / dt
@@ -118,9 +127,11 @@ def main():
         "vs_baseline": round(mfu / 0.45, 4),
     }))
     step_mss = ", ".join(f"{t / steps * 1e3:.1f}" for t in trial_dts)
+    dev_ms = dev_s / steps * 1e3 if dev_s else float("nan")
     print(f"# loss={float(np.asarray(loss).reshape(()))}"
           f" mfu={mfu:.3f} fwd_gflops_per_image={fwd_flops / batch / 1e9:.2f}"
           f" step_ms_median={dt / steps * 1e3:.1f}"
+          f" device_ms={dev_ms:.1f}"
           f" trials=[{step_mss}]", file=sys.stderr)
 
 

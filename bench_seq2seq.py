@@ -41,47 +41,58 @@ def main():
     from paddle_tpu.models.seq2seq import seq_to_seq_net, fake_batch
     import bench
 
-    main_prog, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main_prog, startup):
-        avg_cost, _ = seq_to_seq_net(SRC_DICT, TRG_DICT, emb_dim=EMB,
-                                     encoder_size=ENC, decoder_size=DEC)
-        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
-    main_prog.lod_buckets = True
+    def run_point(batch):
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            avg_cost, _ = seq_to_seq_net(SRC_DICT, TRG_DICT, emb_dim=EMB,
+                                         encoder_size=ENC,
+                                         decoder_size=DEC)
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+        main_prog.lod_buckets = True
 
-    n_windows = 3
-    windows = [[fake_batch(BATCH, SRC_MAX, TRG_MAX, SRC_DICT, TRG_DICT,
-                           seed=50 * w + i) for i in range(WINDOW)]
-               for w in range(n_windows)]
+        n_windows = 3
+        windows = [[fake_batch(batch, SRC_MAX, TRG_MAX, SRC_DICT,
+                               TRG_DICT, seed=50 * w + i)
+                    for i in range(WINDOW)] for w in range(n_windows)]
 
-    def feed_of(w):
-        return {k: [b[k] for b in windows[w]]
-                for k in ("src_word", "trg_word", "label")}
+        def feed_of(w):
+            return {k: [b[k] for b in windows[w]]
+                    for k in ("src_word", "trg_word", "label")}
 
-    def trg_tokens(w):
-        return sum(b["trg_word"][1][0][-1] for b in windows[w])
+        def trg_tokens(w):
+            return sum(b["trg_word"][1][0][-1] for b in windows[w])
 
-    scope = fluid.Scope()
-    with fluid.scope_guard(scope):
-        exe = fluid.Executor()
-        exe.run(startup)
-        for w in range(n_windows):
-            exe.run_steps(main_prog, feed=feed_of(w),
-                          fetch_list=[avg_cost.name], steps=WINDOW)
-        k = [0]
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            for w in range(n_windows):
+                exe.run_steps(main_prog, feed=feed_of(w),
+                              fetch_list=[avg_cost.name], steps=WINDOW)
+            k = [0]
 
-        def run_once():
-            exe.run_steps(main_prog, feed=feed_of(k[0] % n_windows),
-                          fetch_list=[avg_cost.name], steps=WINDOW)
-            k[0] += 1
+            def run_once():
+                exe.run_steps(main_prog, feed=feed_of(k[0] % n_windows),
+                              fetch_list=[avg_cost.name], steps=WINDOW)
+                k[0] += 1
 
-        dt, _ = bench.measure_trials(run_once, n_trials=4)
-    toks = np.mean([trg_tokens(w) for w in range(n_windows)])
-    print(json.dumps({
-        "metric": "seq2seq_attention_tokens_per_sec_per_chip",
-        "value": round(toks / dt, 2), "unit": "tokens/sec",
-        "vs_baseline": None,
-        "ms_per_batch": round(dt * 1e3 / WINDOW, 3),
-    }))
+            dt, _ = bench.measure_trials(run_once, n_trials=4)
+        toks = np.mean([trg_tokens(w) for w in range(n_windows)])
+        return toks / dt, dt * 1e3 / WINDOW
+
+    # the reference operating point (batch 16) on stdout; batch 64 shows
+    # the same program is batch-scalable (the 16-point is latency-bound
+    # by the serial decoder, not a framework ceiling)
+    for batch in [BATCH] + ([BATCH * 4] if BATCH >= 16 else []):
+        tps, mspb = run_point(batch)
+        line = json.dumps({
+            "metric": f"seq2seq_attention_tokens_per_sec_per_chip"
+                      + ("" if batch == BATCH else f"_b{batch}"),
+            "value": round(tps, 2), "unit": "tokens/sec",
+            "vs_baseline": None,
+            "ms_per_batch": round(mspb, 3), "batch": batch,
+        })
+        print(line, file=sys.stdout if batch == BATCH else sys.stderr)
 
 
 if __name__ == "__main__":

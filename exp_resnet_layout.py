@@ -141,11 +141,15 @@ def make_step(nhwc):
 def main():
     global REAL_BN, BATCH
     rng = np.random.RandomState(0)
+    import os
     variants = [
         # (batch, nhwc, real_bn)
         (256, False, False), (256, True, False),
         (256, True, True), (512, True, False),
     ]
+    if os.environ.get("RESNET_VARIANT"):       # e.g. "256,1,1" = one only
+        b, h, r = os.environ["RESNET_VARIANT"].split(",")
+        variants = [(int(b), h == "1", r == "1")]
     for BATCH, nhwc, REAL_BN in variants:
         params = init_params(rng)
         labels = jnp.asarray(rng.randint(0, 1000, BATCH))

@@ -676,10 +676,17 @@ class Executor:
                 stats = release_map[block.idx]["stats"]
                 stats["bytes"] = stats["vars"] = 0  # per-run measurement
                 aux["release"] = release_map
-            lower_block(block, env, rng_key, training, aux)
-            fetches = [env[n] for n in self.fetch_missing_check(fetch_names, env)]
-            new_state = {n: env[n] for n in inout_names + create_state
-                         if n in env}
+            # whole-step scope: every emitted HLO op (including scan/
+            # slicing glue outside the per-op ptop_ scopes) carries it,
+            # so tenant-proof WHOLE-STEP device time is one
+            # scope_device_seconds("pt_step") read
+            with jax.named_scope("pt_step"):
+                lower_block(block, env, rng_key, training, aux)
+                fetches = [env[n] for n in
+                           self.fetch_missing_check(fetch_names, env)]
+                new_state = {n: env[n]
+                             for n in inout_names + create_state
+                             if n in env}
             return fetches, new_state
 
         return {"sig": sig, "step": step, "feed_names": feed_names,

@@ -74,6 +74,13 @@ class OpTest:
 
     # -- forward check -----------------------------------------------------
     def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
+        # dual-place discipline (reference op_test.py passes a larger
+        # atol for the CUDA place): TPU transcendentals (exp/log) differ
+        # from the host libm at the ~4e-5 level
+        from paddle_tpu.place import is_tpu_available
+        if is_tpu_available():
+            atol = max(atol, 1e-4)
+            rtol = max(rtol, 1e-4)
         program, feed = self._build()
         fetch_names = []
         expected = []
