@@ -91,7 +91,11 @@ def main():
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
     hp = T.ModelHyperParams()
     if on_tpu:
-        batch, seq = 256, 256
+        # operating-point overrides (long-context runs: S >= 512 takes
+        # the in-model flash path per BENCH_ATTENTION.md's crossover)
+        batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", "256"))
+        seq = int(os.environ.get("PADDLE_TPU_BENCH_SEQ", "256"))
+        hp.max_length = max(hp.max_length, seq)
         warmup_calls, steps = 2, 16
     else:  # tiny smoke config for dev machines
         hp.d_model, hp.d_inner_hid, hp.n_layer = 64, 128, 2
