@@ -48,6 +48,9 @@ def _persist_program(vars, for_load):
     """A fresh program whose global block mirrors ``vars`` (persistable),
     ready to host save/load ops over them."""
     prog = Program()
+    # persistence programs are host-op programs BY DESIGN (file IO);
+    # the host-op-cliff warning is for unexpected training-path cliffs
+    prog.expect_host_ops = True
     block = prog.global_block()
     for var in vars:
         v = block.create_var(name=var.name, shape=var.shape,

@@ -16,6 +16,18 @@ def monkey_patch_variable():
     def safe_get_dtype(var):
         return var.dtype
 
+    def emit_block(var):
+        """Emit into the program's CURRENT block, not the variable's
+        owner block: an expression on an outer-block var inside a
+        While/cond body must compute INSIDE the body — emitting into
+        the owner block silently hoists it out of the loop, so the loop
+        re-reads a stale pre-loop value (r5 bug: ``acc = acc + 1``
+        in a While body incremented exactly once)."""
+        try:
+            return var.block.program.current_block()
+        except Exception:
+            return var.block
+
     def create_tensor(block, value, dtype, shape):
         value = float(value)
         tmp_name = unique_tmp_name()
@@ -33,10 +45,11 @@ def monkey_patch_variable():
         assert isinstance(ref_var, Variable)
         value = float(value)
         tmp_name = unique_tmp_name()
-        var = ref_var.block.create_var(name=tmp_name, dtype=dtype,
-                                       shape=ref_var.shape)
+        blk = emit_block(ref_var)
+        var = blk.create_var(name=tmp_name, dtype=dtype,
+                             shape=ref_var.shape)
         var.stop_gradient = True
-        ref_var.block.append_op(
+        blk.append_op(
             type="fill_constant_batch_size_like",
             outputs={"Out": [var.name]}, inputs={"Input": [ref_var.name]},
             attrs={"dtype": var.dtype, "shape": list(ref_var.shape),
@@ -44,7 +57,7 @@ def monkey_patch_variable():
         return var
 
     def astype(self, dtype):
-        block = self.block
+        block = emit_block(self)
         out = block.create_var(name=unique_tmp_name(), dtype=dtype,
                                shape=self.shape)
         block.append_op(type="cast", inputs={"X": [self.name]},
@@ -54,7 +67,7 @@ def monkey_patch_variable():
 
     def _elemwise_method_creator_(method_name, op_type, reverse=False):
         def __impl__(self, other_var):
-            block = self.block
+            block = emit_block(self)
             lhs_dtype = safe_get_dtype(self)
             if not isinstance(other_var, Variable):
                 if reverse:
@@ -107,7 +120,7 @@ def monkey_patch_variable():
 
     def _cmp_method_creator_(method_name, op_type):
         def __impl__(self, other_var):
-            block = self.block
+            block = emit_block(self)
             if not isinstance(other_var, Variable):
                 other_var = create_scalar(block, other_var,
                                           safe_get_dtype(self))
@@ -128,7 +141,7 @@ def monkey_patch_variable():
         setattr(Variable, method, _cmp_method_creator_(method, op_type))
 
     def __neg__(self):
-        block = self.block
+        block = emit_block(self)
         out = block.create_var(name=unique_tmp_name(), dtype=self.dtype,
                                shape=self.shape)
         block.append_op(type="scale", inputs={"X": [self.name]},

@@ -63,14 +63,17 @@ class TensorArray:
         index = jnp.asarray(index, jnp.int32).reshape(())
         value = jnp.asarray(value)
         if keep is not None:
+            if value.dtype != self.data.dtype:
+                raise TypeError(
+                    f"TensorArray.write: value dtype {value.dtype} != "
+                    f"buffer dtype {self.data.dtype}")
             old_row = jax.lax.dynamic_index_in_dim(self.data, index,
                                                    axis=0, keepdims=False)
-            value = jnp.where(keep, value.astype(self.data.dtype),
-                              old_row)
+            value = jnp.where(keep, value, old_row)
         start = (index,) + (0,) * value.ndim
-        # no dtype coercion here: an ungated mismatched write must stay
-        # a loud trace-time error (the keep path casts above, where the
-        # row-select requires matching dtypes)
+        # no dtype coercion on either path: a mismatched write is a
+        # loud trace-time error (TypeError above when gated, the
+        # dynamic_update_slice dtype check here when not)
         data = jax.lax.dynamic_update_slice(self.data, value[None], start)
         length = jnp.maximum(self.length, index + 1)
         if keep is not None:
@@ -274,6 +277,13 @@ def while_lower(ctx: LowerContext):
         # silently truncated — fail loudly instead (ADVICE r1).  Some PJRT
         # backends cannot run host callbacks; there the check degrades to a
         # one-time warning at lowering time.
+        still_true = cond_fun(final)
+        # a FROZEN outer carry (this loop nested in a post-termination
+        # outer iteration) keeps the inner condition True by design —
+        # that is not exhaustion
+        outer_keep = ctx.aux.get("loop_keep")
+        if outer_keep is not None:
+            still_true = jnp.logical_and(still_true, outer_keep)
         if _host_callbacks_supported():
             def _check_exhausted(still_true, bound=int(bound)):
                 if bool(still_true):
@@ -282,7 +292,7 @@ def while_lower(ctx: LowerContext):
                         f"trip bound of {bound} iterations (inferred from "
                         f"TensorArray capacity or the 'max_iters' attr); "
                         f"raise 'max_iters' on the while op")
-            jax.debug.callback(_check_exhausted, cond_fun(final))
+            jax.debug.callback(_check_exhausted, still_true)
         else:
             _warn_no_exhaustion_check(int(bound))
     else:

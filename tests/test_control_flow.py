@@ -241,3 +241,50 @@ def test_reorder_lod_tensor_by_rank_ragged():
                     fetch_list=[out.name])
     np.testing.assert_allclose(np.asarray(ov).reshape(-1),
                                [1, 2, 3, 4, 5, 0])
+
+
+class TestNestedBoundedWhile:
+    def test_nested_loops_with_slack_bounds(self):
+        """r5 regression: both loops lower to bounded scans with a trip
+        bound LARGER than the real trip count (max_iters attr).  The
+        outer loop's post-termination iterations run with a frozen
+        carry, which keeps the INNER loop's condition True by design —
+        that must gate TensorArray writes row-wise (no whole-buffer
+        merge) and must NOT trip the inner loop's exhaustion check."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            i = layers.zeros(shape=[1], dtype="int32")
+            i.stop_gradient = True
+            n_outer = layers.fill_constant(shape=[1], dtype="int32",
+                                           value=3)
+            n_outer.stop_gradient = True
+            acc = layers.zeros(shape=[4], dtype="float32")
+            cond = layers.less_than(x=i, y=n_outer)
+            w = layers.While(cond=cond)
+            with w.block():
+                j = layers.zeros(shape=[1], dtype="int32")
+                j.stop_gradient = True
+                n_inner = layers.fill_constant(shape=[1], dtype="int32",
+                                               value=2)
+                n_inner.stop_gradient = True
+                icond = layers.less_than(x=j, y=n_inner)
+                iw = layers.While(cond=icond)
+                with iw.block():
+                    acc2 = acc + 1.0
+                    layers.assign(acc2, output=acc)
+                    j2 = layers.increment(x=j, in_place=True)
+                    layers.less_than(x=j2, y=n_inner, cond=icond)
+                i2 = layers.increment(x=i, in_place=True)
+                layers.less_than(x=i2, y=n_outer, cond=cond)
+            out = layers.reduce_sum(acc)
+        # slack bounds: both loops run as bounded scans past termination
+        for blk in main.blocks:
+            for op in blk.ops:
+                if op.type == "while":
+                    op.attrs["max_iters"] = 7
+        exe = fluid.Executor()
+        exe.run(startup)
+        (o,) = exe.run(main, feed={}, fetch_list=[out.name])
+        # 3 outer x 2 inner increments of a 4-vector summed: 3*2*4
+        np.testing.assert_allclose(float(np.asarray(o).reshape(())),
+                                   24.0, rtol=1e-6)
