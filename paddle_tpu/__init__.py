@@ -56,6 +56,7 @@ from paddle_tpu.parallel import ParallelExecutor
 from paddle_tpu import parallel
 from paddle_tpu import reader
 from paddle_tpu import dataset
+from paddle_tpu import fault
 
 __version__ = "0.1.0"
 

@@ -51,6 +51,11 @@ def _cmd_train(args):
         os.environ["PADDLE_NUM_PASSES"] = str(args.num_passes)
     if args.use_tpu is not None:
         os.environ["PADDLE_TPU_USE_TPU"] = str(int(args.use_tpu))
+    if args.checkpoint_dir is not None:
+        # consumed by fault.manager_from_env() in training scripts
+        # (the paddle_trainer --save_dir analog)
+        os.environ["PADDLE_TPU_CKPT_DIR"] = args.checkpoint_dir
+        os.environ["PADDLE_TPU_CKPT_KEEP"] = str(args.keep_checkpoints)
     sys.argv = [args.config] + (args.script_args or [])
     runpy.run_path(args.config, run_name="__main__")
     return 0
@@ -95,7 +100,8 @@ def _cmd_master(args):
     tasks = partition_files(files, args.chunks_per_task)
     service = MasterService(tasks, timeout=args.timeout,
                             failure_max=args.failure_max,
-                            snapshot_path=args.snapshot)
+                            snapshot_path=args.snapshot,
+                            heartbeat_timeout=args.heartbeat_timeout)
     server = MasterServer(service, host=args.host, port=args.port)
     print(f"master serving {len(tasks)} tasks on "
           f"{server.addr[0]}:{server.addr[1]}", flush=True)
@@ -109,7 +115,9 @@ def _cmd_master(args):
 def _cmd_serve(args):
     """HTTP inference server over a saved model (L6 serving runtime)."""
     from paddle_tpu.serving import serve
-    serve(args.model, host=args.host, port=args.port)
+    serve(args.model, host=args.host, port=args.port,
+          async_load=args.async_load, max_inflight=args.max_inflight,
+          request_timeout=args.request_timeout)
     return 0
 
 
@@ -193,6 +201,10 @@ def main(argv=None):
     p.add_argument("--config", required=True, help="python training script")
     p.add_argument("--num-passes", type=int, default=None)
     p.add_argument("--use-tpu", type=int, default=None)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="export PADDLE_TPU_CKPT_DIR for the script's "
+                        "fault.CheckpointManager")
+    p.add_argument("--keep-checkpoints", type=int, default=5)
     p.add_argument("script_args", nargs="*")
     p.set_defaults(fn=_cmd_train)
 
@@ -212,12 +224,24 @@ def main(argv=None):
     p.add_argument("--failure-max", type=int, default=3)
     p.add_argument("--snapshot", default=None,
                    help="snapshot file for restart recovery")
+    p.add_argument("--heartbeat-timeout", type=float, default=None,
+                   help="reclaim leases of trainers silent this long "
+                        "(default: lease timeout only)")
     p.set_defaults(fn=_cmd_master)
 
     p = sub.add_parser("serve", help="HTTP inference server")
     p.add_argument("--model", required=True, help="save_inference_model dir")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8866)
+    p.add_argument("--async-load", action="store_true",
+                   help="serve /healthz immediately; load the model in "
+                        "the background (/readyz gates traffic)")
+    p.add_argument("--max-inflight", type=int, default=32,
+                   help="concurrent /predict slots before 503 "
+                        "load-shedding")
+    p.add_argument("--request-timeout", type=float, default=None,
+                   help="per-request deadline waiting on the predictor "
+                        "(504 when exceeded)")
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("profile", help="per-op device-time table of one "

@@ -1,0 +1,44 @@
+"""Elastic fault-tolerance runtime.
+
+The reference's fault-tolerance story is split across the Go master
+(lease-based task requeue, ``go/master/service.go``) and the Go pserver
+(CRC'd checkpoints, ``go/pserver/service.go:346``).  This package is the
+runtime that ties our ports of those pieces into something that actually
+survives failure:
+
+- :mod:`paddle_tpu.fault.checkpoint` — crash-consistent checkpoint
+  commits (temp dir -> fsync -> atomic rename -> checksummed manifest)
+  and a :class:`CheckpointManager` with keep-N GC and a
+  ``restore_latest()`` that quarantines torn/corrupt checkpoints.
+- :mod:`paddle_tpu.fault.retry` — :class:`RetryPolicy` (bounded
+  attempts, exponential backoff + jitter, deadline) for RPC and IO
+  paths.
+- :mod:`paddle_tpu.fault.chaos` — named failpoints armed by tests or the
+  ``PADDLE_TPU_CHAOS`` env var; product code calls ``chaos.fire(name)``
+  at checkpoint/RPC/step boundaries, a disarmed failpoint costs one dict
+  lookup.
+- :mod:`paddle_tpu.fault.lifecycle` — :class:`GracefulShutdown`:
+  SIGTERM/SIGINT-aware stop flag so a preempted trainer finishes the
+  current step, commits a checkpoint, and exits cleanly.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.fault import chaos
+from paddle_tpu.fault.chaos import FaultInjected, fire, inject
+from paddle_tpu.fault.checkpoint import (CheckpointManager, CorruptCheckpoint,
+                                         manager_from_env, verify_checkpoint)
+from paddle_tpu.fault.lifecycle import GracefulShutdown, graceful_shutdown
+from paddle_tpu.fault.retry import RetryError, RetryPolicy, retrying
+
+__all__ = [
+    "chaos", "FaultInjected", "fire", "inject",
+    "CheckpointManager", "CorruptCheckpoint", "manager_from_env",
+    "verify_checkpoint",
+    "GracefulShutdown", "graceful_shutdown",
+    "RetryError", "RetryPolicy", "retrying",
+]
+
+# parse PADDLE_TPU_CHAOS eagerly so a malformed spec fails fast at
+# import, not from inside an arbitrary failpoint site mid-training
+chaos._load_env()

@@ -1,0 +1,290 @@
+"""Crash-consistent checkpoint commits + managed retention/recovery.
+
+The reference pserver checkpoints with a CRC32 over the serialized state
+(``go/pserver/service.go:346``) and recovers by validating it on load.
+Same discipline here, at directory granularity: a checkpoint is written
+to a temp dir, a ``MANIFEST.json`` with per-file SHA-256 checksums is
+added, everything is fsynced, and only then is the dir atomically
+renamed to its final ``ckpt-<step>`` name.  A crash at ANY point leaves
+either the previous committed checkpoint or a ``.tmp-``/renamed-away dir
+that :meth:`CheckpointManager.restore_latest` ignores or quarantines —
+never a half-written checkpoint that loads garbage.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+
+from paddle_tpu.fault import chaos
+
+__all__ = ["CheckpointManager", "CorruptCheckpoint", "MANIFEST_NAME",
+           "write_manifest", "verify_checkpoint", "commit_checkpoint"]
+
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_FORMAT = 1
+_TMP_PREFIX = ".tmp-"
+_QUARANTINE_SUFFIX = ".corrupt"
+
+
+class CorruptCheckpoint(RuntimeError):
+    """A checkpoint failed manifest/checksum verification."""
+
+
+def _sha256(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(chunk), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _walk_files(root):
+    for dirpath, _, names in os.walk(root):
+        for n in sorted(names):
+            p = os.path.join(dirpath, n)
+            yield os.path.relpath(p, root), p
+
+
+def _fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_manifest(path, step=None):
+    """Checksum every file under ``path`` into ``MANIFEST.json`` (fsynced)."""
+    files = {}
+    for rel, abs_p in _walk_files(path):
+        if rel == MANIFEST_NAME:
+            continue
+        files[rel] = {"sha256": _sha256(abs_p),
+                      "size": os.path.getsize(abs_p)}
+    manifest = {"format": MANIFEST_FORMAT, "step": step, "files": files}
+    mpath = os.path.join(path, MANIFEST_NAME)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    return manifest
+
+
+def verify_checkpoint(path):
+    """Validate ``path`` against its manifest.
+
+    Returns the manifest dict; raises :class:`CorruptCheckpoint` on a
+    missing/unreadable manifest, a missing file, a size mismatch, or a
+    checksum mismatch.  (Pre-manifest legacy checkpoints fail here — the
+    manager treats only manifested dirs as verifiable and leaves legacy
+    dirs to explicit ``load_checkpoint`` calls.)
+    """
+    mpath = os.path.join(path, MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CorruptCheckpoint(
+            f"{path}: unreadable manifest ({e})") from e
+    for rel, want in manifest.get("files", {}).items():
+        abs_p = os.path.join(path, rel)
+        if not os.path.exists(abs_p):
+            raise CorruptCheckpoint(f"{path}: missing file {rel!r}")
+        size = os.path.getsize(abs_p)
+        if size != want["size"]:
+            raise CorruptCheckpoint(
+                f"{path}: {rel!r} size {size} != manifest {want['size']}")
+        if _sha256(abs_p) != want["sha256"]:
+            raise CorruptCheckpoint(f"{path}: {rel!r} checksum mismatch")
+    return manifest
+
+
+def commit_checkpoint(tmp_path, final_path, step=None):
+    """Manifest + fsync + atomic rename: the commit point of a save.
+
+    The ``ckpt.commit`` failpoint sits after the full temp write and
+    before the rename — a kill there must leave the previous committed
+    checkpoint as the restore target.
+    """
+    write_manifest(tmp_path, step=step)
+    _fsync_dir(tmp_path)
+    chaos.fire("ckpt.commit", step=step)
+    displaced = None
+    if os.path.exists(final_path):
+        # overwriting a committed step (rollback + retrain): displace it
+        # by ATOMIC rename rather than rmtree, so a crash in this window
+        # still leaves a complete dir on disk (restore falls back to an
+        # earlier step; the displaced dir is swept by the next GC)
+        displaced = os.path.join(
+            os.path.dirname(final_path),
+            _TMP_PREFIX + "old-" + os.path.basename(final_path))
+        if os.path.exists(displaced):
+            shutil.rmtree(displaced)
+        os.rename(final_path, displaced)
+    os.rename(tmp_path, final_path)
+    _fsync_dir(os.path.dirname(final_path) or ".")
+    if displaced is not None:
+        shutil.rmtree(displaced, ignore_errors=True)
+    return final_path
+
+
+def manager_from_env(executor=None, main_program=None, scope=None):
+    """Build a :class:`CheckpointManager` from the ``PADDLE_TPU_CKPT_DIR``
+    / ``PADDLE_TPU_CKPT_KEEP`` env vars (exported by ``paddle_tpu train
+    --checkpoint-dir``); returns None when unset — training scripts call
+    this once and checkpoint/resume only when the operator asked for it."""
+    dirname = os.environ.get("PADDLE_TPU_CKPT_DIR")
+    if not dirname:
+        return None
+    keep = int(os.environ.get("PADDLE_TPU_CKPT_KEEP", "5"))
+    return CheckpointManager(dirname, keep=keep, executor=executor,
+                             main_program=main_program, scope=scope)
+
+
+class CheckpointManager:
+    """Keep-N managed checkpoints over ``io.save_checkpoint`` /
+    ``io.load_checkpoint`` with corruption-tolerant recovery.
+
+    ``save(step)`` commits crash-consistently (the io layer routes
+    through :func:`commit_checkpoint`) and garbage-collects all but the
+    newest ``keep`` committed steps.  ``restore_latest()`` walks
+    committed steps newest-first, verifies each manifest, quarantines
+    (renames to ``ckpt-N.corrupt``) anything torn or corrupt, and
+    restores the newest checkpoint that passes — returning its step, or
+    None when nothing is restorable.
+    """
+
+    def __init__(self, dirname, keep=5, executor=None, main_program=None,
+                 scope=None):
+        self.dirname = str(dirname)
+        self.keep = keep
+        self.executor = executor
+        self.main_program = main_program
+        self.scope = scope
+        os.makedirs(self.dirname, exist_ok=True)
+
+    # -- introspection -----------------------------------------------------
+    def steps(self):
+        """Committed (fully renamed) checkpoint steps, ascending."""
+        steps = []
+        for name in os.listdir(self.dirname):
+            if not name.startswith("ckpt-") or name.endswith(
+                    _QUARANTINE_SUFFIX):
+                continue
+            suffix = name[len("ckpt-"):]
+            if suffix.isdigit():
+                steps.append(int(suffix))
+        return sorted(steps)
+
+    def latest_step(self):
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def quarantined(self):
+        return sorted(n for n in os.listdir(self.dirname)
+                      if n.endswith(_QUARANTINE_SUFFIX))
+
+    def path(self, step):
+        return os.path.join(self.dirname, f"ckpt-{int(step)}")
+
+    # -- save --------------------------------------------------------------
+    def save(self, step):
+        """Commit the current training state as ``ckpt-<step>``."""
+        from paddle_tpu import io
+        path = io.save_checkpoint(self.executor, self.dirname,
+                                  main_program=self.main_program,
+                                  step=step, scope=self.scope)
+        self._gc()
+        return path
+
+    def _gc(self):
+        # GC mirrors the commit protocol: only the coordinator host
+        # mutates the shared directory (non-coordinators would otherwise
+        # sweep .tmp-ckpt-<step> out from under process 0's in-flight
+        # manifest/rename)
+        import jax
+        if jax.process_index() != 0:
+            return
+        steps = self.steps()
+        for step in steps[:-self.keep] if self.keep else []:
+            shutil.rmtree(self.path(step), ignore_errors=True)
+        # stale temp dirs from crashed saves are torn garbage by
+        # definition — sweep them too.  (A checkpoint dir has ONE
+        # writer: the trainer committing steps.  Concurrent savers into
+        # the same dir already race the final rename and are
+        # unsupported; multi-host saves share one coordinator-committed
+        # dir, see io.save_checkpoint.)
+        for name in os.listdir(self.dirname):
+            if name.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(self.dirname, name),
+                              ignore_errors=True)
+
+    # -- restore -----------------------------------------------------------
+    def verify(self, step):
+        return verify_checkpoint(self.path(step))
+
+    def restore(self, step, shardings=None):
+        """Verify + restore one specific step (no fallback)."""
+        from paddle_tpu import io
+        verify_checkpoint(self.path(step))
+        return io.load_checkpoint(self.executor, self.dirname,
+                                  main_program=self.main_program, step=step,
+                                  scope=self.scope, shardings=shardings)
+
+    def restore_latest(self, shardings=None):
+        """Restore the newest restorable checkpoint; returns its step or
+        None.  Corrupt/partial candidates are quarantined and skipped."""
+        from paddle_tpu import io
+        for step in reversed(self.steps()):
+            path = self.path(step)
+            if os.path.exists(os.path.join(path, MANIFEST_NAME)):
+                try:
+                    verify_checkpoint(path)
+                except CorruptCheckpoint:
+                    self._quarantine(path)
+                    continue
+                # checksums passed: a load failure now is environmental
+                # (bad shardings arg, FS flake, OOM) — propagate it
+                # rather than quarantining a valid checkpoint
+            else:
+                # pre-manifest legacy checkpoint: unverifiable but very
+                # possibly valid — try it, and on failure SKIP without
+                # quarantining (the dir stays for explicit
+                # load_checkpoint / forensics)
+                try:
+                    got = io.load_checkpoint(
+                        self.executor, self.dirname,
+                        main_program=self.main_program, step=step,
+                        scope=self.scope, shardings=shardings)
+                except Exception:
+                    continue
+                io._write_latest(self.dirname, step)
+                return got
+            got = io.load_checkpoint(
+                self.executor, self.dirname,
+                main_program=self.main_program, step=step,
+                scope=self.scope, shardings=shardings)
+            # re-point ``latest`` in case it referenced a checkpoint we
+            # just quarantined (load_checkpoint(step=None) keeps working)
+            io._write_latest(self.dirname, step)
+            return got
+        # nothing restorable: drop a ``latest`` pointer that would now
+        # name a quarantined dir (load_checkpoint(step=None) then fails
+        # with a clear missing-pointer error, not a phantom ckpt path)
+        try:
+            os.remove(os.path.join(self.dirname, "latest"))
+        except OSError:
+            pass
+        return None
+
+    def _quarantine(self, path):
+        target = path + _QUARANTINE_SUFFIX
+        if os.path.exists(target):
+            shutil.rmtree(target, ignore_errors=True)
+        try:
+            os.rename(path, target)
+        except OSError:
+            shutil.rmtree(path, ignore_errors=True)

@@ -1,0 +1,82 @@
+"""Preemption-aware lifecycle: finish the step, checkpoint, exit clean.
+
+TPU pods preempt with a SIGTERM and a grace window; the reference
+trainer just died and leaned on the master's lease timeout to requeue
+its work.  :class:`GracefulShutdown` converts SIGTERM/SIGINT into a stop
+flag the training loop polls between steps, so the trainer commits a
+final checkpoint instead of losing the tail of its progress — the
+TF-style preemption-safe checkpointing discipline.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+__all__ = ["GracefulShutdown", "graceful_shutdown"]
+
+
+class GracefulShutdown:
+    """Context manager: trap termination signals into a flag.
+
+    ::
+
+        with GracefulShutdown() as stop:
+            for step in range(start, num_steps):
+                if stop.should_stop():
+                    break           # fall through to the final commit
+                run_one_step()
+                manager.save(step)
+
+    Signal handlers are only installable from the main thread; elsewhere
+    the guard still works as a manual flag (``stop.request()``).  The
+    previous handlers are restored on exit.  ``on_shutdown`` (if given)
+    runs inside the handler — keep it async-signal-light (set flags,
+    don't checkpoint there; checkpoint from the loop).
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 on_shutdown=None):
+        self.signals = tuple(signals)
+        self.on_shutdown = on_shutdown
+        self._event = threading.Event()
+        self._previous = {}
+        self.received = None      # signum of the first trapped signal
+
+    # -- flag --------------------------------------------------------------
+    def should_stop(self):
+        return self._event.is_set()
+
+    def wait(self, timeout=None):
+        return self._event.wait(timeout)
+
+    def request(self, signum=None):
+        """Trip the flag programmatically (tests, cluster RPCs)."""
+        if self.received is None:
+            self.received = signum
+        self._event.set()
+        if self.on_shutdown is not None:
+            self.on_shutdown(signum)
+
+    # -- context -----------------------------------------------------------
+    def _handler(self, signum, frame):
+        self.request(signum)
+
+    def __enter__(self):
+        for sig in self.signals:
+            try:
+                self._previous[sig] = signal.signal(sig, self._handler)
+            except ValueError:      # not the main thread: manual-flag mode
+                break
+        return self
+
+    def __exit__(self, *exc):
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+        return False
+
+
+def graceful_shutdown(**kwargs):
+    """Convenience alias: ``with graceful_shutdown() as stop: ...``"""
+    return GracefulShutdown(**kwargs)

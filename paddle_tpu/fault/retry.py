@@ -1,0 +1,114 @@
+"""Bounded retry with exponential backoff + jitter.
+
+The reference's Go clients retry master/pserver RPCs in ad-hoc loops
+(``go/master/client.go`` reconnects on lease loss; ``go/pserver/client``
+re-dials).  Here the policy is one reusable object so every networked
+path — :class:`MasterClient`, the serving client, checkpoint IO — shares
+the same knobs: max attempts, exponential backoff with full jitter, and
+an overall deadline.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+__all__ = ["RetryPolicy", "RetryError", "retrying", "DEFAULT_RPC_POLICY",
+           "parse_hostport"]
+
+
+def parse_hostport(addr):
+    """``(host, port)`` from a tuple or a ``"host:port"`` string — the
+    shared address convention of the networked clients (master RPC,
+    serving HTTP)."""
+    if isinstance(addr, tuple):
+        host, port = addr
+    else:
+        host, _, port = addr.rpartition(":")
+    return host, int(port)
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted (or deadline hit); ``.last`` is the final
+    underlying exception, also chained as ``__cause__``."""
+
+    def __init__(self, message, last):
+        super().__init__(message)
+        self.last = last
+
+
+class RetryPolicy:
+    """``delay(n) = min(max_delay, base_delay * multiplier**n)`` scaled
+    by ``1 ± jitter``; give up after ``max_attempts`` tries or when the
+    next sleep would cross ``deadline`` seconds from the first attempt.
+    """
+
+    def __init__(self, max_attempts=5, base_delay=0.05, max_delay=2.0,
+                 multiplier=2.0, jitter=0.5, deadline=None,
+                 retryable=(ConnectionError, TimeoutError, OSError)):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self.deadline = deadline
+        self.retryable = tuple(retryable)
+
+    def backoff(self, attempt):
+        """Sleep before retry number ``attempt`` (1-based)."""
+        delay = min(self.max_delay,
+                    self.base_delay * self.multiplier ** (attempt - 1))
+        if self.jitter:
+            delay *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
+        return max(0.0, delay)
+
+    def call(self, fn, *args, on_retry=None, **kwargs):
+        """Run ``fn(*args, **kwargs)``, retrying on ``self.retryable``.
+
+        ``on_retry(attempt, exc, delay)`` is invoked before each sleep
+        (logging / reconnect hooks).  Non-retryable exceptions propagate
+        immediately; exhausted attempts raise :class:`RetryError`.
+        """
+        start = time.monotonic()
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn(*args, **kwargs)
+            except self.retryable as e:
+                if attempt >= self.max_attempts:
+                    raise RetryError(
+                        f"gave up after {attempt} attempts: {e}", e) from e
+                delay = self.backoff(attempt)
+                if self.deadline is not None and \
+                        time.monotonic() - start + delay > self.deadline:
+                    raise RetryError(
+                        f"deadline {self.deadline}s exceeded after "
+                        f"{attempt} attempts: {e}", e) from e
+                if on_retry is not None:
+                    on_retry(attempt, e, delay)
+                time.sleep(delay)
+
+
+def retrying(policy=None, **kwargs):
+    """Decorator form: ``@retrying(RetryPolicy(...))`` or
+    ``@retrying(max_attempts=3)``."""
+    policy = policy or RetryPolicy(**kwargs)
+
+    def wrap(fn):
+        def wrapped(*args, **kw):
+            return policy.call(fn, *args, **kw)
+        wrapped.__name__ = getattr(fn, "__name__", "retrying")
+        wrapped.__doc__ = fn.__doc__
+        wrapped.retry_policy = policy
+        return wrapped
+
+    return wrap
+
+
+# trainer-facing RPC default: ~6s worst-case total sleep, enough to ride
+# out a master restart without stalling a trainer for minutes
+DEFAULT_RPC_POLICY = RetryPolicy(max_attempts=6, base_delay=0.05,
+                                 max_delay=2.0, deadline=30.0)

@@ -217,13 +217,33 @@ def load_inference_model(dirname, executor, model_filename=None,
 # multi-host meshes without gathering.
 # ---------------------------------------------------------------------------
 
+def _write_latest(dirname, step):
+    latest = os.path.join(dirname, "latest")
+    tmp = latest + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(int(step)))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, latest)  # atomic: a crash mid-save keeps the old ptr
+
+
 def save_checkpoint(executor, dirname, main_program=None, step=0,
                     scope=None):
     """Save ALL persistable state (params + optimizer accumulators) plus
-    metadata; sharded arrays are written shard-by-shard (orbax)."""
+    metadata; sharded arrays are written shard-by-shard (orbax).
+
+    Crash-consistent: the state is written to a ``.tmp-`` dir, a
+    checksummed ``MANIFEST.json`` is added, and only then is the dir
+    atomically renamed to ``ckpt-<step>`` and the ``latest`` pointer
+    swung — an interruption at any point leaves no partial ``ckpt-*``
+    dir behind (``fault.checkpoint.commit_checkpoint``)."""
+    import shutil
+
     import orbax.checkpoint as ocp
     import jax
 
+    from paddle_tpu.fault import chaos
+    from paddle_tpu.fault.checkpoint import commit_checkpoint
     from paddle_tpu.framework import default_main_program
     from paddle_tpu.scope import global_scope
 
@@ -237,15 +257,42 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
         if v is None or not hasattr(v, "dtype"):
             continue
         state[var.name] = v
+    os.makedirs(dirname, exist_ok=True)
     path = os.path.abspath(os.path.join(dirname, f"ckpt-{int(step)}"))
+    # the temp path must be IDENTICAL on every host: orbax coordinates a
+    # multi-host save over one shared directory, each host writing its
+    # addressable shards into it.  Only the coordinator host commits
+    # (manifest + rename + latest pointer), after orbax reports the
+    # write finished on all hosts.
+    tmp = os.path.abspath(os.path.join(dirname, f".tmp-ckpt-{int(step)}"))
+    if jax.process_index() == 0 and os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    chaos.fire("ckpt.save", step=step)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, state, force=True)
+    ckptr.save(tmp, state, force=True)
     ckptr.wait_until_finished()
-    latest = os.path.join(dirname, "latest")
-    tmp = latest + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(str(int(step)))
-    os.replace(tmp, latest)  # atomic: a crash mid-save keeps the old ptr
+    commit_error = None
+    if jax.process_index() == 0:
+        try:
+            commit_checkpoint(tmp, path, step=int(step))
+            _write_latest(dirname, step)
+        except BaseException as e:
+            commit_error = e
+    if jax.process_count() > 1:
+        # barrier + commit-status broadcast: no host may observe
+        # save_checkpoint() returning until the coordinator's commit
+        # (manifest + rename + latest) is done — and a commit FAILURE
+        # must raise on every host, not deadlock the others at a
+        # barrier the coordinator never reaches
+        from jax.experimental import multihost_utils
+        ok = multihost_utils.broadcast_one_to_all(
+            np.int32(0 if commit_error is not None else 1))
+        if int(ok) != 1 and commit_error is None:
+            raise RuntimeError(
+                f"checkpoint commit for step {int(step)} failed on the "
+                f"coordinator host")
+    if commit_error is not None:
+        raise commit_error
     return path
 
 
@@ -268,7 +315,12 @@ def load_checkpoint(executor, dirname, main_program=None, step=None,
     path = os.path.abspath(os.path.join(dirname, f"ckpt-{int(step)}"))
     ckptr = ocp.StandardCheckpointer()
     if shardings:
-        meta = dict(ckptr.metadata(path).item_metadata.tree)
+        meta = ckptr.metadata(path)
+        # orbax returns a StepMetadata for dirs it renamed itself and a
+        # raw name->ArrayMetadata tree for ours (committed via
+        # fault.checkpoint.commit_checkpoint)
+        meta = dict(meta) if isinstance(meta, dict) else \
+            dict(meta.item_metadata.tree)
         targets = {}
         for name, m in meta.items():
             sh = shardings.get(name)

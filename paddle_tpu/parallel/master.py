@@ -21,7 +21,7 @@ import threading
 import time
 
 __all__ = ["Task", "MasterService", "partition_files",
-           "MasterServer", "MasterClient"]
+           "MasterServer", "MasterClient", "MasterError"]
 
 DEFAULT_TIMEOUT = 60.0
 DEFAULT_FAILURE_MAX = 3
@@ -62,43 +62,68 @@ def partition_files(paths, chunks_per_task=1):
 
 class MasterService:
     def __init__(self, tasks=None, timeout=DEFAULT_TIMEOUT,
-                 failure_max=DEFAULT_FAILURE_MAX, snapshot_path=None):
+                 failure_max=DEFAULT_FAILURE_MAX, snapshot_path=None,
+                 heartbeat_timeout=None):
         self._lock = threading.Lock()
         self.timeout = timeout
         self.failure_max = failure_max
+        self.heartbeat_timeout = heartbeat_timeout
         self.snapshot_path = snapshot_path
         self.todo = list(tasks or [])
         self.pending = {}            # task_id -> (Task, deadline)
         self.done = []
         self.failed_drop = []        # exceeded failure_max
+        self._lease_owner = {}       # task_id -> trainer_id (when known)
+        self._trainer_seen = {}      # trainer_id -> last heartbeat time
+        # only trainers that OPTED IN by heartbeating are subject to
+        # heartbeat eviction — a trainer that merely passes trainer_id
+        # to get_task must not be declared dead for processing a task
+        # longer than heartbeat_timeout
+        self._heartbeaters = set()
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover()
         else:
             self._snapshot()
 
     # -- client API (reference GetTask/TaskFinished/TaskFailed) ------------
-    def get_task(self):
+    def get_task(self, trainer_id=None):
         """Lease a task; returns None when nothing is currently available
         (caller retries — tasks may return via timeout)."""
         with self._lock:
+            if trainer_id is not None:
+                self._trainer_seen[trainer_id] = time.time()
             self._requeue_timeouts()
             if not self.todo:
                 return None
             task = self.todo.pop(0)
             task.epoch += 1
             self.pending[task.id] = (task, time.time() + self.timeout)
+            if trainer_id is not None:
+                self._lease_owner[task.id] = trainer_id
             self._snapshot()
             return task
 
+    def heartbeat(self, trainer_id):
+        """Trainer liveness ping.  With ``heartbeat_timeout`` set, leases
+        held by a trainer that stops pinging are reclaimed promptly (at
+        the next queue mutation) instead of waiting out the full lease
+        timeout — the reference leaned solely on etcd lease TTLs here."""
+        with self._lock:
+            self._trainer_seen[trainer_id] = time.time()
+            self._heartbeaters.add(trainer_id)
+            self._requeue_timeouts()
+            return True
+
     def task_finished(self, task_id, epoch=None):
         with self._lock:
-            entry = self.pending.pop(task_id, None)
+            entry = self.pending.get(task_id)
             if entry is None:
                 return False
             task, _ = entry
             if epoch is not None and epoch != task.epoch:
-                self.pending[task_id] = entry  # stale lease report
-                return False
+                return False  # stale lease report: current lease untouched
+            del self.pending[task_id]
+            self._lease_owner.pop(task_id, None)
             task.failures = 0  # reference: NumFailure resets on success
             self.done.append(task)
             self._snapshot()
@@ -106,13 +131,14 @@ class MasterService:
 
     def task_failed(self, task_id, epoch=None):
         with self._lock:
-            entry = self.pending.pop(task_id, None)
+            entry = self.pending.get(task_id)
             if entry is None:
                 return False
             task, _ = entry
             if epoch is not None and epoch != task.epoch:
-                self.pending[task_id] = entry
-                return False
+                return False  # stale lease report: current lease untouched
+            del self.pending[task_id]
+            self._lease_owner.pop(task_id, None)
             self._process_failed(task)
             self._snapshot()
             return True
@@ -143,7 +169,8 @@ class MasterService:
         with self._lock:
             return {"todo": len(self.todo), "pending": len(self.pending),
                     "done": len(self.done),
-                    "dropped": len(self.failed_drop)}
+                    "dropped": len(self.failed_drop),
+                    "trainers": len(self._trainer_seen)}
 
     # -- internals ---------------------------------------------------------
     def _process_failed(self, task):
@@ -156,8 +183,35 @@ class MasterService:
     def _requeue_timeouts(self):
         now = time.time()
         expired = [tid for tid, (_, dl) in self.pending.items() if dl < now]
+        if self.heartbeat_timeout is not None:
+            # leases of trainers that stopped heartbeating are reclaimed
+            # without waiting out the full lease timeout
+            dead = {t for t in self._heartbeaters
+                    if now - self._trainer_seen.get(t, now)
+                    > self.heartbeat_timeout}
+            expired += [tid for tid, owner in self._lease_owner.items()
+                        if owner in dead and tid not in expired
+                        and tid in self.pending]
+            for t in dead:
+                self._trainer_seen.pop(t, None)
+                self._heartbeaters.discard(t)
+        # registry hygiene: trainer ids that neither hold leases nor
+        # heartbeat within a generous horizon are forgotten, so a
+        # long-lived master serving elastically scaled trainers (fresh
+        # ids every restart) doesn't grow without bound
+        horizon = max(self.heartbeat_timeout or 0.0, 10.0 * self.timeout)
+        owners = set(self._lease_owner.values())
+        for tid in [t for t, seen in self._trainer_seen.items()
+                    if now - seen > horizon and t not in owners]:
+            self._trainer_seen.pop(tid, None)
+            self._heartbeaters.discard(tid)
         for tid in expired:
             task, _ = self.pending.pop(tid)
+            self._lease_owner.pop(tid, None)
+            # bump the epoch at eviction so a LATE task_finished /
+            # task_failed from the evicted holder is rejected even if it
+            # lands before the task is re-leased
+            task.epoch += 1
             self._process_failed(task)
         if expired:
             self._snapshot()
@@ -207,8 +261,10 @@ class _MasterRPCHandler(socketserver.StreamRequestHandler):
                 method = req.get("method")
                 params = req.get("params") or {}
                 if method == "get_task":
-                    t = svc.get_task()
+                    t = svc.get_task(params.get("trainer_id"))
                     result = t.to_dict() if t is not None else None
+                elif method == "heartbeat":
+                    result = svc.heartbeat(params["trainer_id"])
                 elif method == "task_finished":
                     result = svc.task_finished(params["task_id"],
                                                params.get("epoch"))
@@ -257,28 +313,119 @@ class MasterServer:
         self._server.server_close()
 
 
+class MasterError(RuntimeError):
+    """The master executed the request and reported an error (NOT a
+    transport failure — never retried)."""
+
+
 class MasterClient:
     """Trainer-side client (reference ``go/pserver/client`` C ABI +
-    ``python/paddle/v2/master/client.py``)."""
+    ``python/paddle/v2/master/client.py``).
 
-    def __init__(self, addr, timeout=30.0):
-        host, port = addr if isinstance(addr, tuple) else \
-            (addr.rsplit(":", 1)[0], int(addr.rsplit(":", 1)[1]))
+    Transport failures (connection reset, master restart, timeout) are
+    retried under ``retry`` (a :class:`paddle_tpu.fault.RetryPolicy`;
+    default ``DEFAULT_RPC_POLICY``) with a fresh connection per attempt,
+    so a flaky or briefly-restarting master no longer kills the trainer.
+    Re-sent requests are at-least-once safe: every mutating method is
+    idempotent under the lease epoch (a duplicate ``task_finished`` /
+    ``task_failed`` returns False, a re-sent ``get_task`` at worst
+    double-leases a task whose first lease times out and requeues).
+    """
+
+    def __init__(self, addr, timeout=30.0, retry=None, trainer_id=None):
+        from paddle_tpu.fault.retry import (DEFAULT_RPC_POLICY,
+                                            parse_hostport)
+        self._addr = parse_hostport(addr)
+        self._timeout = timeout
+        self._retry = retry or DEFAULT_RPC_POLICY
+        self.trainer_id = trainer_id
+        self._lock = threading.Lock()
+        self._sock = None
+        self._rfile = None
+        self._hb_stop = None
+        self._closed = False
+        # connection is lazy: the first _call dials under the retry
+        # policy, so constructing a client while the master is briefly
+        # down (trainer resume during master restart) is safe
+
+    def _connect(self):
+        if self._closed:
+            raise RuntimeError("MasterClient is closed")
+        self._drop_connection()
+        host, port = self._addr
         self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
+                                              timeout=self._timeout)
         self._rfile = self._sock.makefile("r")
 
+    def _drop_connection(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._rfile = None
+
     def _call(self, method, **params):
-        msg = json.dumps({"method": method, "params": params}) + "\n"
-        self._sock.sendall(msg.encode())
-        resp = json.loads(self._rfile.readline())
+        from paddle_tpu.fault import chaos
+
+        def attempt():
+            chaos.fire("master.rpc", method=method)
+            with self._lock:
+                if self._sock is None:
+                    self._connect()
+                try:
+                    msg = json.dumps({"method": method,
+                                      "params": params}) + "\n"
+                    self._sock.sendall(msg.encode())
+                    line = self._rfile.readline()
+                    if not line:  # server closed mid-request
+                        raise ConnectionError("master closed connection")
+                    return json.loads(line)
+                except OSError:
+                    # a dead stream can't be reused: reconnect on the
+                    # next attempt
+                    self._drop_connection()
+                    raise
+                except ValueError as e:
+                    # garbled/truncated frame — same remedy as a reset
+                    self._drop_connection()
+                    raise ConnectionError(f"garbled master reply: {e}") \
+                        from e
+
+        resp = self._retry.call(attempt)
         if "error" in resp:
-            raise RuntimeError(f"master: {resp['error']}")
+            raise MasterError(f"master: {resp['error']}")
         return resp["result"]
 
     def get_task(self):
-        d = self._call("get_task")
+        d = self._call("get_task", trainer_id=self.trainer_id)
         return Task.from_dict(d) if d is not None else None
+
+    def heartbeat(self):
+        if self.trainer_id is None:
+            raise ValueError("heartbeat requires a trainer_id")
+        return self._call("heartbeat", trainer_id=self.trainer_id)
+
+    def start_heartbeats(self, interval=5.0):
+        """Send heartbeats from a daemon thread every ``interval``
+        seconds (enrolls this trainer in heartbeat-based lease
+        reclamation on the master).  Stops on :meth:`close`."""
+        if self.trainer_id is None:
+            raise ValueError("heartbeats require a trainer_id")
+        if self._hb_stop is not None:
+            return
+        stop = threading.Event()   # captured: immune to close() racing
+        self._hb_stop = stop       # the attribute back to None
+
+        def beat():
+            while not stop.wait(interval):
+                try:
+                    self.heartbeat()
+                except Exception:
+                    pass  # transient (retried already) — or closed
+
+        threading.Thread(target=beat, daemon=True).start()
 
     def task_finished(self, task_id, epoch=None):
         return self._call("task_finished", task_id=task_id, epoch=epoch)
@@ -296,4 +443,8 @@ class MasterClient:
         return self._call("stats")
 
     def close(self):
-        self._sock.close()
+        self._closed = True   # an in-flight retry can no longer redial
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            self._hb_stop = None
+        self._drop_connection()

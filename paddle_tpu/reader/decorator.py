@@ -17,6 +17,8 @@ import queue
 import random
 import threading
 
+from paddle_tpu.fault import chaos as _chaos
+
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache"]
 
@@ -113,6 +115,7 @@ def buffered(reader, size):
         def pump():
             try:
                 for sample in reader():
+                    _chaos.fire("reader.pump")
                     q.put(sample)
             except BaseException as e:  # re-raised consumer-side
                 q.put(_Raised(e))
@@ -195,6 +198,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     if item is _STOP:
                         return
                     pos, sample = item
+                    _chaos.fire("reader.worker")
                     outq.put((pos, mapper(sample)))
             except BaseException as e:
                 outq.put(_Raised(e))

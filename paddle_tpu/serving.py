@@ -16,7 +16,26 @@ import threading
 
 import numpy as np
 
-__all__ = ["Predictor", "serve", "InferenceServer"]
+__all__ = ["Predictor", "serve", "InferenceServer", "DeadlineExceeded",
+           "ServingClient", "ServingError"]
+
+
+class DeadlineExceeded(RuntimeError):
+    """A request timed out waiting for the predictor (queue saturation)."""
+
+
+class ServingError(RuntimeError):
+    """Structured server-side error; ``retryable`` mirrors the reply."""
+
+    def __init__(self, etype, message, retryable=False):
+        super().__init__(f"{etype}: {message}")
+        self.etype = etype
+        self.retryable = retryable
+
+
+class _TransientServingError(ConnectionError):
+    """A retryable (503/504) reply, surfaced as a transport-class error
+    so RetryPolicy's default ``retryable`` set covers it."""
 
 
 class Predictor:
@@ -44,14 +63,25 @@ class Predictor:
         return [t.name if hasattr(t, "name") else str(t)
                 for t in self._fetch_targets]
 
-    def run(self, feed):
-        """feed: dict name -> ndarray; returns list of ndarrays."""
+    def run(self, feed, timeout=None):
+        """feed: dict name -> ndarray; returns list of ndarrays.
+
+        ``timeout``: max seconds to wait for the (serialized) executor —
+        a saturated predictor raises :class:`DeadlineExceeded` instead of
+        queueing the caller indefinitely."""
         missing = [n for n in self._feed_names if n not in feed]
         if missing:
             raise ValueError(f"missing feeds: {missing}")
-        with self._lock, self._fluid.scope_guard(self._scope):
-            outs = self._exe.run(self._program, feed=dict(feed),
-                                 fetch_list=self._fetch_targets)
+        if not self._lock.acquire(timeout=-1 if timeout is None
+                                  else timeout):
+            raise DeadlineExceeded(
+                f"predictor busy for more than {timeout}s")
+        try:
+            with self._fluid.scope_guard(self._scope):
+                outs = self._exe.run(self._program, feed=dict(feed),
+                                     fetch_list=self._fetch_targets)
+        finally:
+            self._lock.release()
         return [np.asarray(o) for o in outs]
 
 
@@ -86,10 +116,48 @@ def _capi_run(predictor, names, buffers, shapes, dtypes):
 # ---------------------------------------------------------------------------
 
 class InferenceServer:
-    def __init__(self, model_dir, host="127.0.0.1", port=0):
+    """HTTP inference server with graceful degradation.
+
+    - ``/healthz`` (and legacy ``/health``): liveness — 200 while the
+      process serves, even before the model loads.
+    - ``/readyz``: readiness — 200 only once the model is loaded; 503
+      with ``retryable: true`` while loading, 500 with ``retryable:
+      false`` if the load failed.
+    - ``/predict`` (and alias ``/run``): 503 + ``retryable: true``
+      before the model is ready or when all ``max_inflight`` slots are
+      taken (load shedding), 504 + ``retryable: true`` when a request
+      waits longer than ``request_timeout`` on the predictor, 400/500
+      structured errors otherwise.  Every error body is
+      ``{"error": {"type", "message"}, "retryable": bool}``.
+
+    ``async_load=True`` starts serving immediately and loads the model
+    in the background (k8s-style: readiness gates traffic, liveness
+    doesn't kill the pod during a long restore).
+    """
+
+    def __init__(self, model_dir, host="127.0.0.1", port=0,
+                 async_load=False, max_inflight=32, request_timeout=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-        predictor = Predictor(model_dir)
+        from paddle_tpu.fault import chaos
+
+        self.predictor = None
+        self._ready = threading.Event()
+        self._load_done = threading.Event()  # set on success OR failure
+        self._load_error = None
+        self._slots = threading.BoundedSemaphore(max_inflight)
+        self._request_timeout = request_timeout
+        server = self
+
+        def _load():
+            try:
+                chaos.fire("serving.load", model_dir=model_dir)
+                server.predictor = Predictor(model_dir)
+                server._ready.set()
+            except BaseException as e:
+                server._load_error = e
+            finally:
+                server._load_done.set()
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
@@ -103,20 +171,64 @@ class InferenceServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _error(self, code, etype, message, retryable):
+                self._reply(code, {"error": {"type": etype,
+                                             "message": message},
+                                   "retryable": retryable})
+
+            def _gate_ready(self):
+                """404/503/500 preludes; returns the predictor or None
+                (reply already sent)."""
+                if server._load_error is not None:
+                    self._error(500, "model_load_failed",
+                                str(server._load_error), retryable=False)
+                    return None
+                if not server._ready.is_set():
+                    self._error(503, "model_loading",
+                                "model is still loading; retry later",
+                                retryable=True)
+                    return None
+                return server.predictor
+
             def do_GET(self):
-                if self.path == "/health":
+                if self.path in ("/health", "/healthz"):
                     self._reply(200, {"status": "ok"})
+                elif self.path == "/readyz":
+                    if server._load_error is not None:
+                        self._error(500, "model_load_failed",
+                                    str(server._load_error),
+                                    retryable=False)
+                    elif server._ready.is_set():
+                        self._reply(200, {"status": "ready"})
+                    else:
+                        self._error(503, "model_loading",
+                                    "model is still loading",
+                                    retryable=True)
                 elif self.path == "/meta":
-                    self._reply(200, {"feeds": predictor.feed_names,
-                                      "fetches": predictor.fetch_names})
+                    predictor = self._gate_ready()
+                    if predictor is not None:
+                        self._reply(200,
+                                    {"feeds": predictor.feed_names,
+                                     "fetches": predictor.fetch_names})
                 else:
-                    self._reply(404, {"error": "not found"})
+                    self._error(404, "not_found", self.path,
+                                retryable=False)
 
             def do_POST(self):
-                if self.path != "/predict":
-                    self._reply(404, {"error": "not found"})
+                if self.path not in ("/predict", "/run"):
+                    self._error(404, "not_found", self.path,
+                                retryable=False)
+                    return
+                predictor = self._gate_ready()
+                if predictor is None:
+                    return
+                if not server._slots.acquire(blocking=False):
+                    # saturated: shed load instead of queueing unboundedly
+                    self._error(503, "overloaded",
+                                "all inference slots busy", retryable=True)
                     return
                 try:
+                    chaos.fire("serving.run", path=self.path)
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n))
                     feed = {k: np.asarray(v, dtype="float32")
@@ -124,16 +236,51 @@ class InferenceServer:
                             else np.asarray(v["data"],
                                             dtype=v.get("dtype", "float32"))
                             for k, v in req["feeds"].items()}
-                    outs = predictor.run(feed)
+                    outs = predictor.run(
+                        feed, timeout=server._request_timeout)
                     self._reply(200, {"outputs": [o.tolist() for o in outs],
                                       "shapes": [list(o.shape)
+                                                 for o in outs],
+                                      "dtypes": [str(o.dtype)
                                                  for o in outs]})
+                except DeadlineExceeded as e:
+                    self._error(504, "deadline_exceeded", str(e),
+                                retryable=True)
+                except (ValueError, KeyError, TypeError) as e:
+                    self._error(400, "bad_request", str(e), retryable=False)
                 except Exception as e:
-                    self._reply(400, {"error": str(e)})
+                    self._error(500, "internal", str(e), retryable=False)
+                finally:
+                    server._slots.release()
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.addr = self._server.server_address
-        self.predictor = predictor
+        if async_load:
+            self._loader = threading.Thread(target=_load, daemon=True)
+            self._loader.start()
+        else:
+            _load()
+            if self._load_error is not None:
+                self._server.server_close()  # don't leak the bound socket
+                raise self._load_error
+
+    @property
+    def ready(self):
+        return self._ready.is_set()
+
+    @property
+    def load_error(self):
+        return self._load_error
+
+    def wait_until_ready(self, timeout=None):
+        """Block until the model loads.  A FAILED async load raises the
+        load error instead of blocking forever; a timeout returns
+        False."""
+        if not self._load_done.wait(timeout):
+            return False
+        if self._load_error is not None:
+            raise self._load_error
+        return self._ready.is_set()
 
     def serve_forever(self):
         self._server.serve_forever()
@@ -148,8 +295,91 @@ class InferenceServer:
         self._server.server_close()
 
 
-def serve(model_dir, host="127.0.0.1", port=8866):
-    server = InferenceServer(model_dir, host, port)
+class ServingClient:
+    """Retrying client for :class:`InferenceServer`.
+
+    Transport failures AND replies the server marks ``retryable: true``
+    (model still loading, load shedding, deadline exceeded) are retried
+    under ``retry`` (a :class:`paddle_tpu.fault.RetryPolicy`); permanent
+    errors raise :class:`ServingError` immediately.  This is the
+    trainer/edge-side mirror of the master RPC retry path: a briefly
+    unready or saturated server no longer kills the caller.
+    """
+
+    def __init__(self, addr, retry=None, timeout=30.0):
+        from paddle_tpu.fault.retry import RetryPolicy, parse_hostport
+        host, port = parse_hostport(addr)
+        self._base = f"http://{host}:{port}"
+        self._timeout = timeout
+        self._retry = retry or RetryPolicy(max_attempts=8, base_delay=0.1,
+                                           max_delay=2.0, deadline=60.0)
+
+    def _request(self, path, payload=None, retry=True):
+        import urllib.error
+        import urllib.request
+
+        def attempt():
+            req = urllib.request.Request(
+                self._base + path,
+                data=None if payload is None
+                else json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self._timeout) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                try:
+                    body = json.loads(e.read())
+                except ValueError:
+                    body = {"error": {"type": "http", "message": str(e)},
+                            "retryable": e.code in (502, 503, 504)}
+                err = body.get("error") or {}
+                if body.get("retryable"):
+                    raise _TransientServingError(
+                        f"{err.get('type', 'http')}: "
+                        f"{err.get('message', str(e))}") from e
+                raise ServingError(err.get("type", "http"),
+                                   err.get("message", str(e)),
+                                   retryable=False) from e
+            except urllib.error.URLError as e:
+                raise ConnectionError(str(e)) from e
+
+        return self._retry.call(attempt) if retry else attempt()
+
+    def predict(self, feeds):
+        """feeds: dict name -> array-like; returns list of ndarrays."""
+        resp = self._request("/predict", {
+            "feeds": {k: np.asarray(v).tolist() for k, v in feeds.items()}})
+        dtypes = resp.get("dtypes") or [None] * len(resp["outputs"])
+        return [np.asarray(o) if dt is None else np.asarray(o, dtype=dt)
+                for o, dt in zip(resp["outputs"], dtypes)]
+
+    def meta(self):
+        return self._request("/meta")
+
+    def healthy(self):
+        """Single-shot liveness probe (no retries — probes must be cheap)."""
+        try:
+            return self._request("/healthz",
+                                 retry=False).get("status") == "ok"
+        except Exception:
+            return False
+
+    def ready(self):
+        """Single-shot readiness probe."""
+        try:
+            return self._request("/readyz",
+                                 retry=False).get("status") == "ready"
+        except Exception:
+            return False
+
+
+def serve(model_dir, host="127.0.0.1", port=8866, async_load=False,
+          max_inflight=32, request_timeout=None):
+    server = InferenceServer(model_dir, host, port, async_load=async_load,
+                             max_inflight=max_inflight,
+                             request_timeout=request_timeout)
     print(f"serving {model_dir} on {server.addr[0]}:{server.addr[1]}",
           flush=True)
     server.serve_forever()

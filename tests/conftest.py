@@ -35,6 +35,24 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def corrupt_largest_file(ckpt_dir, truncate_to_half=True):
+    """Tear a committed checkpoint for fault-tolerance tests: truncate
+    (or bit-flip) its largest payload file, sparing the manifest."""
+    files = [(os.path.getsize(os.path.join(dp, f)), os.path.join(dp, f))
+             for dp, _, fs in os.walk(str(ckpt_dir))
+             for f in fs if f != "MANIFEST.json"]
+    size, victim = max(files)
+    with open(victim, "r+b") as f:
+        if truncate_to_half:
+            f.truncate(size // 2)
+        else:
+            f.seek(size - 1)
+            byte = f.read(1)
+            f.seek(-1, 2)
+            f.write(bytes([byte[0] ^ 0xFF]))
+    return victim
+
+
 @pytest.fixture(autouse=True)
 def fresh_programs():
     """Each test gets fresh default programs and a fresh scope."""

@@ -90,3 +90,44 @@ class TestCheckpointResume:
             # restored value matches and carries the requested sharding
             np.testing.assert_allclose(np.asarray(w1), w1_before, rtol=1e-6)
             assert w1.sharding.spec == P(None, "model"), w1.sharding
+
+
+class TestCrashConsistency:
+    """save_checkpoint commits atomically (temp dir -> manifest -> rename,
+    fault.checkpoint); a torn write can never become the restore target."""
+
+    def test_interrupted_save_leaves_no_partial_step_dir(self, tmp_path):
+        import os
+        from paddle_tpu.fault import chaos
+
+        loss = _model()
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        exe.run(fluid.default_main_program(), feed=_feed(), fetch_list=[loss])
+        fluid.io.save_checkpoint(exe, str(tmp_path), step=1)
+        # interrupt the NEXT save right before its atomic rename
+        chaos.inject("ckpt.commit", error=KeyboardInterrupt("preempted"))
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                fluid.io.save_checkpoint(exe, str(tmp_path), step=2)
+        finally:
+            chaos.clear()
+        assert not os.path.exists(tmp_path / "ckpt-2")  # no partial dir
+        # the latest pointer still names the previous committed step
+        assert fluid.io.load_checkpoint(exe, str(tmp_path)) == 1
+
+    def test_truncated_checkpoint_falls_back_to_previous(self, tmp_path):
+        from conftest import corrupt_largest_file
+        from paddle_tpu.fault import CheckpointManager
+
+        loss = _model()
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        mgr = CheckpointManager(str(tmp_path), executor=exe)
+        for step in (1, 2):
+            exe.run(fluid.default_main_program(), feed=_feed(step),
+                    fetch_list=[loss])
+            mgr.save(step)
+        corrupt_largest_file(mgr.path(2))
+        assert mgr.restore_latest() == 1     # checksum catches the tear
+        assert any("ckpt-2" in q for q in mgr.quarantined())

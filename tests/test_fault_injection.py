@@ -1,0 +1,654 @@
+"""Fault-injection suite: failpoints in reader, RPC, and checkpoint
+paths, retry/backoff semantics, stale-lease and heartbeat handling,
+graceful shutdown, and the kill-and-resume training drill
+(docs/fault_tolerance.md).  All chaos-marked tests run on the CPU
+platform with bounded timeouts — tier-1-safe by construction."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from paddle_tpu.fault import (CheckpointManager, CorruptCheckpoint,
+                              FaultInjected, GracefulShutdown, RetryError,
+                              RetryPolicy, chaos)
+from paddle_tpu.fault.checkpoint import MANIFEST_NAME, verify_checkpoint
+from paddle_tpu.parallel.master import (MasterClient, MasterServer,
+                                        MasterService, Task,
+                                        partition_files)
+from paddle_tpu.reader import decorator as rdr
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+# ---------------------------------------------------------------------------
+# chaos primitives
+# ---------------------------------------------------------------------------
+
+class TestFailpoints:
+    def test_disarmed_is_noop(self):
+        chaos.fire("nothing.armed")  # no raise
+
+    def test_error_after_and_times(self):
+        chaos.inject("fp", after=2, times=2)
+        outcomes = []
+        for _ in range(6):
+            try:
+                chaos.fire("fp")
+                outcomes.append("ok")
+            except FaultInjected:
+                outcomes.append("boom")
+        assert outcomes == ["ok", "ok", "boom", "boom", "ok", "ok"]
+
+    def test_custom_exception_class_and_instance(self):
+        chaos.inject("fp", error=ConnectionResetError)
+        with pytest.raises(ConnectionResetError):
+            chaos.fire("fp")
+        chaos.inject("fp", error=ValueError("bad"))
+        with pytest.raises(ValueError, match="bad"):
+            chaos.fire("fp")
+
+    def test_delay_only_does_not_raise(self):
+        chaos.inject("fp", delay=0.01)
+        t0 = time.monotonic()
+        chaos.fire("fp")
+        assert time.monotonic() - t0 >= 0.01
+
+    def test_scoped_disarms(self):
+        with chaos.scoped("fp"):
+            assert chaos.armed("fp")
+            with pytest.raises(FaultInjected):
+                chaos.fire("fp")
+        assert not chaos.armed("fp")
+
+    def test_env_grammar(self):
+        names = chaos.arm_from_env(
+            "train.step=kill@4;master.rpc=error*2,reader.pump=delay:0.25")
+        assert set(names) == {"train.step", "master.rpc", "reader.pump"}
+        fired = chaos.failpoints()
+        assert set(fired) >= set(names)
+        with pytest.raises(ValueError):
+            chaos.arm_from_env("x=explode")
+
+    def test_env_grammar_modifiers_compose_in_either_order(self):
+        for spec in ("fp=error*2@1", "fp=error@1*2"):
+            chaos.clear()
+            chaos.arm_from_env(spec)
+            outcomes = []
+            for _ in range(5):
+                try:
+                    chaos.fire("fp")
+                    outcomes.append("ok")
+                except FaultInjected:
+                    outcomes.append("boom")
+            assert outcomes == ["ok", "boom", "boom", "ok", "ok"], spec
+
+    def test_kill_action_in_subprocess(self, tmp_path):
+        code = ("from paddle_tpu.fault import chaos\n"
+                "chaos.fire('die.here')\n"
+                "print('survived')\n")
+        env = dict(os.environ)
+        env["PADDLE_TPU_CHAOS"] = "die.here=kill"
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == chaos.KILL_EXIT_CODE
+        assert "survived" not in r.stdout
+
+
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        p = RetryPolicy(max_attempts=4, base_delay=0.001, jitter=0)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("transient")
+            return "ok"
+
+        assert p.call(flaky) == "ok"
+        assert len(calls) == 3
+
+    def test_exhausted_raises_retry_error_with_cause(self):
+        p = RetryPolicy(max_attempts=2, base_delay=0.001, jitter=0)
+
+        def always():
+            raise TimeoutError("down")
+
+        with pytest.raises(RetryError) as ei:
+            p.call(always)
+        assert isinstance(ei.value.last, TimeoutError)
+
+    def test_non_retryable_propagates_immediately(self):
+        p = RetryPolicy(max_attempts=5, base_delay=0.001)
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise KeyError("logic bug")
+
+        with pytest.raises(KeyError):
+            p.call(bad)
+        assert len(calls) == 1
+
+    def test_deadline(self):
+        p = RetryPolicy(max_attempts=100, base_delay=0.2, jitter=0,
+                        deadline=0.1)
+        with pytest.raises(RetryError, match="deadline"):
+            p.call(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+
+    def test_backoff_growth_capped(self):
+        p = RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=0.3,
+                        jitter=0)
+        assert [p.backoff(n) for n in (1, 2, 3, 4)] == \
+            [0.1, 0.2, 0.3, 0.3]
+
+
+# ---------------------------------------------------------------------------
+# reader resilience: worker/producer exceptions reach the consumer
+# ---------------------------------------------------------------------------
+
+class TestReaderFaults:
+    def _ints(self, n=20):
+        def reader():
+            yield from range(n)
+        return reader
+
+    def test_buffered_producer_fault_propagates(self):
+        chaos.inject("reader.pump", after=5)
+        out = []
+        with pytest.raises(FaultInjected):
+            for x in rdr.buffered(self._ints(), size=4)():
+                out.append(x)
+        assert out == [0, 1, 2, 3, 4]  # partial progress, then the fault
+
+    def test_xmap_worker_fault_propagates(self):
+        chaos.inject("reader.worker", after=3)
+        with pytest.raises(FaultInjected):
+            list(rdr.xmap_readers(lambda x: x + 1, self._ints(),
+                                  process_num=2, buffer_size=4)())
+
+    def test_xmap_mapper_exception_propagates(self):
+        def mapper(x):
+            if x == 7:
+                raise ValueError("bad sample")
+            return x
+
+        with pytest.raises(ValueError, match="bad sample"):
+            list(rdr.xmap_readers(mapper, self._ints(),
+                                  process_num=2, buffer_size=4,
+                                  order=True)())
+
+    def test_clean_stream_unaffected(self):
+        got = sorted(rdr.xmap_readers(lambda x: x * 2, self._ints(10),
+                                      process_num=3, buffer_size=4)())
+        assert got == [2 * i for i in range(10)]
+
+
+# ---------------------------------------------------------------------------
+# master: stale leases, heartbeats, RPC retry
+# ---------------------------------------------------------------------------
+
+class TestStaleLeases:
+    def test_stale_task_failed_ignored(self):
+        m = MasterService(partition_files(["a"]), timeout=60)
+        t_old = m.get_task()
+        e_old = t_old.epoch  # in-process service aliases Task objects:
+        old_id = t_old.id    # snapshot what the dead holder knew
+        # evict the holder: force its lease to expire, then re-lease
+        m.pending[old_id] = (m.pending[old_id][0], 0.0)
+        t_new = m.get_task()
+        assert t_new is not None and t_new.epoch != e_old
+        e_new = t_new.epoch
+        # dead holder reports failure with its stale epoch: must be
+        # IGNORED — the new lease stays pending, no duplicate in todo
+        assert m.task_failed(old_id, epoch=e_old) is False
+        st = m.stats()
+        assert st["pending"] == 1 and st["todo"] == 0
+        assert m.task_finished(t_new.id, e_new) is True
+
+    def test_requeue_bumps_epoch_rejecting_late_finish(self):
+        m = MasterService([Task(0, ["a"])], timeout=0.01, failure_max=10)
+        t = m.get_task()
+        e_leased = t.epoch   # epoch the (about to be evicted) holder saw
+        time.sleep(0.03)
+        m.all_done()  # triggers _requeue_timeouts; task back in todo
+        st = m.stats()
+        assert st["todo"] == 1 and st["pending"] == 0
+        # late finish from the evicted holder: rejected (not in pending)
+        assert m.task_finished(0, epoch=e_leased) is False
+        # even the requeued task's epoch moved past the evicted lease
+        assert m.todo[0].epoch > e_leased
+
+    def test_heartbeat_reclaims_dead_trainer_leases(self):
+        m = MasterService(partition_files(["a", "b"]), timeout=60,
+                          heartbeat_timeout=0.05)
+        ta = m.get_task(trainer_id="A")
+        assert ta is not None
+        e_a = ta.epoch
+        m.heartbeat("A")     # A opts into heartbeat eviction...
+        time.sleep(0.1)      # ...then goes silent past the window
+        m.heartbeat("B")
+        # A's lease was reclaimed well before the 60s lease timeout
+        st = m.stats()
+        assert st["pending"] == 0 and st["todo"] == 2
+        tb = m.get_task(trainer_id="B")
+        assert tb is not None
+        # A's late report is rejected by the epoch bump
+        assert m.task_finished(ta.id, epoch=e_a) is False
+
+    def test_no_heartbeat_opt_in_means_no_heartbeat_eviction(self):
+        """A trainer that only leases (never heartbeats) must not be
+        declared dead for working longer than the heartbeat window —
+        its lease is governed by the lease timeout alone."""
+        m = MasterService(partition_files(["a"]), timeout=60,
+                          heartbeat_timeout=0.05)
+        t = m.get_task(trainer_id="slowpoke")
+        time.sleep(0.1)      # longer than heartbeat_timeout
+        m.heartbeat("other")
+        assert m.stats()["pending"] == 1          # lease intact
+        assert m.task_finished(t.id, t.epoch) is True
+
+
+class TestMasterRPCRetry:
+    def _serve(self, tasks):
+        svc = MasterService(tasks, timeout=60)
+        server = MasterServer(svc, port=0)
+        server.start_background()
+        return svc, server, f"{server.addr[0]}:{server.addr[1]}"
+
+    def test_injected_rpc_faults_are_retried(self):
+        svc, server, addr = self._serve(partition_files(["a", "b"]))
+        try:
+            client = MasterClient(
+                addr, retry=RetryPolicy(max_attempts=5, base_delay=0.001,
+                                        jitter=0,
+                                        retryable=(ConnectionError,
+                                                   TimeoutError, OSError,
+                                                   FaultInjected)))
+            chaos.inject("master.rpc", times=2)  # two transient faults
+            t = client.get_task()
+            assert t is not None
+            assert client.task_finished(t.id, t.epoch) is True
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_client_survives_master_restart(self):
+        svc, server, addr = self._serve(partition_files(["a", "b"]))
+        host, port = server.addr
+        client = MasterClient(
+            addr, retry=RetryPolicy(max_attempts=8, base_delay=0.05,
+                                    jitter=0))
+        t1 = client.get_task()
+        assert t1 is not None
+        # master dies and comes back on the same port (state survives:
+        # same in-process service, fresh server)
+        server.shutdown()
+        server2 = MasterServer(svc, host=host, port=port)
+        server2.start_background()
+        try:
+            # the client's socket is dead; _call must reconnect + retry
+            assert client.task_finished(t1.id, t1.epoch) is True
+            t2 = client.get_task()
+            assert t2 is not None and t2.id != t1.id
+            client.close()
+        finally:
+            server2.shutdown()
+
+    def test_exhausted_retries_surface(self):
+        svc, server, addr = self._serve(partition_files(["a"]))
+        server.shutdown()  # nobody listening anymore
+        # construction is lazy (restart-safe); the RPC itself exhausts
+        # its retries and surfaces a RetryError
+        client = MasterClient(
+            addr, retry=RetryPolicy(max_attempts=2, base_delay=0.001,
+                                    jitter=0))
+        with pytest.raises(RetryError):
+            client.get_task()
+
+    def test_construction_while_master_down(self):
+        """The client dials lazily: constructing it while the master is
+        briefly down (trainer resume during a master restart) works."""
+        svc = MasterService(partition_files(["a"]), timeout=60)
+        server = MasterServer(svc, port=0)
+        host, port = server.addr
+        server.start_background()
+        server.shutdown()            # master not up yet
+        client = MasterClient(
+            (host, port), retry=RetryPolicy(max_attempts=10,
+                                            base_delay=0.05, jitter=0))
+        server2 = MasterServer(svc, host=host, port=port)
+        server2.start_background()
+        try:
+            t = client.get_task()
+            assert t is not None
+            client.close()
+        finally:
+            server2.shutdown()
+
+    def test_background_heartbeats_keep_lease_alive(self):
+        svc = MasterService(partition_files(["a"]), timeout=60,
+                            heartbeat_timeout=0.2)
+        server = MasterServer(svc, port=0)
+        server.start_background()
+        try:
+            client = MasterClient(
+                f"{server.addr[0]}:{server.addr[1]}", trainer_id="hb")
+            client.start_heartbeats(interval=0.05)
+            t = client.get_task()
+            time.sleep(0.5)          # >> heartbeat window
+            assert svc.stats()["pending"] == 1   # lease kept alive
+            assert client.task_finished(t.id, t.epoch) is True
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_trainer_id_flows_through_rpc(self):
+        svc, server, addr = self._serve(partition_files(["a"]))
+        try:
+            client = MasterClient(addr, trainer_id="t-0")
+            assert client.heartbeat() is True
+            t = client.get_task()
+            assert t is not None
+            assert svc.stats()["trainers"] == 1
+            client.close()
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: torn writes, corruption quarantine, keep-N
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    x = layers.data(name="x", shape=[4, 8], append_batch_size=False)
+    y = layers.data(name="y", shape=[4, 1], append_batch_size=False)
+    pred = layers.fc(input=x, size=1, param_attr="ft_w")
+    loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _tiny_feed(step):
+    rng = np.random.RandomState(step)
+    xs = rng.rand(4, 8).astype("float32")
+    return {"x": xs, "y": xs.sum(1, keepdims=True).astype("float32") * 0.1}
+
+
+class TestCheckpointManager:
+    def _train_and_save(self, tmp_path, steps, keep=10):
+        loss = _tiny_model()
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        mgr = CheckpointManager(str(tmp_path), keep=keep, executor=exe)
+        for s in range(1, steps + 1):
+            exe.run(fluid.default_main_program(), feed=_tiny_feed(s),
+                    fetch_list=[loss])
+            mgr.save(s)
+        return mgr, exe, loss
+
+    def test_manifest_written_and_verifies(self, tmp_path):
+        mgr, _, _ = self._train_and_save(tmp_path, steps=1)
+        manifest = verify_checkpoint(mgr.path(1))
+        assert manifest["step"] == 1 and manifest["files"]
+        assert os.path.exists(os.path.join(mgr.path(1), MANIFEST_NAME))
+
+    def test_keep_n_gc(self, tmp_path):
+        mgr, _, _ = self._train_and_save(tmp_path, steps=5, keep=2)
+        assert mgr.steps() == [4, 5]
+
+    def test_truncated_checkpoint_quarantined_and_fallback(self, tmp_path):
+        from conftest import corrupt_largest_file
+        mgr, exe, _ = self._train_and_save(tmp_path, steps=2)
+        corrupt_largest_file(mgr.path(2))
+        with pytest.raises(CorruptCheckpoint):
+            mgr.verify(2)
+        got = mgr.restore_latest()
+        assert got == 1                      # fell back past the torn one
+        assert mgr.steps() == [1]
+        assert any("ckpt-2" in q for q in mgr.quarantined())
+        # the latest pointer follows the restored step
+        assert fluid.io.load_checkpoint(exe, str(tmp_path)) == 1
+
+    def test_bitflip_detected_by_checksum(self, tmp_path):
+        from conftest import corrupt_largest_file
+        mgr, _, _ = self._train_and_save(tmp_path, steps=1)
+        corrupt_largest_file(mgr.path(1), truncate_to_half=False)
+        with pytest.raises(CorruptCheckpoint, match="checksum"):
+            mgr.verify(1)
+
+    def test_resave_same_step_overwrites_safely(self, tmp_path):
+        """Re-committing an existing step (rollback + retrain) displaces
+        the old dir by rename, never rmtree-before-rename."""
+        mgr, exe, loss = self._train_and_save(tmp_path, steps=1)
+        exe.run(fluid.default_main_program(), feed=_tiny_feed(9),
+                fetch_list=[loss])
+        mgr.save(1)  # overwrite the committed ckpt-1
+        assert mgr.steps() == [1]
+        assert mgr.restore_latest() == 1
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if n.startswith(".tmp-")]
+
+    def test_legacy_checkpoint_without_manifest_still_restores(
+            self, tmp_path):
+        """Pre-manifest checkpoints (written before this runtime) are
+        unverifiable but valid: restore_latest loads them and must NOT
+        quarantine them."""
+        mgr, exe, _ = self._train_and_save(tmp_path, steps=1)
+        os.remove(os.path.join(mgr.path(1), MANIFEST_NAME))
+        assert mgr.restore_latest() == 1
+        assert mgr.quarantined() == []
+
+    def test_restore_latest_empty_dir(self, tmp_path):
+        exe = fluid.Executor()
+        mgr = CheckpointManager(str(tmp_path), executor=exe)
+        assert mgr.restore_latest() is None
+
+    def test_kill_at_commit_leaves_previous_restorable(self, tmp_path):
+        """A crash between the temp write and the atomic rename must not
+        produce a partial ckpt-* dir; the previous step stays latest."""
+        mgr, exe, loss = self._train_and_save(tmp_path, steps=1)
+        chaos.inject("ckpt.commit", error=KeyboardInterrupt("preempted"))
+        exe.run(fluid.default_main_program(), feed=_tiny_feed(2),
+                fetch_list=[loss])
+        with pytest.raises(KeyboardInterrupt):
+            mgr.save(2)
+        chaos.clear()
+        assert mgr.steps() == [1]            # no partial ckpt-2
+        leftovers = [n for n in os.listdir(str(tmp_path))
+                     if n.startswith(".tmp-")]
+        assert leftovers                      # torn temp dir left behind...
+        assert mgr.restore_latest() == 1
+        mgr.save(2)                           # ...and swept by the next GC
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if n.startswith(".tmp-")]
+
+
+# ---------------------------------------------------------------------------
+# graceful shutdown
+# ---------------------------------------------------------------------------
+
+class TestGracefulShutdown:
+    def test_sigterm_sets_flag_and_restores_handler(self):
+        prev = signal.getsignal(signal.SIGTERM)
+        with GracefulShutdown() as stop:
+            assert not stop.should_stop()
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert stop.wait(5.0)
+            assert stop.received == signal.SIGTERM
+        assert signal.getsignal(signal.SIGTERM) is prev
+
+    def test_preempted_loop_commits_final_checkpoint(self, tmp_path):
+        loss = _tiny_model()
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        mgr = CheckpointManager(str(tmp_path), executor=exe)
+        done = 0
+        with GracefulShutdown() as stop:
+            for step in range(1, 100):
+                if stop.should_stop():
+                    break
+                exe.run(fluid.default_main_program(), feed=_tiny_feed(step),
+                        fetch_list=[loss])
+                done = step
+                if step == 3:  # "SIGTERM" arrives mid-run
+                    os.kill(os.getpid(), signal.SIGTERM)
+            mgr.save(done)  # the final commit a preemption must not lose
+        assert done == 3 and mgr.restore_latest() == 3
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume drill (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+TRAINER_SCRIPT = r'''
+"""Deterministic trainer for the kill-and-resume drill: checkpoint every
+step through CheckpointManager, resume from restore_latest(), fire the
+train.step failpoint so PADDLE_TPU_CHAOS can kill it mid-epoch."""
+import argparse
+import json
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.fault import CheckpointManager, chaos
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--ckpt", required=True)
+ap.add_argument("--steps", type=int, required=True)
+ap.add_argument("--out", required=True)
+args = ap.parse_args()
+
+main, startup = fluid.Program(), fluid.Program()
+main.random_seed = startup.random_seed = 11
+with fluid.program_guard(main, startup):
+    x = layers.data("x", shape=[6], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, 1, param_attr="w", bias_attr="b")
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9) \
+        .minimize(loss)
+
+exe = fluid.Executor()
+exe.run(startup)
+mgr = CheckpointManager(args.ckpt, keep=3, executor=exe, main_program=main)
+start = mgr.restore_latest() or 0
+
+def feed_for(step):
+    rng = np.random.RandomState(1000 + step)
+    xs = rng.rand(16, 6).astype("float32")
+    ys = (xs @ np.arange(1.0, 7.0, dtype="float32").reshape(6, 1)
+          ).astype("float32")
+    return {"x": xs, "y": ys}
+
+final_loss = None
+for step in range(start + 1, args.steps + 1):
+    chaos.fire("train.step", step=step)
+    (lv,) = exe.run(main, feed=feed_for(step), fetch_list=[loss.name])
+    final_loss = float(np.asarray(lv).reshape(-1)[0])
+    mgr.save(step)
+
+with open(args.out, "w") as f:
+    json.dump({"final_loss": final_loss, "resumed_from": start}, f)
+'''
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # full kill/resume drill: 5 subprocess boots; the
+                   # in-process failpoint tests above are the tier-1
+                   # smoke subset (ckpt.commit kill semantics included)
+class TestKillAndResume:
+    def test_killed_run_resumes_to_same_loss(self, tmp_path):
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH",
+                                                             "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PADDLE_TPU_CHAOS", None)
+        trainer = tmp_path / "trainer.py"
+        trainer.write_text(TRAINER_SCRIPT)
+        steps = 8
+
+        def run(ckpt, out, chaos_spec=None, expect_rc=0):
+            e = dict(env)
+            if chaos_spec:
+                e["PADDLE_TPU_CHAOS"] = chaos_spec
+            r = subprocess.run(
+                [sys.executable, str(trainer), "--ckpt", str(ckpt),
+                 "--steps", str(steps), "--out", str(out)],
+                cwd=repo_root, env=e, capture_output=True, text=True,
+                timeout=300)
+            assert r.returncode == expect_rc, \
+                (r.returncode, r.stderr[-2000:])
+            return r
+
+        # uninterrupted reference run
+        ref_out = tmp_path / "ref.json"
+        run(tmp_path / "ref_ckpt", ref_out)
+        ref = json.loads(ref_out.read_text())
+        assert ref["resumed_from"] == 0
+
+        # chaos run: killed hard at step 5 (steps 1-4 committed)
+        ckpt = tmp_path / "ckpt"
+        out = tmp_path / "got.json"
+        run(ckpt, out, chaos_spec="train.step=kill@4",
+            expect_rc=chaos.KILL_EXIT_CODE)
+        assert not out.exists()              # it really died mid-epoch
+
+        # resume: picks up from the newest committed checkpoint
+        run(ckpt, out)
+        got = json.loads(out.read_text())
+        assert got["resumed_from"] == 4
+        np.testing.assert_allclose(got["final_loss"], ref["final_loss"],
+                                   rtol=1e-5)
+
+    def test_resume_skips_truncated_checkpoint(self, tmp_path):
+        """Kill + corrupt the newest surviving checkpoint: recovery must
+        checksum-detect it, quarantine, and resume from the one before."""
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH",
+                                                             "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PADDLE_TPU_CHAOS", None)
+        trainer = tmp_path / "trainer.py"
+        trainer.write_text(TRAINER_SCRIPT)
+        ckpt = tmp_path / "ckpt"
+        out = tmp_path / "out.json"
+        e = dict(env, PADDLE_TPU_CHAOS="train.step=kill@4")
+        r = subprocess.run(
+            [sys.executable, str(trainer), "--ckpt", str(ckpt),
+             "--steps", "8", "--out", str(out)],
+            cwd=repo_root, env=e, capture_output=True, text=True,
+            timeout=300)
+        assert r.returncode == chaos.KILL_EXIT_CODE, r.stderr[-2000:]
+        from conftest import corrupt_largest_file
+        corrupt_largest_file(ckpt / "ckpt-4")
+        r = subprocess.run(
+            [sys.executable, str(trainer), "--ckpt", str(ckpt),
+             "--steps", "8", "--out", str(out)],
+            cwd=repo_root, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        got = json.loads(out.read_text())
+        assert got["resumed_from"] == 3      # ckpt-4 skipped by checksum
+        assert any(n.endswith(".corrupt") for n in os.listdir(ckpt))
