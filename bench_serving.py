@@ -1,0 +1,222 @@
+"""Serving-throughput microbenchmark: closed-loop clients vs the
+lock-serialized batch-1 predictor and vs the dynamic micro-batching
+server (CPU; the comparison is dispatch-count economics, not FLOPs).
+
+Each mode starts an :class:`InferenceServer` over the same tiny saved
+model, runs ``--clients`` closed-loop threads against ``/predict`` for
+``--duration`` seconds, and reports request throughput + latency
+percentiles.  The batched server coalesces the concurrent requests into
+padded row-bucketed dispatches (one compiled call per batch), so its
+sustained RPS should exceed the serialized predictor's by roughly the
+achieved batch occupancy.
+
+    python bench_serving.py --clients 8 --duration 3 --out bench.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+
+def build_model(dirname, feature_dim=32, hidden=2048, depth=12):
+    """Save an MLP inference model with a flexible batch dim (batching
+    needs ``[-1, feature_dim]`` feeds).  The default is deliberately
+    wide and deep: on weight-traffic-bound layers a batch of N rows
+    costs barely more than one row, which is the regime dynamic
+    batching exists for (and the regime real serving models live in)."""
+    import paddle_tpu as fluid
+    import paddle_tpu.layers as layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[feature_dim])
+        h = x
+        for _ in range(depth):
+            h = layers.fc(input=h, size=hidden, act="relu")
+        pred = layers.fc(input=h, size=4)
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(dirname, ["x"], [pred], exe,
+                                      main_program=main)
+    return dirname
+
+
+class _Client:
+    """Persistent keep-alive connection (one per closed-loop thread)."""
+
+    def __init__(self, host, port, timeout=60.0):
+        self.host, self.port, self.timeout = host, port, timeout
+        self.conn = http.client.HTTPConnection(host, port, timeout=timeout)
+
+    def post(self, path, payload):
+        body = json.dumps(payload).encode()
+        try:
+            self.conn.request("POST", path, body,
+                              {"Content-Type": "application/json"})
+            r = self.conn.getresponse()
+            data = r.read()
+        except Exception:
+            self.conn.close()
+            self.conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+            raise
+        if r.status != 200:
+            raise RuntimeError(f"{r.status}: {data[:200]!r}")
+        return json.loads(data)
+
+    def get(self, path):
+        self.conn.request("GET", path)
+        r = self.conn.getresponse()
+        return json.loads(r.read())
+
+    def close(self):
+        self.conn.close()
+
+
+def _closed_loop(client, payload, stop_at, out):
+    """One closed-loop client: issue requests back-to-back until the
+    deadline, recording per-request latency and failures."""
+    while time.monotonic() < stop_at:
+        t0 = time.perf_counter()
+        try:
+            client.post("/predict", payload)
+            out["latencies"].append(time.perf_counter() - t0)
+        except Exception:
+            out["failures"] += 1
+
+
+def _percentile(xs, q):
+    from paddle_tpu.profiler import _nearest_rank
+    return _nearest_rank(sorted(xs), q)
+
+
+def run_mode(model_dir, batching, clients, duration, rows_per_request=1,
+             feature_dim=32, max_batch_size=32, max_batch_delay=0.01):
+    """Start one server, drive it with closed-loop clients, return a
+    stats dict."""
+    from paddle_tpu import profiler
+    from paddle_tpu.serving import InferenceServer
+
+    profiler.runtime_metrics.reset()  # occupancy of THIS mode only
+    server = InferenceServer(
+        model_dir, port=0, batching=batching, warmup=True,
+        max_batch_size=max_batch_size, max_batch_delay=max_batch_delay,
+        max_inflight=max(64, clients * 4), request_timeout=60.0)
+    server.start_background()
+    try:
+        assert server.wait_until_ready(300)
+        host, port = server.addr
+        rng = np.random.RandomState(0)
+        payloads = [
+            {"feeds": {"x": rng.rand(rows_per_request,
+                                     feature_dim).astype("float32").tolist()}}
+            for _ in range(clients)]
+        conns = [_Client(host, port) for _ in range(clients)]
+        # untimed warmup round: first-request compiles (exact unbucketed
+        # shapes on the serialized path) stay out of the measurement
+        for conn, pl in zip(conns, payloads):
+            conn.post("/predict", pl)
+        stats = [{"latencies": [], "failures": 0} for _ in range(clients)]
+        stop_at = time.monotonic() + duration
+        threads = [threading.Thread(target=_closed_loop,
+                                    args=(conns[i], payloads[i], stop_at,
+                                          stats[i]))
+                   for i in range(clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t0
+        lats = [x for s in stats for x in s["latencies"]]
+        ok = len(lats)
+        failures = sum(s["failures"] for s in stats)
+        snap = conns[0].get("/stats")
+        for conn in conns:
+            conn.close()
+        occupancy = snap.get("histograms", {}).get(
+            "serving.batch_occupancy", {})
+        return {
+            "mode": "batched" if batching else "serialized",
+            "requests_ok": ok,
+            "failures": failures,
+            "elapsed_sec": elapsed,
+            "rps": ok / elapsed if elapsed > 0 else 0.0,
+            "latency_ms": {
+                "p50": (_percentile(lats, 50) or 0) * 1e3,
+                "p95": (_percentile(lats, 95) or 0) * 1e3,
+                "p99": (_percentile(lats, 99) or 0) * 1e3,
+            },
+            "batch_occupancy": occupancy,
+        }
+    finally:
+        server.shutdown()
+
+
+def run_bench(clients=8, duration=3.0, rows_per_request=1, feature_dim=32,
+              hidden=2048, depth=12, max_batch_size=32,
+              max_batch_delay=0.01, model_dir=None):
+    """Both modes over one model; returns the JSON-ready summary."""
+    own_dir = model_dir is None
+    tmp = tempfile.mkdtemp(prefix="ptserve_") if own_dir else None
+    model_dir = model_dir or build_model(tmp + "/model",
+                                         feature_dim=feature_dim,
+                                         hidden=hidden, depth=depth)
+    kw = dict(clients=clients, duration=duration,
+              rows_per_request=rows_per_request, feature_dim=feature_dim,
+              max_batch_size=max_batch_size,
+              max_batch_delay=max_batch_delay)
+    serialized = run_mode(model_dir, batching=False, **kw)
+    batched = run_mode(model_dir, batching=True, **kw)
+    speedup = (batched["rps"] / serialized["rps"]
+               if serialized["rps"] else None)
+    return {
+        "clients": clients,
+        "duration_sec": duration,
+        "rows_per_request": rows_per_request,
+        "serialized": serialized,
+        "batched": batched,
+        "speedup": speedup,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--rows-per-request", type=int, default=1)
+    ap.add_argument("--feature-dim", type=int, default=32)
+    ap.add_argument("--hidden", type=int, default=2048)
+    ap.add_argument("--depth", type=int, default=12)
+    ap.add_argument("--max-batch-size", type=int, default=32)
+    ap.add_argument("--max-batch-delay", type=float, default=0.01)
+    ap.add_argument("--quick", action="store_true",
+                    help="fast smoke run (4 clients, 1s, narrower model)")
+    ap.add_argument("--out", default=None, help="write the JSON summary")
+    args = ap.parse_args(argv)
+    if args.quick:
+        args.clients, args.duration = min(args.clients, 4), 1.0
+        args.hidden, args.depth = min(args.hidden, 1024), min(args.depth, 4)
+    summary = run_bench(clients=args.clients, duration=args.duration,
+                        rows_per_request=args.rows_per_request,
+                        feature_dim=args.feature_dim, hidden=args.hidden,
+                        depth=args.depth,
+                        max_batch_size=args.max_batch_size,
+                        max_batch_delay=args.max_batch_delay)
+    text = json.dumps(summary, indent=2, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

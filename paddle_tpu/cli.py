@@ -14,6 +14,10 @@ Usage: ``python -m paddle_tpu <command> ...``
   launch  --nproc N SCRIPT [args...]         spawn an N-process cluster on
                                              this host (jax.distributed)
   serve   --model DIR --port P               HTTP inference server
+                                             (--batch --warmup
+                                             --compile-cache DIR)
+  stats   --addr HOST:PORT                   runtime metrics snapshot of
+                                             a serving replica (/stats)
   profile [--model transformer|resnet ...]   per-op device-time table of
                                              one compiled training step
   version
@@ -115,9 +119,45 @@ def _cmd_master(args):
 def _cmd_serve(args):
     """HTTP inference server over a saved model (L6 serving runtime)."""
     from paddle_tpu.serving import serve
+    if args.compile_cache:
+        # before the predictor's Executor exists, so its compiles persist
+        os.environ["PADDLE_TPU_COMPILE_CACHE"] = args.compile_cache
+    warmup_sizes = None
+    if args.warmup_batch_sizes:
+        warmup_sizes = [int(s) for s in args.warmup_batch_sizes.split(",")]
     serve(args.model, host=args.host, port=args.port,
           async_load=args.async_load, max_inflight=args.max_inflight,
-          request_timeout=args.request_timeout)
+          request_timeout=args.request_timeout, batching=args.batch,
+          max_batch_size=args.max_batch_size,
+          max_batch_delay=args.max_batch_delay,
+          batch_queue_size=args.batch_queue_size, warmup=args.warmup,
+          warmup_batch_sizes=warmup_sizes)
+    return 0
+
+
+def _cmd_stats(args):
+    """Fetch and render a server's /stats metrics snapshot."""
+    import json as _json
+
+    from paddle_tpu.serving import ServingClient
+    snap = ServingClient(args.addr).stats()
+    if args.json:
+        print(_json.dumps(snap, indent=2, sort_keys=True))
+        return 0
+    for name, v in sorted((snap.get("counters") or {}).items()):
+        print(f"{name:<36}{v:>12}")
+    for name, s in sorted((snap.get("series") or {}).items()):
+        p50, p95, p99 = s.get("p50"), s.get("p95"), s.get("p99")
+        fmt = (lambda x: f"{x * 1e3:.2f}ms" if isinstance(x, (int, float))
+               else "-")
+        print(f"{name:<36}count={s.get('count', 0):<8}"
+              f"p50={fmt(p50):<10}p95={fmt(p95):<10}p99={fmt(p99)}")
+    for name, hist in sorted((snap.get("histograms") or {}).items()):
+        print(f"{name}: " + " ".join(f"{k}:{v}" for k, v in hist.items()))
+    srv = snap.get("server") or {}
+    if srv:
+        print("server: " + " ".join(f"{k}={v}"
+                                    for k, v in sorted(srv.items())))
     return 0
 
 
@@ -242,7 +282,35 @@ def main(argv=None):
     p.add_argument("--request-timeout", type=float, default=None,
                    help="per-request deadline waiting on the predictor "
                         "(504 when exceeded)")
+    p.add_argument("--batch", action="store_true",
+                   help="coalesce concurrent /predict requests into "
+                        "padded row-bucketed micro-batches")
+    p.add_argument("--max-batch-size", type=int, default=8,
+                   help="max requests coalesced into one dispatch")
+    p.add_argument("--max-batch-delay", type=float, default=0.005,
+                   help="seconds the batcher lingers for co-batchable "
+                        "requests after the first arrives")
+    p.add_argument("--batch-queue-size", type=int, default=128,
+                   help="bounded batch queue depth before 503 "
+                        "load-shedding")
+    p.add_argument("--warmup", action="store_true",
+                   help="AOT-compile declared feed shapes / serving "
+                        "buckets before /readyz reports ready")
+    p.add_argument("--warmup-batch-sizes", default=None,
+                   help="comma-separated batch sizes to warm "
+                        "(default: the batcher's bucket edges)")
+    p.add_argument("--compile-cache", default=None,
+                   help="persistent XLA compilation cache dir "
+                        "(PADDLE_TPU_COMPILE_CACHE): restarts reuse "
+                        "compiled executables instead of recompiling")
     p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("stats", help="fetch a serving replica's /stats "
+                                     "metrics snapshot")
+    p.add_argument("--addr", required=True, help="host:port of the server")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the formatted table")
+    p.set_defaults(fn=_cmd_stats)
 
     p = sub.add_parser("profile", help="per-op device-time table of one "
                                        "compiled training step")

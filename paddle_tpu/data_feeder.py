@@ -14,14 +14,22 @@ import numpy as np
 from paddle_tpu import framework
 from paddle_tpu.framework import Variable
 
-__all__ = ["DataFeeder"]
+__all__ = ["DataFeeder", "FeedShapeError"]
+
+
+class FeedShapeError(ValueError):
+    """Fed samples cannot be reshaped to a slot's declared shape (the
+    PADDLE_ENFORCE analog at the feeder boundary — raised HERE, with the
+    slot named, instead of letting a mis-shaped array surface as a deep
+    trace error downstream)."""
 
 
 class DataToLoDTensorConverter:
-    def __init__(self, place, lod_level, shape, dtype):
+    def __init__(self, place, lod_level, shape, dtype, name=None):
         self.place = place
         self.lod_level = lod_level
         self.shape = shape
+        self.name = name
         self.dtype = np.dtype(dtype) if dtype != "bfloat16" else dtype
         self.data = []
         self.lod = [[] for _ in range(lod_level)]
@@ -40,13 +48,22 @@ class DataToLoDTensorConverter:
     def done(self):
         if self.lod_level == 0:
             arr = np.array(self.data, dtype=self.dtype)
-            if self.shape:
-                want = [d for d in self.shape]
-                # allow flattened rows: reshape to declared shape w/ -1 batch
+            inner = [d for d in self.shape[1:]] if self.shape else []
+            # the strict reshape only makes sense when every non-batch
+            # dim is concrete; with dynamic inner dims (-1/None) the
+            # stacked sample array is already the right shape
+            if inner and all(d is not None and d >= 0 for d in inner):
                 try:
-                    arr = arr.reshape([-1] + [d for d in want[1:]])
-                except ValueError:
-                    pass
+                    arr = arr.reshape([-1] + inner)
+                except ValueError as e:
+                    # a silent pass here used to feed the mis-shaped array
+                    # downstream, failing much later inside a trace; name
+                    # the slot and fail at the boundary instead
+                    raise FeedShapeError(
+                        f"feed slot {self.name or '<unnamed>'!r}: "
+                        f"{len(self.data)} sample(s) with total shape "
+                        f"{arr.shape} cannot be reshaped to declared "
+                        f"shape {tuple(self.shape)}: {e}") from e
             return arr
         flat = []
 
@@ -89,9 +106,11 @@ class DataFeeder:
     def feed(self, iterable):
         converters = [
             DataToLoDTensorConverter(self.place, lod_level=lod, shape=shape,
-                                     dtype=dtype)
-            for lod, shape, dtype in zip(self.feed_lod_level,
-                                         self.feed_shapes, self.feed_dtypes)]
+                                     dtype=dtype, name=name)
+            for lod, shape, dtype, name in zip(self.feed_lod_level,
+                                               self.feed_shapes,
+                                               self.feed_dtypes,
+                                               self.feed_names)]
         for each_sample in iterable:
             assert len(each_sample) == len(converters), \
                 "sample arity != feed arity"

@@ -17,6 +17,7 @@ launch — no Python per-op work at all.
 from __future__ import annotations
 
 import logging
+import time
 
 import jax
 import jax.numpy as jnp
@@ -28,7 +29,8 @@ from paddle_tpu.place import CPUPlace, TPUPlace
 from paddle_tpu.scope import Scope, global_scope
 from paddle_tpu.ops import registry
 
-__all__ = ["Executor", "fetch_var"]
+__all__ = ["Executor", "fetch_var", "enable_compile_cache",
+           "disable_compile_cache", "jit_cache_capacity"]
 
 logger = logging.getLogger(__name__)
 
@@ -105,6 +107,80 @@ def _as_device_array(value, dtype=None, device=None):
     if device is not None:
         arr = jax.device_put(arr, device)
     return arr
+
+
+# ---------------------------------------------------------------------------
+# persistent XLA compilation cache (PADDLE_TPU_COMPILE_CACHE): a restart
+# no longer recompiles every program from scratch — XLA executables are
+# stored under the cache dir keyed by the lowered module, and a second
+# process (or a second Executor re-tracing an identical program) loads
+# them instead of invoking the backend compiler.  Hit/miss counters land
+# in profiler.runtime_metrics (compile_cache.hits / .misses).
+# ---------------------------------------------------------------------------
+
+_compile_cache_dir = None
+
+
+def enable_compile_cache(cache_dir):
+    """Point jax's persistent compilation cache at ``cache_dir`` and relax
+    its size/compile-time admission floors so every executable is cached
+    (the floors exist to keep trivial kernels out of shared caches; a
+    serving replica wants ALL of its programs warm).  Idempotent."""
+    global _compile_cache_dir
+    if not cache_dir or _compile_cache_dir == cache_dir:
+        return _compile_cache_dir is not None
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _reset_jax_cache_memo()  # see below — without this, enabling after
+    # the process has already compiled something is silently a no-op
+    _compile_cache_dir = str(cache_dir)
+    from paddle_tpu import profiler as _profiler
+    _profiler.install_jax_compile_listeners()
+    return True
+
+
+def disable_compile_cache():
+    """Turn the persistent cache back off (tests; config symmetry)."""
+    global _compile_cache_dir
+    if _compile_cache_dir is None:
+        return
+    jax.config.update("jax_compilation_cache_dir", None)
+    _reset_jax_cache_memo()
+    _compile_cache_dir = None
+
+
+def _reset_jax_cache_memo():
+    """jax memoizes cache-enabled/disabled at the FIRST compile of the
+    process (compilation_cache._cache_checked); reset it so a dir set
+    mid-process (serving replica enabling the cache at load time) takes
+    effect."""
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - internal API moved
+        logger.warning("could not reset jax compilation-cache state; "
+                       "a cache dir set after the first compile may be "
+                       "ignored", exc_info=True)
+
+
+def _maybe_enable_compile_cache_from_env():
+    import os
+    d = os.environ.get("PADDLE_TPU_COMPILE_CACHE", "").strip()
+    if d:
+        enable_compile_cache(d)
+
+
+def jit_cache_capacity():
+    """Executor-level jit LRU capacity: PADDLE_TPU_JIT_CACHE_SIZE
+    (default 64; values < 1 clamp to 1)."""
+    import os
+    raw = os.environ.get("PADDLE_TPU_JIT_CACHE_SIZE", "").strip()
+    try:
+        return max(1, int(raw)) if raw else 64
+    except ValueError:
+        logger.warning("bad PADDLE_TPU_JIT_CACHE_SIZE=%r; using 64", raw)
+        return 64
 
 
 class _CompiledBlock:
@@ -247,7 +323,25 @@ class Executor:
             TPUPlace(0) if any(d.platform != "cpu" for d in jax.devices())
             else CPUPlace())
         self._cache = {}
+        self._cache_capacity = jit_cache_capacity()
+        self._cache_inserts = 0  # lifetime insert count (eviction-proof)
         self._run_counter = 0
+        _maybe_enable_compile_cache_from_env()
+        from paddle_tpu import profiler as _profiler
+        _profiler.install_jax_compile_listeners()
+
+    # ------------------------------------------------------------------
+    def _cache_insert(self, sig, value):
+        """LRU insert bounded by PADDLE_TPU_JIT_CACHE_SIZE; evictions are
+        counted (jit_cache.evictions) — a serving process churning through
+        more signatures than the cache holds is recompiling, and the
+        counter is how you see it."""
+        from paddle_tpu import profiler as _profiler
+        while len(self._cache) >= self._cache_capacity:
+            self._cache.pop(next(iter(self._cache)))
+            _profiler.runtime_metrics.inc("jit_cache.evictions")
+        self._cache[sig] = value
+        self._cache_inserts += 1
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -305,8 +399,12 @@ class Executor:
         key = jax.random.PRNGKey(
             (program.random_seed or 0) * 1000003 + self._run_counter)
 
+        t0 = time.perf_counter()
         fetches, new_state = compiled.fn(feed_arrays, ro_state, inout_state,
                                          key)
+        from paddle_tpu import profiler as _profiler
+        _profiler.runtime_metrics.observe("executor.step_seconds",
+                                          time.perf_counter() - t0)
         if _check_nan_inf_enabled(program):
             _check_nan_inf(fetch_names, fetches, new_state)
         for n, v in new_state.items():
@@ -314,6 +412,72 @@ class Executor:
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
+
+    # ------------------------------------------------------------------
+    def warmup(self, program=None, feed_shapes=None, fetch_list=None,
+               scope=None, allow_state_updates=False):
+        """AOT warmup: trace + lower + compile ``program`` for each
+        declared feed signature BEFORE real traffic arrives, so the first
+        real request pays zero compile time.
+
+        ``feed_shapes``: a dict ``name -> concrete shape`` (one
+        signature), or a list of such dicts (one per serving bucket).
+        Every listed dim must be concrete — warmup exists to pin exact
+        signatures.  Dtypes come from the program's variables.  Each
+        signature is executed once on zero-filled feeds, which lands the
+        executable in this executor's jit cache and — when
+        PADDLE_TPU_COMPILE_CACHE is set — in the persistent XLA cache,
+        where a restarted process finds it again.
+
+        Warmup EXECUTES the program, so a program that writes persistable
+        state (a training step: parameters, optimizer moments) would be
+        mutated by zero-filled feeds — that is refused unless
+        ``allow_state_updates=True`` is passed explicitly.
+
+        Returns the number of signatures that were freshly compiled
+        (0 = everything was already warm)."""
+        program = program if program is not None else default_main_program()
+        specs = feed_shapes if isinstance(feed_shapes, (list, tuple)) \
+            else [feed_shapes or {}]
+        block = program.global_block()
+        if not allow_state_updates:
+            written = [n for op in block.ops if op.type not in _SKIP_OPS
+                       for n in op.output_arg_names
+                       if block.has_var(n) and block.var(n).persistable]
+            if written:
+                raise ValueError(
+                    f"warmup would EXECUTE this program, mutating "
+                    f"persistable state ({sorted(set(written))[:3]}...) "
+                    f"with zero-filled feeds — warm an inference program "
+                    f"instead, or pass allow_state_updates=True if the "
+                    f"state writes are intended")
+        # count INSERTS, not the cache-size delta: a full LRU evicting
+        # during warmup would otherwise report 0 (or negative) compiles
+        before = self._cache_inserts
+        from paddle_tpu import profiler as _profiler
+        with _profiler.record_latency("executor.warmup_seconds"):
+            for spec in specs:
+                feed = {}
+                for name, shape in spec.items():
+                    if shape is None or any(
+                            d is None or int(d) < 0 for d in shape):
+                        raise ValueError(
+                            f"warmup feed {name!r} needs a concrete "
+                            f"shape, got {shape}")
+                    var = block.var(name) if block.has_var(name) else None
+                    dtype = (var.dtype if var is not None
+                             and var.dtype is not None else "float32")
+                    shape = tuple(int(d) for d in shape)
+                    if dtype == "bfloat16":
+                        feed[name] = jnp.zeros(shape, jnp.bfloat16)
+                    else:
+                        feed[name] = np.zeros(shape, np.dtype(dtype))
+                self.run(program, feed=feed, fetch_list=fetch_list,
+                         scope=scope)
+        compiled = self._cache_inserts - before
+        _profiler.runtime_metrics.inc("warmup.signatures", len(specs))
+        _profiler.runtime_metrics.inc("warmup.compiles", compiled)
+        return compiled
 
     # ------------------------------------------------------------------
     def run_steps(self, program=None, feed=None, fetch_list=None, steps=1,
@@ -465,10 +629,13 @@ class Executor:
             return [np.asarray(v) for v in stacked] if return_numpy \
                 else stacked
 
+        from paddle_tpu import profiler as _profiler
         if sig in self._cache:
             self._cache[sig] = self._cache.pop(sig)
             fn = self._cache[sig]
+            _profiler.runtime_metrics.inc("jit_cache.hits")
         else:
+            _profiler.runtime_metrics.inc("jit_cache.misses")
             def multi(const_feeds, per_feeds, ro_state, carry, base_key):
                 keys = jax.random.split(base_key, steps)
 
@@ -485,9 +652,7 @@ class Executor:
                 return ys, carry
 
             fn = jax.jit(multi, donate_argnums=(3,))
-            if len(self._cache) >= 64:
-                self._cache.pop(next(iter(self._cache)))
-            self._cache[sig] = fn
+            self._cache_insert(sig, fn)
 
         carry = dict(inout_state)
         # write-only persistables (create_state) ride the carry too so the
@@ -696,13 +861,17 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _get_compiled(self, program, block, feed_arrays, fetch_names, scope):
+        from paddle_tpu import profiler as _profiler
         sig = self._signature(program, block, feed_arrays, fetch_names,
                               scope)
         if sig in self._cache:
             self._cache[sig] = self._cache.pop(sig)  # LRU bump
+            _profiler.runtime_metrics.inc("jit_cache.hits")
             return self._cache[sig]
-        parts = self._prepare(program, block, feed_arrays, fetch_names,
-                              scope)
+        _profiler.runtime_metrics.inc("jit_cache.misses")
+        with _profiler.record_latency("executor.prepare_seconds"):
+            parts = self._prepare(program, block, feed_arrays, fetch_names,
+                                  scope)
 
         if parts["interpret"]:
             # op-by-op eager execution — needed when a host op (data-
@@ -714,9 +883,7 @@ class Executor:
         compiled = _CompiledBlock(fn, parts["feed_names"],
                                   parts["ro_names"], parts["inout_names"],
                                   tuple(fetch_names), parts["uses_rng"])
-        if len(self._cache) >= 64:  # LRU-evict the coldest executable
-            self._cache.pop(next(iter(self._cache)))
-        self._cache[sig] = compiled
+        self._cache_insert(sig, compiled)
         return compiled
 
     @staticmethod

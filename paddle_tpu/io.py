@@ -28,7 +28,8 @@ from paddle_tpu.scope import global_scope
 __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
-    "load_inference_model", "save_checkpoint", "load_checkpoint", "get_inference_program",
+    "load_inference_model", "save_checkpoint", "load_checkpoint",
+    "get_inference_program", "infer_feed_specs",
 ]
 
 
@@ -189,6 +190,30 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     save_persistables(executor, dirname, inference_program,
                       params_filename or "__params__")
     return fetch_var_names
+
+
+def infer_feed_specs(program, feed_names):
+    """Declared feed signatures of an inference program: a dict
+    ``name -> {"shape": tuple (None for dynamic dims), "dtype": str,
+    "lod_level": int}`` — what a server needs to synthesize AOT-warmup
+    batches (``Executor.warmup`` / ``serving.Predictor.warmup``) for the
+    model's declared shapes without ever seeing a real request."""
+    block = program.global_block()
+    specs = {}
+    for name in feed_names:
+        var = block.var(name) if block.has_var(name) else None
+        if var is None:
+            specs[name] = {"shape": None, "dtype": "float32",
+                           "lod_level": 0}
+            continue
+        shape = None
+        if var.shape is not None:
+            shape = tuple(None if d is None or int(d) < 0 else int(d)
+                          for d in var.shape)
+        specs[name] = {"shape": shape,
+                       "dtype": var.dtype or "float32",
+                       "lod_level": getattr(var, "lod_level", 0) or 0}
+    return specs
 
 
 def load_inference_model(dirname, executor, model_filename=None,

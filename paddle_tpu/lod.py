@@ -24,7 +24,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["DynLoD", "next_bucket", "bucket_ragged_feed", "SPLITS_SUFFIX"]
+__all__ = ["DynLoD", "next_bucket", "row_bucket", "bucket_edges",
+           "bucket_ragged_feed", "SPLITS_SUFFIX"]
 
 SPLITS_SUFFIX = "@lod0"
 
@@ -37,6 +38,31 @@ def next_bucket(n):
     while b < n:
         b *= 2
     return b
+
+
+def row_bucket(n, edges=None):
+    """Round a row count up to a stable jit-cache bucket.
+
+    ``edges``: optional sorted iterable of custom bucket edges (the
+    serving batcher's knob); counts past the largest edge fall back to
+    the power-of-two ladder so the key stays bounded either way."""
+    n = max(int(n), 1)
+    if edges:
+        for e in edges:
+            if n <= int(e):
+                return int(e)
+    return next_bucket(n)
+
+
+def bucket_edges(lo, hi, edges=None):
+    """The distinct buckets covering row counts in [lo, hi] — what a
+    server warms up ahead of time so no real request compiles."""
+    out = []
+    for n in range(max(int(lo), 1), max(int(hi), 1) + 1):
+        b = row_bucket(n, edges)
+        if not out or b != out[-1]:
+            out.append(b)
+    return out
 
 
 class DynLoD:

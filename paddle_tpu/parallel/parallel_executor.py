@@ -84,13 +84,21 @@ class ParallelExecutor(Executor):
         feed_lods = tuple(sorted(
             (n, _freeze_lod(scope.find_lod(n))) for n in feed_arrays
             if scope.find_lod(n) is not None))
+        from paddle_tpu import profiler as _profiler
         sig = ("pexe", id(program), program._version, block.idx,
                tuple(sorted((n, str(a.dtype), a.shape)
                             for n, a in feed_arrays.items())),
                feed_lods,
                fetch_names)
         if sig in self._cache:
+            self._cache[sig] = self._cache.pop(sig)  # LRU bump
+            _profiler.runtime_metrics.inc("jit_cache.hits")
             return self._cache[sig]
+        # count the sharded-wrapper miss HERE: super() below also counts
+        # its base-signature lookup, and that one can legitimately hit
+        # while this level re-jits (each parallel program holds two
+        # cache entries — base step + sharded wrapper)
+        _profiler.runtime_metrics.inc("jit_cache.misses")
 
         base = super()._get_compiled(program, block, feed_arrays,
                                      fetch_names, scope)
@@ -177,7 +185,7 @@ class ParallelExecutor(Executor):
 
         compiled = _CompiledBlock(fn, base.feed_names, base.ro_names,
                                   base.inout_names, tuple(fetch_names), True)
-        self._cache[sig] = compiled
+        self._cache_insert(sig, compiled)
         return compiled
 
     def _feed_device(self):

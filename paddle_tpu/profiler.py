@@ -11,15 +11,19 @@ reference API.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
+import threading
 import time
 
 import jax
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler",
            "start_profiler", "stop_profiler", "enable_op_profiling",
-           "disable_op_profiling", "op_profile_table", "op_profiler"]
+           "disable_op_profiling", "op_profile_table", "op_profiler",
+           "RuntimeMetrics", "runtime_metrics", "record_latency",
+           "install_jax_compile_listeners"]
 
 _trace_dir = None
 _start_time = None
@@ -326,6 +330,171 @@ def compiled_op_table(trace_dir, sorted_key="total"):
         lines.append(f"{op_type:<28}{n:>8}{total * 1e3:>12.3f}"
                      f"{total / max(n, 1) * 1e3:>12.3f}")
     return "\n".join(lines), rows
+
+
+# ---------------------------------------------------------------------------
+# runtime metrics surface (serving/compile hot path): counters, latency
+# percentiles, and small-value histograms, exported via the inference
+# server's /stats endpoint and `paddle_tpu stats`.  The reference exposes
+# analogous counters through its pserver/master Prometheus handlers
+# (go/pserver/service.go); here one process-wide registry serves the
+# executor (jit-cache hits/evictions, compile seconds), the persistent
+# XLA compilation cache (hits/misses via jax monitoring events), and the
+# serving batcher (request latency, batch occupancy).
+# ---------------------------------------------------------------------------
+
+_LATENCY_WINDOW = 2048  # samples kept per series for percentile estimates
+
+
+def _nearest_rank(sorted_xs, q):
+    """Nearest-rank percentile over an ascending-sorted list (shared by
+    percentiles() and snapshot() so the two can never drift)."""
+    if not sorted_xs:
+        return None
+    i = min(len(sorted_xs) - 1,
+            max(0, int(round(q / 100.0 * len(sorted_xs))) - 1))
+    return sorted_xs[i]
+
+
+class RuntimeMetrics:
+    """Thread-safe process-wide counters + bounded latency reservoirs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = collections.Counter()
+        self._series = {}       # name -> deque[float] (bounded window)
+        self._series_agg = {}   # name -> [count, total]  (unwindowed)
+        self._hist = {}         # name -> Counter (small integer values)
+
+    # -- writers -------------------------------------------------------
+    def inc(self, name, n=1):
+        with self._lock:
+            self._counters[name] += n
+
+    def observe(self, name, value):
+        """Record one sample (seconds, rows, ...) into a bounded window."""
+        with self._lock:
+            d = self._series.get(name)
+            if d is None:
+                d = self._series[name] = collections.deque(
+                    maxlen=_LATENCY_WINDOW)
+                self._series_agg[name] = [0, 0.0]
+            d.append(float(value))
+            agg = self._series_agg[name]
+            agg[0] += 1
+            agg[1] += float(value)
+
+    def bucket(self, name, key):
+        """Histogram over small discrete values (batch occupancy)."""
+        with self._lock:
+            self._hist.setdefault(name, collections.Counter())[int(key)] += 1
+
+    # -- readers -------------------------------------------------------
+    def counter(self, name):
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def percentiles(self, name, qs=(50, 95, 99)):
+        with self._lock:
+            d = self._series.get(name)
+            xs = sorted(d) if d else []
+        return {f"p{q}": _nearest_rank(xs, q) for q in qs}
+
+    def snapshot(self):
+        """One JSON-serializable dict of everything (the /stats body)."""
+        with self._lock:
+            counters = dict(self._counters)
+            hist = {n: {str(k): v for k, v in sorted(c.items())}
+                    for n, c in self._hist.items()}
+            series = {n: (list(d), list(self._series_agg[n]))
+                      for n, d in self._series.items()}
+        latency = {}
+        for name, (window, (count, total)) in series.items():
+            xs = sorted(window)
+            entry = {"count": count, "total": total,
+                     "mean": (total / count) if count else None}
+            for q in (50, 95, 99):
+                entry[f"p{q}"] = _nearest_rank(xs, q)
+            # 1/mean — a true rate ONLY for serially-recorded series
+            # (executor.step_seconds = steps/sec); for concurrent
+            # series (request latencies) it is NOT throughput — divide
+            # a request counter by wall time instead
+            entry["per_sec_serial"] = (count / total) if total else None
+            latency[name] = entry
+        return {"counters": counters, "series": latency,
+                "histograms": hist}
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._series.clear()
+            self._series_agg.clear()
+            self._hist.clear()
+
+
+runtime_metrics = RuntimeMetrics()
+
+
+@contextlib.contextmanager
+def record_latency(name, metrics=None):
+    """Time the body and observe it as one sample of ``name``."""
+    m = metrics or runtime_metrics
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        m.observe(name, time.perf_counter() - t0)
+
+
+_jax_listeners_installed = False
+
+
+def install_jax_compile_listeners():
+    """Mirror jax's compile/compilation-cache monitoring events into the
+    runtime metrics registry (idempotent):
+
+    - ``compile_cache.hits`` / ``compile_cache.misses``: persistent XLA
+      compilation-cache outcomes (PADDLE_TPU_COMPILE_CACHE) — a warm
+      restart shows hits where a cold one shows misses;
+    - ``compile.backend_seconds`` / ``compile.trace_seconds`` /
+      ``compile.lower_seconds``: where compile time goes (XLA backend vs
+      jaxpr trace vs MLIR lowering).
+    """
+    global _jax_listeners_installed
+    if _jax_listeners_installed:
+        return True
+    try:
+        from jax._src import monitoring
+    except ImportError:  # pragma: no cover - monitoring moved/absent
+        return False
+
+    _EVENT_COUNTERS = {
+        "/jax/compilation_cache/cache_hits": "compile_cache.hits",
+        "/jax/compilation_cache/cache_misses": "compile_cache.misses",
+    }
+    _DURATION_SERIES = {
+        "/jax/core/compile/backend_compile_duration":
+            "compile.backend_seconds",
+        "/jax/core/compile/jaxpr_trace_duration": "compile.trace_seconds",
+        "/jax/core/compile/jaxpr_to_mlir_module_duration":
+            "compile.lower_seconds",
+    }
+
+    def _on_event(event, **kw):
+        name = _EVENT_COUNTERS.get(event)
+        if name is not None:
+            runtime_metrics.inc(name)
+
+    def _on_duration(event, duration, **kw):
+        name = _DURATION_SERIES.get(event)
+        if name is not None:
+            runtime_metrics.observe(name, duration)
+            runtime_metrics.inc("compile.events")
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _jax_listeners_installed = True
+    return True
 
 
 @contextlib.contextmanager

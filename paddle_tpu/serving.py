@@ -12,16 +12,25 @@ image of the reference, which embedded CPython in its C++ data layer
 from __future__ import annotations
 
 import json
+import logging
 import threading
+import time
 
 import numpy as np
 
-__all__ = ["Predictor", "serve", "InferenceServer", "DeadlineExceeded",
-           "ServingClient", "ServingError"]
+logger = logging.getLogger(__name__)
+
+__all__ = ["Predictor", "serve", "InferenceServer", "MicroBatcher",
+           "DeadlineExceeded", "QueueFull", "ServingClient", "ServingError"]
 
 
 class DeadlineExceeded(RuntimeError):
     """A request timed out waiting for the predictor (queue saturation)."""
+
+
+class QueueFull(RuntimeError):
+    """The batcher's bounded request queue is full (load shedding — the
+    caller gets a retryable 503 instead of queueing unboundedly)."""
 
 
 class ServingError(RuntimeError):
@@ -48,6 +57,10 @@ class Predictor:
         self._fluid = fluid
         self._scope = fluid.Scope()
         self._lock = threading.Lock()  # Executor/scope are not re-entrant
+        # None until a batched dispatch proves (True) or disproves
+        # (False) that outputs track the row axis; False short-circuits
+        # run_many straight to per-request dispatches
+        self._row_scatter_ok = None
         with fluid.scope_guard(self._scope):
             self._exe = fluid.Executor()
             (self._program, self._feed_names,
@@ -83,6 +96,295 @@ class Predictor:
         finally:
             self._lock.release()
         return [np.asarray(o) for o in outs]
+
+    def run_many(self, feeds_list, timeout=None):
+        """Run several per-request feed dicts as ONE padded, row-bucketed
+        dispatch (the micro-batching hot path).
+
+        All requests must be batch-compatible — same feed names, dtypes
+        and trailing dims, with a shared leading (row) axis; see
+        :func:`batch_key`.  Rows are concatenated, zero-padded up to a
+        ``lod.row_bucket`` edge (so the jit-cache key is the bucket, not
+        the exact total), dispatched once, and the outputs are scattered
+        back by row ranges.  Outputs whose leading dim does not track the
+        row axis (e.g. a batch-reduced scalar) cannot be scattered: the
+        batch falls back to per-request runs (counted as
+        ``serving.batch_fallbacks``).  Returns a list of per-request
+        output lists."""
+        from paddle_tpu import profiler as _profiler
+        from paddle_tpu.lod import row_bucket
+
+        if self._row_scatter_ok is False:
+            # this model's outputs were seen not to track the row axis:
+            # skip the (wasted) batched attempt entirely
+            return [self.run(f, timeout=timeout) for f in feeds_list]
+        if len(feeds_list) == 1:
+            key, _ = batch_key(feeds_list[0])
+            if key is None:
+                return [self.run(feeds_list[0], timeout=timeout)]
+        rows = []
+        for f in feeds_list:
+            _, r = batch_key(f)
+            if r is None:
+                raise ValueError("run_many got a non-batchable request in "
+                                 "a batch of size > 1")
+            rows.append(r)
+        total = sum(rows)
+        bucket = row_bucket(total)
+        names = sorted(feeds_list[0])
+        feed = {}
+        for name in names:
+            parts = [np.asarray(f[name]) for f in feeds_list]
+            cat = parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
+            if bucket > total:
+                pad = np.zeros((bucket - total,) + cat.shape[1:], cat.dtype)
+                cat = np.concatenate([cat, pad], 0)
+            feed[name] = cat
+        outs = self.run(feed, timeout=timeout)
+        if any(o.ndim == 0 or o.shape[0] != bucket for o in outs):
+            # row-misaligned outputs: correctness beats throughput —
+            # and remember, so later batches skip the wasted attempt
+            self._row_scatter_ok = False
+            logger.warning(
+                "model outputs do not track the batch row axis; "
+                "micro-batching disabled for this predictor (requests "
+                "dispatch individually)")
+            _profiler.runtime_metrics.inc("serving.batch_fallbacks")
+            return [self.run(f, timeout=timeout) for f in feeds_list]
+        self._row_scatter_ok = True
+        results, off = [], 0
+        for r in rows:
+            results.append([o[off:off + r] for o in outs])
+            off += r
+        return results
+
+    def warmup(self, batch_sizes=(1,), bucket=True):
+        """AOT-compile the model for each batch size before traffic
+        arrives (`Executor.warmup` over the DECLARED feed shapes of the
+        loaded inference program).  ``bucket=True`` rounds sizes through
+        ``lod.row_bucket`` — the shapes BATCHED dispatches actually see;
+        pass ``bucket=False`` on the serialized path, where requests run
+        unpadded and only exact sizes match.  Feeds whose trailing dims
+        are dynamic or that carry LoD cannot be synthesized — warmup
+        then skips (logged + ``warmup.skipped`` counter) and returns 0.
+        Returns the number of fresh compiles."""
+        from paddle_tpu import io as _io
+        from paddle_tpu.lod import row_bucket
+
+        from paddle_tpu import profiler as _profiler
+        specs = _io.infer_feed_specs(self._program, self._feed_names)
+        shapes = {}
+        for name, spec in specs.items():
+            shape = spec["shape"]
+            if shape is None or spec["lod_level"] or len(shape) == 0 or \
+                    any(d is None for d in shape[1:]):
+                # can't synthesize this feed — say so loudly: /readyz
+                # will flip with NOTHING compiled, and the first real
+                # request pays the compile warmup exists to avoid
+                logger.warning(
+                    "warmup skipped: feed %r has dynamic non-batch dims "
+                    "or LoD (%r) — no signature can be synthesized",
+                    name, shape)
+                _profiler.runtime_metrics.inc("warmup.skipped")
+                return 0
+            shapes[name] = shape
+        sigs, seen = [], set()
+        sizes = {row_bucket(b) if bucket else max(int(b), 1)
+                 for b in batch_sizes}
+        for b in sorted(sizes):
+            sig = {name: tuple(shape) if shape[0] is not None
+                   else (b,) + tuple(shape[1:])
+                   for name, shape in shapes.items()}
+            frozen = tuple(sorted((n, s) for n, s in sig.items()))
+            if frozen not in seen:
+                seen.add(frozen)
+                sigs.append(sig)
+        with self._lock:
+            with self._fluid.scope_guard(self._scope):
+                return self._exe.warmup(self._program, sigs,
+                                        fetch_list=self._fetch_targets,
+                                        scope=self._scope)
+
+
+def batch_key(feed):
+    """(compatibility key, rows) for a request feed — requests sharing a
+    key can ride one padded dispatch (same feed names/dtypes/trailing
+    dims form one stable jit-cache bucket).  ``(None, None)`` marks a
+    non-batchable request: a rank-0 feed, or feeds that disagree on the
+    leading (row) dim."""
+    rows = None
+    parts = []
+    for name in sorted(feed):
+        a = np.asarray(feed[name])
+        if a.ndim == 0:
+            return None, None
+        if rows is None:
+            rows = int(a.shape[0])
+        elif int(a.shape[0]) != rows:
+            return None, None
+        parts.append((name, str(a.dtype), tuple(a.shape[1:])))
+    if rows is None or rows == 0:
+        return None, None
+    return tuple(parts), rows
+
+
+class _Pending:
+    """One enqueued request awaiting its batch slot."""
+
+    __slots__ = ("feed", "key", "rows", "event", "result", "error",
+                 "abandoned")
+
+    def __init__(self, feed, key, rows):
+        self.feed = feed
+        self.key = key
+        self.rows = rows
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.abandoned = False
+
+
+class MicroBatcher:
+    """Dynamic request micro-batching over a :class:`Predictor`.
+
+    Concurrent ``submit`` calls land in a bounded queue; a single batcher
+    thread coalesces batch-compatible requests — up to ``max_batch_size``
+    requests / ``max_batch_rows`` total rows, waiting at most
+    ``max_batch_delay`` seconds after the first — into ONE padded
+    dispatch through ``Predictor.run_many``, and scatters per-request
+    outputs back.  Mixed-shape requests (different trailing dims or feed
+    sets) never share a batch: each compatibility key is its own bucket.
+
+    Degradation semantics mirror the serialized path: a full queue raises
+    :class:`QueueFull` (503 load shedding), a request whose result does
+    not arrive within its timeout raises :class:`DeadlineExceeded` (504)
+    and its queue slot is abandoned."""
+
+    def __init__(self, predictor, max_batch_size=8, max_batch_delay=0.005,
+                 queue_size=128, max_batch_rows=None):
+        from paddle_tpu.lod import row_bucket
+        self._predictor = predictor
+        self.max_batch_size = max(1, int(max_batch_size))
+        self.max_batch_delay = max(0.0, float(max_batch_delay))
+        self.queue_size = max(1, int(queue_size))
+        self.max_batch_rows = int(max_batch_rows) if max_batch_rows \
+            else max(row_bucket(self.max_batch_size), self.max_batch_size)
+        self._queue = []
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="paddle-tpu-batcher")
+        self._thread.start()
+
+    @property
+    def queue_depth(self):
+        with self._cv:
+            return len(self._queue)
+
+    def submit(self, feed, timeout=None):
+        """Enqueue one request feed and block for its outputs."""
+        from paddle_tpu import profiler as _profiler
+        missing = [n for n in self._predictor.feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"missing feeds: {missing}")
+        key, rows = batch_key(feed)
+        p = _Pending(feed, key, rows)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is shut down")
+            if len(self._queue) >= self.queue_size:
+                _profiler.runtime_metrics.inc("serving.queue_rejections")
+                raise QueueFull(
+                    f"batch queue full ({self.queue_size} pending)")
+            self._queue.append(p)
+            self._cv.notify_all()
+        if not p.event.wait(timeout):
+            with self._cv:
+                p.abandoned = True
+                # free the queue slot NOW: a dead entry left in place
+                # would count toward queue_size and shed live traffic
+                try:
+                    self._queue.remove(p)
+                except ValueError:
+                    pass  # already taken into a batch
+            _profiler.runtime_metrics.inc("serving.deadline_exceeded")
+            raise DeadlineExceeded(
+                f"request waited more than {timeout}s for its batch")
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+
+    # -- batcher thread ------------------------------------------------
+    def _take_compatible(self, batch, key, rows_budget):
+        """Move queued requests compatible with ``key`` into ``batch``
+        (holding the lock); returns the remaining row budget."""
+        i = 0
+        while i < len(self._queue):
+            if len(batch) >= self.max_batch_size or rows_budget <= 0 or \
+                    key is None:
+                break
+            p = self._queue[i]
+            if p.abandoned:
+                self._queue.pop(i)
+                continue
+            if p.key == key and p.rows <= rows_budget:
+                self._queue.pop(i)
+                batch.append(p)
+                rows_budget -= p.rows
+                continue
+            i += 1
+        return rows_budget
+
+    def _loop(self):
+        while True:
+            batch = []
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait(0.05)
+                if not self._queue:
+                    if self._closed:
+                        return
+                    continue
+                first = self._queue.pop(0)
+                if first.abandoned:
+                    continue
+                batch.append(first)
+                budget = self.max_batch_rows - (first.rows or 0)
+                # linger up to max_batch_delay for co-batchable arrivals
+                deadline = time.monotonic() + self.max_batch_delay
+                while first.key is not None and \
+                        len(batch) < self.max_batch_size and budget > 0:
+                    budget = self._take_compatible(batch, first.key, budget)
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or \
+                            len(batch) >= self.max_batch_size or budget <= 0:
+                        break
+                    self._cv.wait(remaining)
+            self._dispatch(batch)
+
+    def _dispatch(self, batch):
+        from paddle_tpu import profiler as _profiler
+        from paddle_tpu.fault import chaos
+        try:
+            chaos.fire("serving.batch", size=len(batch))
+            _profiler.runtime_metrics.bucket("serving.batch_occupancy",
+                                             len(batch))
+            _profiler.runtime_metrics.inc("serving.batches")
+            results = self._predictor.run_many([p.feed for p in batch])
+        except BaseException as e:
+            for p in batch:
+                p.error = e
+                p.event.set()
+            return
+        for p, r in zip(batch, results):
+            p.result = r
+            p.event.set()
 
 
 # ---------------------------------------------------------------------------
@@ -133,13 +435,27 @@ class InferenceServer:
     ``async_load=True`` starts serving immediately and loads the model
     in the background (k8s-style: readiness gates traffic, liveness
     doesn't kill the pod during a long restore).
+
+    ``batching=True`` coalesces concurrent ``/predict`` requests into
+    padded, row-bucketed micro-batches through a :class:`MicroBatcher`
+    (one compiled dispatch per batch instead of one per request); the
+    per-request 503/504 degradation semantics are preserved.
+    ``warmup=True`` AOT-compiles the declared serving buckets during
+    load, BEFORE ``/readyz`` flips — the first real request never pays a
+    compile.  ``/stats`` serves the runtime metrics snapshot
+    (``profiler.runtime_metrics``) plus server/batcher state.
     """
 
     def __init__(self, model_dir, host="127.0.0.1", port=0,
-                 async_load=False, max_inflight=32, request_timeout=None):
+                 async_load=False, max_inflight=32, request_timeout=None,
+                 batching=False, max_batch_size=8, max_batch_delay=0.005,
+                 batch_queue_size=128, warmup=False,
+                 warmup_batch_sizes=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         from paddle_tpu.fault import chaos
+        from paddle_tpu import profiler as _profiler
+        from paddle_tpu.lod import bucket_edges
 
         self.predictor = None
         self._ready = threading.Event()
@@ -147,12 +463,39 @@ class InferenceServer:
         self._load_error = None
         self._slots = threading.BoundedSemaphore(max_inflight)
         self._request_timeout = request_timeout
+        self._batcher = None
+        self._batch_conf = {"batching": bool(batching),
+                            "max_batch_size": int(max_batch_size),
+                            "max_batch_delay": float(max_batch_delay),
+                            "batch_queue_size": int(batch_queue_size)}
+        if warmup_batch_sizes is None and warmup:
+            # cover every bucket a batch of 1..max rows can pad into, so
+            # no steady-state batched dispatch compiles after /readyz
+            warmup_batch_sizes = bucket_edges(
+                1, max(int(max_batch_size), 1)) if batching else (1,)
+        self._warmup_batch_sizes = tuple(warmup_batch_sizes or ())
+        self._do_warmup = bool(warmup)
         server = self
 
         def _load():
             try:
                 chaos.fire("serving.load", model_dir=model_dir)
-                server.predictor = Predictor(model_dir)
+                predictor = Predictor(model_dir)
+                if server._do_warmup:
+                    chaos.fire("serving.warmup", model_dir=model_dir)
+                    # batched dispatches see row-bucketed (padded)
+                    # shapes; serialized ones see exact request shapes
+                    predictor.warmup(
+                        server._warmup_batch_sizes or (1,),
+                        bucket=server._batch_conf["batching"])
+                if server._batch_conf["batching"]:
+                    server._batcher = MicroBatcher(
+                        predictor,
+                        max_batch_size=server._batch_conf["max_batch_size"],
+                        max_batch_delay=server._batch_conf
+                        ["max_batch_delay"],
+                        queue_size=server._batch_conf["batch_queue_size"])
+                server.predictor = predictor
                 server._ready.set()
             except BaseException as e:
                 server._load_error = e
@@ -160,6 +503,11 @@ class InferenceServer:
                 server._load_done.set()
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: every reply carries Content-Length, so
+            # closed-loop clients reuse one connection (and one server
+            # thread) instead of paying connect/teardown per request
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # quiet
                 pass
 
@@ -210,11 +558,40 @@ class InferenceServer:
                         self._reply(200,
                                     {"feeds": predictor.feed_names,
                                      "fetches": predictor.fetch_names})
+                elif self.path == "/stats":
+                    snap = _profiler.runtime_metrics.snapshot()
+                    batcher = server._batcher
+                    snap["server"] = dict(
+                        server._batch_conf,
+                        ready=server._ready.is_set(),
+                        request_timeout=server._request_timeout,
+                        queue_depth=batcher.queue_depth if batcher else 0,
+                        warmup_batch_sizes=list(
+                            server._warmup_batch_sizes))
+                    self._reply(200, snap)
                 else:
                     self._error(404, "not_found", self.path,
                                 retryable=False)
 
             def do_POST(self):
+                # drain the body FIRST: replying on an early-error path
+                # with unread body bytes would desync a keep-alive
+                # connection (the next request would parse mid-body)
+                if "Content-Length" not in self.headers:
+                    # no declared length (absent or chunked body): the
+                    # body can't be drained, so the connection can't be
+                    # reused — close it after this reply
+                    self.close_connection = True
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(n)
+                except ValueError:
+                    # unreadable length: same problem, same remedy
+                    self.close_connection = True
+                    self._error(400, "bad_request",
+                                "invalid Content-Length header",
+                                retryable=False)
+                    return
                 if self.path not in ("/predict", "/run"):
                     self._error(404, "not_found", self.path,
                                 retryable=False)
@@ -227,22 +604,29 @@ class InferenceServer:
                     self._error(503, "overloaded",
                                 "all inference slots busy", retryable=True)
                     return
+                t0 = time.perf_counter()
                 try:
                     chaos.fire("serving.run", path=self.path)
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n))
+                    req = json.loads(raw)
                     feed = {k: np.asarray(v, dtype="float32")
                             if not isinstance(v, dict)
                             else np.asarray(v["data"],
                                             dtype=v.get("dtype", "float32"))
                             for k, v in req["feeds"].items()}
-                    outs = predictor.run(
-                        feed, timeout=server._request_timeout)
+                    if server._batcher is not None:
+                        outs = server._batcher.submit(
+                            feed, timeout=server._request_timeout)
+                    else:
+                        outs = predictor.run(
+                            feed, timeout=server._request_timeout)
+                    _profiler.runtime_metrics.inc("serving.requests_ok")
                     self._reply(200, {"outputs": [o.tolist() for o in outs],
                                       "shapes": [list(o.shape)
                                                  for o in outs],
                                       "dtypes": [str(o.dtype)
                                                  for o in outs]})
+                except QueueFull as e:
+                    self._error(503, "overloaded", str(e), retryable=True)
                 except DeadlineExceeded as e:
                     self._error(504, "deadline_exceeded", str(e),
                                 retryable=True)
@@ -252,6 +636,9 @@ class InferenceServer:
                     self._error(500, "internal", str(e), retryable=False)
                 finally:
                     server._slots.release()
+                    _profiler.runtime_metrics.observe(
+                        "serving.request_seconds",
+                        time.perf_counter() - t0)
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.addr = self._server.server_address
@@ -291,7 +678,12 @@ class InferenceServer:
         return t
 
     def shutdown(self):
+        # stop accepting FIRST: closing the batcher while handlers are
+        # still arriving would turn their requests into non-retryable
+        # 500s; close() then drains what is already queued
         self._server.shutdown()
+        if self._batcher is not None:
+            self._batcher.close()
         self._server.server_close()
 
 
@@ -358,6 +750,11 @@ class ServingClient:
     def meta(self):
         return self._request("/meta")
 
+    def stats(self):
+        """Runtime metrics snapshot (/stats): request latency
+        percentiles, batch occupancy, compile/jit-cache counters."""
+        return self._request("/stats")
+
     def healthy(self):
         """Single-shot liveness probe (no retries — probes must be cheap)."""
         try:
@@ -376,10 +773,18 @@ class ServingClient:
 
 
 def serve(model_dir, host="127.0.0.1", port=8866, async_load=False,
-          max_inflight=32, request_timeout=None):
+          max_inflight=32, request_timeout=None, batching=False,
+          max_batch_size=8, max_batch_delay=0.005, batch_queue_size=128,
+          warmup=False, warmup_batch_sizes=None):
     server = InferenceServer(model_dir, host, port, async_load=async_load,
                              max_inflight=max_inflight,
-                             request_timeout=request_timeout)
+                             request_timeout=request_timeout,
+                             batching=batching,
+                             max_batch_size=max_batch_size,
+                             max_batch_delay=max_batch_delay,
+                             batch_queue_size=batch_queue_size,
+                             warmup=warmup,
+                             warmup_batch_sizes=warmup_batch_sizes)
     print(f"serving {model_dir} on {server.addr[0]}:{server.addr[1]}",
           flush=True)
     server.serve_forever()

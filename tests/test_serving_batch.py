@@ -1,0 +1,379 @@
+"""Dynamic micro-batching serving runtime: concurrent /predict requests
+coalesce into padded row-bucketed batches (one compiled dispatch per
+batch), mixed-shape requests land in separate buckets, AOT warmup gates
+/readyz and eliminates first-request compiles, and /stats exposes the
+metrics surface."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from paddle_tpu import profiler
+from paddle_tpu.serving import (InferenceServer, MicroBatcher, Predictor,
+                                QueueFull, batch_key)
+
+
+@pytest.fixture()
+def model_dir(tmp_path):
+    """A model with a FLEXIBLE batch dim ([-1, 4] feed) — what batching
+    needs — plus reference outputs computed through a local predictor."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        pred = layers.fc(input=x, size=2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "model")
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main)
+    return d
+
+
+@pytest.fixture()
+def shapeless_model_dir(tmp_path):
+    """A param-free model whose feed has a DYNAMIC trailing dim
+    ([-1, -1]): requests with different feature dims are valid but
+    batch-incompatible — they must land in separate buckets."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[-1])
+        out = layers.reduce_sum(x, dim=1, keep_dim=True)
+        exe = fluid.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "model")
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+    return d
+
+
+def _post(host, port, path, obj, timeout=60):
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(host, port, path, timeout=30):
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestBatchKey:
+    def test_compatible_requests_share_a_key(self):
+        a = {"x": np.zeros((2, 4), "float32")}
+        b = {"x": np.ones((5, 4), "float32")}
+        assert batch_key(a)[0] == batch_key(b)[0]
+        assert batch_key(a)[1] == 2 and batch_key(b)[1] == 5
+
+    def test_mixed_shapes_get_distinct_keys(self):
+        a = {"x": np.zeros((2, 4), "float32")}
+        b = {"x": np.zeros((2, 7), "float32")}
+        assert batch_key(a)[0] != batch_key(b)[0]
+
+    def test_rank0_and_disagreeing_rows_not_batchable(self):
+        assert batch_key({"x": np.float32(1.0)}) == (None, None)
+        assert batch_key({"x": np.zeros((2, 4)),
+                          "y": np.zeros((3, 1))}) == (None, None)
+
+
+class TestConcurrentServing:
+    def test_n_threads_all_succeed_via_batching(self, model_dir):
+        """N concurrent /predict calls must ALL succeed (no
+        DeadlineExceeded), each with its own correct output."""
+        server = InferenceServer(model_dir, port=0, batching=True,
+                                 max_batch_size=8, max_batch_delay=0.02,
+                                 warmup=True, request_timeout=60.0)
+        server.start_background()
+        try:
+            host, port = server.addr
+            ref = Predictor(model_dir)
+            n = 8
+            rng = np.random.RandomState(0)
+            inputs = [rng.rand(1, 4).astype("float32") for _ in range(n)]
+            wants = [ref.run({"x": a})[0] for a in inputs]
+            results = [None] * n
+
+            def hit(i):
+                results[i] = _post(host, port, "/predict",
+                                   {"feeds": {"x": inputs[i].tolist()}})
+
+            threads = [threading.Thread(target=hit, args=(i,))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i, (code, body) in enumerate(results):
+                assert code == 200, body
+                np.testing.assert_allclose(
+                    np.asarray(body["outputs"][0], "float32"), wants[i],
+                    rtol=1e-4)
+            # the batcher actually coalesced: some dispatch carried > 1
+            code, snap = _get(host, port, "/stats")
+            occupancy = snap["histograms"].get("serving.batch_occupancy",
+                                               {})
+            assert any(int(k) > 1 for k in occupancy), occupancy
+        finally:
+            server.shutdown()
+
+    def test_mixed_shape_requests_separate_buckets(self,
+                                                   shapeless_model_dir):
+        """Requests with different feature dims are batch-incompatible:
+        each must run in its own bucket and still come back correct."""
+        server = InferenceServer(shapeless_model_dir, port=0, batching=True,
+                                 max_batch_size=8, max_batch_delay=0.02,
+                                 request_timeout=60.0)
+        server.start_background()
+        try:
+            host, port = server.addr
+            assert server.wait_until_ready(60)
+            dims = [3, 5, 3, 5, 3, 5]
+            results = [None] * len(dims)
+
+            def hit(i):
+                a = np.full((2, dims[i]), float(i), "float32")
+                results[i] = (a, _post(host, port, "/predict",
+                                       {"feeds": {"x": a.tolist()}}))
+
+            threads = [threading.Thread(target=hit, args=(i,))
+                       for i in range(len(dims))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for a, (code, body) in results:
+                assert code == 200, body
+                got = np.asarray(body["outputs"][0], "float32")
+                np.testing.assert_allclose(got, a.sum(axis=1,
+                                                      keepdims=True),
+                                           rtol=1e-5)
+        finally:
+            server.shutdown()
+
+
+class TestWarmup:
+    def test_warmup_gates_readyz_and_first_predict_compiles_nothing(
+            self, model_dir):
+        from paddle_tpu.fault import chaos
+
+        # hold warmup open long enough to observe /readyz gating it
+        chaos.inject("serving.warmup", delay=1.0)
+        try:
+            server = InferenceServer(model_dir, port=0, batching=True,
+                                     max_batch_size=8, warmup=True,
+                                     async_load=True,
+                                     request_timeout=60.0)
+            server.start_background()
+            host, port = server.addr
+            code, body = _get(host, port, "/readyz")
+            assert code == 503 and body["retryable"] is True
+            assert server.wait_until_ready(120)
+            code, _ = _get(host, port, "/readyz")
+            assert code == 200
+        finally:
+            chaos.clear()
+        try:
+            # declared buckets are warm: a real request in bucket range
+            # must trigger NO new lowering/compile
+            lowerings = profiler.runtime_metrics.counter(
+                "jit_cache.misses")
+            code, body = _post(host, port, "/predict",
+                               {"feeds": {"x": np.ones((3, 4),
+                                                       "float32").tolist()}})
+            assert code == 200, body
+            assert profiler.runtime_metrics.counter(
+                "jit_cache.misses") == lowerings
+        finally:
+            server.shutdown()
+
+    def test_serialized_warmup_warms_exact_shapes(self, model_dir):
+        """Without batching nothing pads, so warmup must compile the
+        EXACT declared batch sizes — the first real request of a warmed
+        size then triggers no new lowering."""
+        server = InferenceServer(model_dir, port=0, warmup=True,
+                                 warmup_batch_sizes=(2,),
+                                 request_timeout=60.0)
+        server.start_background()
+        try:
+            assert server.wait_until_ready(120)
+            host, port = server.addr
+            misses = profiler.runtime_metrics.counter("jit_cache.misses")
+            code, body = _post(host, port, "/predict",
+                               {"feeds": {"x": np.ones((2, 4),
+                                                       "float32").tolist()}})
+            assert code == 200, body
+            assert profiler.runtime_metrics.counter(
+                "jit_cache.misses") == misses
+        finally:
+            server.shutdown()
+
+    def test_predictor_warmup_counts_fresh_compiles(self, model_dir):
+        p = Predictor(model_dir)
+        assert p.warmup(batch_sizes=(1, 4, 8)) == 1   # all bucket to 8
+        assert p.warmup(batch_sizes=(1,)) == 0        # already warm
+        assert p.warmup(batch_sizes=(16,)) == 1       # a new bucket
+
+
+class TestDegradation:
+    def test_full_queue_sheds_load_503(self, model_dir):
+        from paddle_tpu.fault import chaos
+
+        server = InferenceServer(model_dir, port=0, batching=True,
+                                 max_batch_size=1, batch_queue_size=1,
+                                 request_timeout=60.0)
+        server.start_background()
+        try:
+            assert server.wait_until_ready(60)
+            host, port = server.addr
+            # first dispatch stalls; queue (depth 1) fills; next sheds
+            chaos.inject("serving.batch", delay=1.5, times=1)
+            feeds = {"feeds": {"x": [[1.0, 2.0, 3.0, 4.0]]}}
+            codes = [None] * 3
+
+            def hit(i):
+                codes[i] = _post(host, port, "/predict", feeds)[0]
+
+            threads = [threading.Thread(target=hit, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+                time.sleep(0.25)
+            for t in threads:
+                t.join()
+            assert 503 in codes and 200 in codes
+        finally:
+            chaos.clear()
+            server.shutdown()
+
+    def test_deadline_exceeded_504(self, model_dir):
+        from paddle_tpu.fault import chaos
+
+        server = InferenceServer(model_dir, port=0, batching=True,
+                                 request_timeout=0.3)
+        server.start_background()
+        try:
+            assert server.wait_until_ready(60)
+            host, port = server.addr
+            _post(host, port, "/predict",
+                  {"feeds": {"x": [[0.0, 0.0, 0.0, 0.0]]}})  # warm compile
+            chaos.inject("serving.batch", delay=1.5, times=1)
+            code, body = _post(host, port, "/predict",
+                               {"feeds": {"x": [[1.0, 2.0, 3.0, 4.0]]}})
+            assert code == 504
+            assert body["error"]["type"] == "deadline_exceeded"
+            assert body["retryable"] is True
+            # the timed-out request freed its queue slot immediately —
+            # dead entries must not shed live traffic as 503s
+            assert server._batcher.queue_depth == 0
+        finally:
+            chaos.clear()
+            server.shutdown()
+
+
+class TestStats:
+    def test_stats_endpoint_schema(self, model_dir):
+        server = InferenceServer(model_dir, port=0, batching=True,
+                                 warmup=True, request_timeout=60.0)
+        server.start_background()
+        try:
+            assert server.wait_until_ready(120)
+            host, port = server.addr
+            _post(host, port, "/predict",
+                  {"feeds": {"x": [[1.0, 2.0, 3.0, 4.0]]}})
+            code, snap = _get(host, port, "/stats")
+            assert code == 200
+            assert {"counters", "series", "histograms",
+                    "server"} <= set(snap)
+            assert snap["server"]["batching"] is True
+            assert snap["server"]["ready"] is True
+            assert snap["counters"].get("serving.requests_ok", 0) >= 1
+            lat = snap["series"]["serving.request_seconds"]
+            assert lat["count"] >= 1
+            assert lat["p50"] is not None and lat["p99"] is not None
+            assert "serving.batch_occupancy" in snap["histograms"]
+        finally:
+            server.shutdown()
+
+    def test_cli_stats_command(self, model_dir, capsys):
+        from paddle_tpu.cli import main as cli_main
+
+        server = InferenceServer(model_dir, port=0, batching=True,
+                                 request_timeout=60.0)
+        server.start_background()
+        try:
+            assert server.wait_until_ready(60)
+            host, port = server.addr
+            _post(host, port, "/predict",
+                  {"feeds": {"x": [[1.0, 2.0, 3.0, 4.0]]}})
+            rc = cli_main(["stats", "--addr", f"{host}:{port}"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "serving.request_seconds" in out
+            rc = cli_main(["stats", "--addr", f"{host}:{port}", "--json"])
+            assert rc == 0
+            assert "counters" in capsys.readouterr().out
+        finally:
+            server.shutdown()
+
+
+class TestMicroBatcher:
+    def test_run_many_scatter_matches_solo_runs(self, model_dir):
+        p = Predictor(model_dir)
+        rng = np.random.RandomState(7)
+        feeds = [{"x": rng.rand(r, 4).astype("float32")}
+                 for r in (1, 3, 2)]
+        batched = p.run_many(feeds)
+        for f, outs in zip(feeds, batched):
+            (want,) = p.run(f)
+            np.testing.assert_allclose(outs[0], want, rtol=1e-5)
+
+    def test_row_misaligned_output_falls_back(self, tmp_path):
+        """A batch-reduced (scalar-per-batch) output cannot be scattered
+        by rows: run_many must fall back to per-request dispatches and
+        still return correct per-request values."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[4])
+            out = layers.reduce_mean(x)  # scalar: mixes batch rows
+            exe = fluid.Executor()
+            exe.run(startup)
+            d = str(tmp_path / "model")
+            fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                          main_program=main)
+        p = Predictor(d)
+        a = {"x": np.full((2, 4), 1.0, "float32")}
+        b = {"x": np.full((2, 4), 3.0, "float32")}
+        before = profiler.runtime_metrics.counter(
+            "serving.batch_fallbacks")
+        ra, rb = p.run_many([a, b])
+        assert profiler.runtime_metrics.counter(
+            "serving.batch_fallbacks") == before + 1
+        np.testing.assert_allclose(np.asarray(ra[0]).reshape(()), 1.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(rb[0]).reshape(()), 3.0,
+                                   rtol=1e-6)
+
+    def test_submit_validates_missing_feeds_before_enqueue(self,
+                                                           model_dir):
+        p = Predictor(model_dir)
+        b = MicroBatcher(p)
+        try:
+            with pytest.raises(ValueError, match="missing feeds"):
+                b.submit({"nope": np.zeros((1, 4), "float32")}, timeout=5)
+        finally:
+            b.close()
