@@ -57,6 +57,7 @@ from paddle_tpu import parallel
 from paddle_tpu import reader
 from paddle_tpu import dataset
 from paddle_tpu import fault
+from paddle_tpu import datapipe
 
 __version__ = "0.1.0"
 

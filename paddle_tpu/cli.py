@@ -17,7 +17,8 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              (--batch --warmup
                                              --compile-cache DIR)
   stats   --addr HOST:PORT                   runtime metrics snapshot of
-                                             a serving replica (/stats)
+                                             a serving replica (/stats);
+                                             --local for this process
   profile [--model transformer|resnet ...]   per-op device-time table of
                                              one compiled training step
   version
@@ -136,11 +137,20 @@ def _cmd_serve(args):
 
 
 def _cmd_stats(args):
-    """Fetch and render a server's /stats metrics snapshot."""
+    """Fetch and render a server's /stats metrics snapshot (or this
+    process's own registry with --local — the datapipe/executor counters
+    of an in-process run)."""
     import json as _json
 
-    from paddle_tpu.serving import ServingClient
-    snap = ServingClient(args.addr).stats()
+    if args.local:
+        from paddle_tpu.profiler import runtime_metrics
+        snap = runtime_metrics.snapshot()
+    elif args.addr:
+        from paddle_tpu.serving import ServingClient
+        snap = ServingClient(args.addr).stats()
+    else:
+        print("stats: need --addr HOST:PORT or --local", file=sys.stderr)
+        return 2
     if args.json:
         print(_json.dumps(snap, indent=2, sort_keys=True))
         return 0
@@ -152,6 +162,8 @@ def _cmd_stats(args):
                else "-")
         print(f"{name:<36}count={s.get('count', 0):<8}"
               f"p50={fmt(p50):<10}p95={fmt(p95):<10}p99={fmt(p99)}")
+    for name, v in sorted((snap.get("gauges") or {}).items()):
+        print(f"{name:<36}{v:>12g}")
     for name, hist in sorted((snap.get("histograms") or {}).items()):
         print(f"{name}: " + " ".join(f"{k}:{v}" for k, v in hist.items()))
     srv = snap.get("server") or {}
@@ -307,7 +319,10 @@ def main(argv=None):
 
     p = sub.add_parser("stats", help="fetch a serving replica's /stats "
                                      "metrics snapshot")
-    p.add_argument("--addr", required=True, help="host:port of the server")
+    p.add_argument("--addr", default=None, help="host:port of the server")
+    p.add_argument("--local", action="store_true",
+                   help="this process's own metrics registry instead of "
+                        "a remote server (datapipe/executor counters)")
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the formatted table")
     p.set_defaults(fn=_cmd_stats)

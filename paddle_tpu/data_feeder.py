@@ -31,8 +31,30 @@ class DataToLoDTensorConverter:
         self.shape = shape
         self.name = name
         self.dtype = np.dtype(dtype) if dtype != "bfloat16" else dtype
+        self.reset()
+
+    def reset(self):
+        """Clear accumulated samples so the converter can be reused for
+        the next batch (DataFeeder caches converters across feed calls)."""
         self.data = []
-        self.lod = [[] for _ in range(lod_level)]
+        self.lod = [[] for _ in range(self.lod_level)]
+
+    def _check_dtype(self, stacked):
+        """Reject float samples headed into an integer slot: the dtype
+        cast below would silently TRUNCATE them — the classic
+        mis-wired-feed bug (labels and features swapped) that then
+        trains on garbage without a peep.  ``stacked`` is the batch
+        array built WITHOUT a forced dtype, so even one float sample in
+        an otherwise-integer batch promotes its kind and is caught."""
+        if not isinstance(self.dtype, np.dtype):
+            return  # bfloat16 string tag: no integer truncation risk
+        if stacked.dtype.kind in "fc" and self.dtype.kind in "iub":
+            raise FeedShapeError(
+                f"feed slot {self.name or '<unnamed>'!r}: got "
+                f"{stacked.dtype.name} samples for a declared "
+                f"{self.dtype.name} slot — refusing the silent "
+                f"truncating cast (fix the feed order or the declared "
+                f"dtype)")
 
     def feed(self, data):
         self._feed_impl_(data, self.lod, self.lod_level)
@@ -45,9 +67,23 @@ class DataToLoDTensorConverter:
             for each_data in data:
                 self._feed_impl_(each_data, lod[1:], lod_level - 1)
 
+    def _needs_truncation_check(self):
+        # only integer/bool targets can silently truncate; float slots
+        # keep the single cast-while-stacking path (no double convert
+        # on the hot feed loop)
+        return isinstance(self.dtype, np.dtype) and self.dtype.kind in "iub"
+
     def done(self):
         if self.lod_level == 0:
-            arr = np.array(self.data, dtype=self.dtype)
+            if self.data and self._needs_truncation_check():
+                # stack WITHOUT the target dtype first: mixed batches
+                # promote (one float sample makes the whole batch kind
+                # 'f'), so the truncation check sees every sample
+                arr = np.asarray(self.data)
+                self._check_dtype(arr)
+                arr = arr.astype(self.dtype, copy=False)
+            else:
+                arr = np.array(self.data, dtype=self.dtype)
             inner = [d for d in self.shape[1:]] if self.shape else []
             # the strict reshape only makes sense when every non-batch
             # dim is concrete; with dynamic inner dims (-1/None) the
@@ -75,7 +111,12 @@ class DataToLoDTensorConverter:
                 flat.append(x)
 
         _flatten(self.data)
-        arr = np.array(flat, dtype=self.dtype)
+        if flat and self._needs_truncation_check():
+            arr = np.asarray(flat)
+            self._check_dtype(arr)
+            arr = arr.astype(self.dtype, copy=False)
+        else:
+            arr = np.array(flat, dtype=self.dtype)
         inner = [d for d in self.shape if d != -1]
         if inner:
             arr = arr.reshape([-1] + inner)
@@ -102,15 +143,43 @@ class DataFeeder:
             self.feed_shapes.append(each_var.shape)
             self.feed_dtypes.append(each_var.dtype)
         self.place = place
+        self._converters = None
+        self._feeding = False
 
     def feed(self, iterable):
-        converters = [
-            DataToLoDTensorConverter(self.place, lod_level=lod, shape=shape,
-                                     dtype=dtype, name=name)
-            for lod, shape, dtype, name in zip(self.feed_lod_level,
-                                               self.feed_shapes,
-                                               self.feed_dtypes,
-                                               self.feed_names)]
+        """Convert one batch of samples to a feed dict.
+
+        NOT re-entrant: the converter set is cached across calls (built
+        once, reset per batch), so one DataFeeder serves one feeding
+        thread — overlapping calls would interleave two batches into
+        one output array.  Concurrent misuse raises instead."""
+        if self._feeding:
+            raise RuntimeError(
+                "DataFeeder.feed is not re-entrant (converters are "
+                "cached across calls); use one DataFeeder per feeding "
+                "thread")
+        self._feeding = True
+        try:
+            return self._feed(iterable)
+        finally:
+            self._feeding = False
+
+    def _feed(self, iterable):
+        # converters are built once and reset per batch — the per-feed
+        # construction cost (np.dtype parsing, per-slot allocation) used
+        # to be paid on EVERY batch of the training loop
+        if self._converters is None:
+            self._converters = [
+                DataToLoDTensorConverter(self.place, lod_level=lod,
+                                         shape=shape, dtype=dtype, name=name)
+                for lod, shape, dtype, name in zip(self.feed_lod_level,
+                                                   self.feed_shapes,
+                                                   self.feed_dtypes,
+                                                   self.feed_names)]
+        else:
+            for conv in self._converters:
+                conv.reset()
+        converters = self._converters
         for each_sample in iterable:
             assert len(each_sample) == len(converters), \
                 "sample arity != feed arity"

@@ -1,0 +1,37 @@
+"""paddle_tpu.datapipe — async sharded input pipeline with device
+prefetch and checkpointable iterators (docs/data_pipeline.md).
+
+Compose stages fluently from a sharded source::
+
+    import paddle_tpu.datapipe as dp
+
+    pipe = (dp.InMemorySource(samples, num_shards=4, shard_index=rank)
+              .shuffle(buffer_size=1024, seed=7)
+              .map(decode, workers=4)
+              .batch(32, pad_to_bucket=True)
+              .prefetch(depth=2))
+
+    for batch in pipe:            # one epoch; iterate again for the next
+        exe.run(main, feed=batch, fetch_list=[loss])
+
+``pipe.state_dict()`` / ``pipe.load_state_dict()`` capture the exact
+mid-epoch position (shard offsets, shuffle RNG + buffer, in-flight
+samples); hand the pipeline to ``fault.CheckpointManager(datapipe=pipe)``
+and a killed trainer resumes with the identical sample sequence.  Every
+stage reports ``datapipe.*`` throughput/stall/queue-depth metrics into
+``profiler.runtime_metrics``.
+"""
+
+from paddle_tpu.datapipe.core import Stage, PipelineStateError, stats
+from paddle_tpu.datapipe.sources import (Source, InMemorySource, FileSource,
+                                         RecordIOSource)
+from paddle_tpu.datapipe.stages import (Shuffle, ParallelMap, Batch,
+                                        default_collate)
+from paddle_tpu.datapipe.prefetch import DevicePrefetch
+
+__all__ = [
+    "Stage", "PipelineStateError", "stats",
+    "Source", "InMemorySource", "FileSource", "RecordIOSource",
+    "Shuffle", "ParallelMap", "Batch", "default_collate",
+    "DevicePrefetch",
+]

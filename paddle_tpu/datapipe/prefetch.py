@@ -1,0 +1,209 @@
+"""Async device prefetch: the stage that actually hides host time.
+
+A background thread pulls batches from upstream, converts them to device
+arrays (``jax.device_put``), and parks them in a bounded queue — so
+host-side decode/collate/transfer of batch N+1 overlaps device compute
+of batch N (the double-buffer discipline of the reference's
+``create_double_buffer_reader_op.cc``, generalized to a depth-``depth``
+queue).  The consumer-side ``datapipe.prefetch.stall_seconds`` series is
+THE input-starvation signal: near zero means the pipeline keeps the
+accelerator fed; large means add map workers or prefetch depth.
+
+Quiesce semantics match the other threaded stages: ``state_dict()``
+stops the thread and drains queued batches into a pending buffer
+(device arrays are pulled back to host numpy for pickling), so a
+checkpoint taken between steps loses nothing.  A batch already in the
+worker's hands when the stop lands is stashed into an overflow slot and
+folded in AFTER the queued (older) batches — order is preserved, no
+sample is dropped or replayed.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+
+import jax
+import numpy as np
+
+from paddle_tpu.datapipe.core import Stage, _Raised
+from paddle_tpu.profiler import runtime_metrics
+
+__all__ = ["DevicePrefetch"]
+
+_EOF = object()
+
+
+def _to_device(batch, device):
+    # device_put/jnp.asarray accept host AND device inputs (the latter
+    # pass through without a copy), so this is safe both for fresh host
+    # batches and for re-placing restored/pending ones
+    put = (lambda a: jax.device_put(a, device)) if device is not None \
+        else jax.numpy.asarray
+    if isinstance(batch, dict):
+        return {k: put(v) for k, v in batch.items()}
+    if isinstance(batch, (tuple, list)):
+        return tuple(put(v) for v in batch)
+    return put(batch)
+
+
+def _to_host(batch):
+    if isinstance(batch, dict):
+        return {k: np.asarray(v) for k, v in batch.items()}
+    if isinstance(batch, (tuple, list)):
+        return tuple(np.asarray(v) for v in batch)
+    return np.asarray(batch)
+
+
+class DevicePrefetch(Stage):
+    kind = "prefetch"
+
+    def __init__(self, upstream, depth=2, device=None, name=None):
+        super().__init__(upstream, name or "prefetch")
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1")
+        self.depth = int(depth)
+        self.device = device
+        self._q = None
+        self._thread = None
+        self._stop = None
+        self._up_iter = None
+        self._pending = collections.deque()
+        self._overflow = []      # worker's in-hand items at quiesce time
+        self._eof_pending = False
+
+    # -- producer -------------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is not None:
+            return
+        self._q = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._up_iter = iter(self._upstream)
+        up_iter = self._up_iter
+
+        def deliver(q, stop, overflow, item):
+            """Queue ``item``; once stopped, stash it in the overflow
+            slot instead (never drop — the item was already pulled from
+            upstream, so upstream's position has moved past it)."""
+            while True:
+                if stop.is_set():
+                    overflow.append(item)
+                    return False
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+
+        def worker(q, stop, overflow):
+            try:
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        batch = self._pull(up_iter)
+                    except StopIteration:
+                        deliver(q, stop, overflow, _EOF)
+                        return
+                    dev = _to_device(batch, self.device)
+                    runtime_metrics.observe(
+                        self._metrics + ".fill_seconds",
+                        time.perf_counter() - t0)
+                    if not deliver(q, stop, overflow, dev):
+                        return
+                    runtime_metrics.set_gauge(
+                        self._metrics + ".queue_depth", q.qsize())
+            except BaseException as e:
+                deliver(q, stop, overflow, _Raised(e))
+
+        self._thread = threading.Thread(
+            target=worker, args=(self._q, self._stop, self._overflow),
+            daemon=True, name=f"datapipe-{self.name}")
+        self._thread.start()
+
+    # -- consumer -------------------------------------------------------
+    def _iterate(self):
+        while True:
+            while self._pending:
+                item = self._pending.popleft()
+                if isinstance(item, _Raised):
+                    raise item.exc
+                self._count()
+                # pending batches restored by load_state_dict are host
+                # numpy — place them so the device-array contract holds
+                # on post-restore steps too (no-op for quiesced device
+                # batches)
+                yield _to_device(item, self.device)
+            if self._eof_pending:
+                self._eof_pending = False
+                return
+            self._ensure_thread()
+            t0 = time.perf_counter()
+            item = self._q.get()
+            runtime_metrics.observe(self._metrics + ".stall_seconds",
+                                    time.perf_counter() - t0)
+            runtime_metrics.set_gauge(self._metrics + ".queue_depth",
+                                      self._q.qsize())
+            if item is _EOF:
+                self._shutdown()      # joins the (exiting) thread
+                self._eof_pending = False
+                return
+            if isinstance(item, _Raised):
+                self._shutdown()
+                raise item.exc
+            self._count()
+            yield item
+
+    # -- quiesce --------------------------------------------------------
+    def _shutdown(self):
+        if self._thread is None:
+            return
+        self._stop.set()
+        # drain while the thread winds down so a put blocked on a full
+        # queue completes; queued items are OLDER than the worker's
+        # in-hand overflow item, so the queue folds into pending first
+        while self._thread.is_alive():
+            self._drain_into_pending()
+            self._thread.join(timeout=0.05)
+        self._drain_into_pending()
+        self._thread = None
+        for item in self._overflow:
+            if item is _EOF:
+                self._eof_pending = True
+            else:
+                self._pending.append(item)
+        del self._overflow[:]
+        if self._up_iter is not None:
+            self._up_iter.close()
+            self._up_iter = None
+
+    def _drain_into_pending(self):
+        try:
+            while True:
+                item = self._q.get_nowait()
+                if item is _EOF:
+                    self._eof_pending = True
+                else:
+                    self._pending.append(item)
+        except queue.Empty:
+            pass
+
+    def _state(self):
+        pending = []
+        for item in self._pending:
+            if isinstance(item, _Raised):
+                raise RuntimeError(
+                    f"prefetch stage {self.name!r} holds a pending "
+                    f"worker exception; consume (and handle) it before "
+                    f"checkpointing")
+            pending.append(_to_host(item))
+        return {"pending": pending, "eof_pending": self._eof_pending}
+
+    def _load_state(self, state):
+        self._pending = collections.deque(state["pending"])
+        self._eof_pending = bool(state["eof_pending"])
+
+    def _reset_local(self):
+        self._pending.clear()
+        self._eof_pending = False

@@ -678,6 +678,55 @@ class Executor:
         return list(ys)
 
     # ------------------------------------------------------------------
+    def run_pipeline(self, program=None, pipeline=None, fetch_list=None,
+                     scope=None, max_steps=None, return_numpy=True,
+                     on_step=None):
+        """Drive one epoch (or ``max_steps`` batches) of a
+        ``datapipe`` pipeline through :meth:`run`.
+
+        Each batch must be a feed dict (``name -> array``) — the shape a
+        ``Batch`` stage with dict samples (or a custom collate) emits;
+        batches already placed by a ``DevicePrefetch`` stage skip the
+        host->device copy inside :meth:`run`.  Fires the ``train.step``
+        failpoint per batch (so ``PADDLE_TPU_CHAOS`` kill drills target
+        this loop) and records ``datapipe.step_seconds``.  Stopping at
+        ``max_steps`` closes the iterator cleanly: threaded stages
+        quiesce with their position intact, so a following
+        ``pipeline.state_dict()`` checkpoints mid-epoch.
+
+        ``on_step(step_index, fetches)`` runs after each batch (metrics,
+        checkpointing).  Returns the list of per-batch fetch lists."""
+        from paddle_tpu import profiler as _profiler
+        from paddle_tpu.fault import chaos as _chaos
+        if pipeline is None:
+            raise ValueError("run_pipeline requires a datapipe pipeline")
+        outs = []
+        it = iter(pipeline)
+        try:
+            step = 0
+            # check the budget BEFORE pulling: a batch pulled past the
+            # limit would be dropped (lost from the resume sequence)
+            while max_steps is None or step < max_steps:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                _chaos.fire("train.step", step=step)
+                with _profiler.record_latency("datapipe.step_seconds"):
+                    fetches = self.run(program, feed=batch,
+                                       fetch_list=fetch_list, scope=scope,
+                                       return_numpy=return_numpy)
+                outs.append(fetches)
+                if on_step is not None:
+                    on_step(step, fetches)
+                step += 1
+        finally:
+            close = getattr(it, "close", None)  # plain iterables lack it
+            if close is not None:
+                close()
+        return outs
+
+    # ------------------------------------------------------------------
     def _feed_device(self):
         """Target placement for feed arrays; ParallelExecutor overrides to
         None so sharded placement happens against the mesh instead."""

@@ -17,9 +17,12 @@ Failpoint names currently wired through the codebase:
                           leave the previous checkpoint restorable)
 ``reader.pump``           ``reader.decorator.buffered`` producer, per sample
 ``reader.worker``         ``reader.decorator.xmap_readers`` worker, per sample
+``datapipe.source``       ``datapipe.Source``, per emitted sample (breaks
+                          the input stream where a flaky FS/decoder would)
 ``serving.run``           ``InferenceServer`` request handler, per request
 ``train.step``            fired by training loops that opt in (the
-                          kill-and-resume drill's trainer does)
+                          kill-and-resume drill's trainer does, and
+                          ``Executor.run_pipeline`` fires it per batch)
 ========================  ====================================================
 
 Env grammar (``;`` or ``,`` separated)::

@@ -16,14 +16,27 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import pickle
 import shutil
 
 from paddle_tpu.fault import chaos
 
 __all__ = ["CheckpointManager", "CorruptCheckpoint", "MANIFEST_NAME",
-           "write_manifest", "verify_checkpoint", "commit_checkpoint"]
+           "DATAPIPE_STATE_NAME", "write_manifest", "verify_checkpoint",
+           "commit_checkpoint"]
 
 MANIFEST_NAME = "MANIFEST.json"
+DATAPIPE_STATE_NAME = "datapipe_state.pkl"
+
+
+def _datapipe_state_name():
+    """Per-host sidecar name: each trainer's iterator position is
+    host-local state (its own input shard), so multi-host runs save one
+    file per process; single-host keeps the unsuffixed legacy name."""
+    import jax
+    if jax.process_count() == 1:
+        return DATAPIPE_STATE_NAME
+    return f"datapipe_state.{jax.process_index()}.pkl"
 MANIFEST_FORMAT = 1
 _TMP_PREFIX = ".tmp-"
 _QUARANTINE_SUFFIX = ".corrupt"
@@ -155,15 +168,22 @@ class CheckpointManager:
     (renames to ``ckpt-N.corrupt``) anything torn or corrupt, and
     restores the newest checkpoint that passes — returning its step, or
     None when nothing is restorable.
+
+    ``datapipe``: an optional ``datapipe`` pipeline (any stage with
+    ``state_dict``/``load_state_dict``).  Its iterator position is
+    serialized into every checkpoint (same atomic commit as the
+    tensors) and restored alongside them, so a killed trainer resumes
+    mid-epoch with the exact sample sequence it would have seen.
     """
 
     def __init__(self, dirname, keep=5, executor=None, main_program=None,
-                 scope=None):
+                 scope=None, datapipe=None):
         self.dirname = str(dirname)
         self.keep = keep
         self.executor = executor
         self.main_program = main_program
         self.scope = scope
+        self.datapipe = datapipe
         os.makedirs(self.dirname, exist_ok=True)
 
     # -- introspection -----------------------------------------------------
@@ -192,11 +212,17 @@ class CheckpointManager:
 
     # -- save --------------------------------------------------------------
     def save(self, step):
-        """Commit the current training state as ``ckpt-<step>``."""
+        """Commit the current training state as ``ckpt-<step>`` (plus the
+        datapipe iterator position, when a pipeline is attached)."""
         from paddle_tpu import io
+        extras = None
+        if self.datapipe is not None:
+            extras = {_datapipe_state_name(): pickle.dumps(
+                self.datapipe.state_dict(), protocol=4)}
         path = io.save_checkpoint(self.executor, self.dirname,
                                   main_program=self.main_program,
-                                  step=step, scope=self.scope)
+                                  step=step, scope=self.scope,
+                                  extras=extras)
         self._gc()
         return path
 
@@ -230,9 +256,27 @@ class CheckpointManager:
         """Verify + restore one specific step (no fallback)."""
         from paddle_tpu import io
         verify_checkpoint(self.path(step))
-        return io.load_checkpoint(self.executor, self.dirname,
-                                  main_program=self.main_program, step=step,
-                                  scope=self.scope, shardings=shardings)
+        got = io.load_checkpoint(self.executor, self.dirname,
+                                 main_program=self.main_program, step=step,
+                                 scope=self.scope, shardings=shardings)
+        self._restore_datapipe(step)
+        return got
+
+    def _restore_datapipe(self, step):
+        """Load the iterator position saved next to ``ckpt-<step>`` into
+        the attached pipeline (no-op without one; a checkpoint written
+        before a pipeline existed leaves the pipeline untouched)."""
+        if self.datapipe is None:
+            return False
+        p = os.path.join(self.path(step), _datapipe_state_name())
+        if not os.path.exists(p):
+            # legacy / topology-changed fallback: the unsuffixed name
+            p = os.path.join(self.path(step), DATAPIPE_STATE_NAME)
+            if not os.path.exists(p):
+                return False
+        with open(p, "rb") as f:
+            self.datapipe.load_state_dict(pickle.load(f))
+        return True
 
     def restore_latest(self, shardings=None):
         """Restore the newest restorable checkpoint; returns its step or
@@ -262,6 +306,7 @@ class CheckpointManager:
                 except Exception:
                     continue
                 io._write_latest(self.dirname, step)
+                self._restore_datapipe(step)
                 return got
             got = io.load_checkpoint(
                 self.executor, self.dirname,
@@ -270,6 +315,7 @@ class CheckpointManager:
             # re-point ``latest`` in case it referenced a checkpoint we
             # just quarantined (load_checkpoint(step=None) keeps working)
             io._write_latest(self.dirname, step)
+            self._restore_datapipe(step)
             return got
         # nothing restorable: drop a ``latest`` pointer that would now
         # name a quarantined dir (load_checkpoint(step=None) then fails

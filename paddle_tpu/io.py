@@ -253,7 +253,7 @@ def _write_latest(dirname, step):
 
 
 def save_checkpoint(executor, dirname, main_program=None, step=0,
-                    scope=None):
+                    scope=None, extras=None):
     """Save ALL persistable state (params + optimizer accumulators) plus
     metadata; sharded arrays are written shard-by-shard (orbax).
 
@@ -261,7 +261,15 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
     checksummed ``MANIFEST.json`` is added, and only then is the dir
     atomically renamed to ``ckpt-<step>`` and the ``latest`` pointer
     swung — an interruption at any point leaves no partial ``ckpt-*``
-    dir behind (``fault.checkpoint.commit_checkpoint``)."""
+    dir behind (``fault.checkpoint.commit_checkpoint``).
+
+    ``extras``: optional ``filename -> bytes`` sidecar files (e.g. the
+    serialized datapipe iterator state) written into the checkpoint dir
+    BEFORE the commit, so they ride the same manifest/rename atomicity
+    as the tensors.  EVERY host writes its own extras (names must be
+    per-host unique in multi-host runs — each trainer's input-shard
+    position is host-local state); a barrier then orders those writes
+    before the coordinator's manifest walk."""
     import shutil
 
     import orbax.checkpoint as ocp
@@ -296,6 +304,18 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(tmp, state, force=True)
     ckptr.wait_until_finished()
+    for name, blob in (extras or {}).items():
+        with open(os.path.join(tmp, name), "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+    if jax.process_count() > 1:
+        # all hosts' extras must land before the coordinator manifests
+        # the tmp dir — without this barrier a late host's sidecar file
+        # would be missing from (or invalidate) the manifest
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(
+            f"paddle_tpu.ckpt.extras.{int(step)}")
     commit_error = None
     if jax.process_index() == 0:
         try:

@@ -365,6 +365,7 @@ class RuntimeMetrics:
         self._series = {}       # name -> deque[float] (bounded window)
         self._series_agg = {}   # name -> [count, total]  (unwindowed)
         self._hist = {}         # name -> Counter (small integer values)
+        self._gauges = {}       # name -> float (last-write-wins level)
 
     # -- writers -------------------------------------------------------
     def inc(self, name, n=1):
@@ -389,10 +390,20 @@ class RuntimeMetrics:
         with self._lock:
             self._hist.setdefault(name, collections.Counter())[int(key)] += 1
 
+    def set_gauge(self, name, value):
+        """Instantaneous level (queue depth, pool size): last write wins,
+        unlike observe()'s sample series."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
     # -- readers -------------------------------------------------------
     def counter(self, name):
         with self._lock:
             return self._counters.get(name, 0)
+
+    def gauge(self, name):
+        with self._lock:
+            return self._gauges.get(name)
 
     def percentiles(self, name, qs=(50, 95, 99)):
         with self._lock:
@@ -404,6 +415,7 @@ class RuntimeMetrics:
         """One JSON-serializable dict of everything (the /stats body)."""
         with self._lock:
             counters = dict(self._counters)
+            gauges = dict(self._gauges)
             hist = {n: {str(k): v for k, v in sorted(c.items())}
                     for n, c in self._hist.items()}
             series = {n: (list(d), list(self._series_agg[n]))
@@ -422,7 +434,7 @@ class RuntimeMetrics:
             entry["per_sec_serial"] = (count / total) if total else None
             latency[name] = entry
         return {"counters": counters, "series": latency,
-                "histograms": hist}
+                "histograms": hist, "gauges": gauges}
 
     def reset(self):
         with self._lock:
@@ -430,6 +442,7 @@ class RuntimeMetrics:
             self._series.clear()
             self._series_agg.clear()
             self._hist.clear()
+            self._gauges.clear()
 
 
 runtime_metrics = RuntimeMetrics()

@@ -27,6 +27,18 @@ __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
 _STOP = object()
 
 
+def _put_unless(abandoned, q, item, timeout=0.1):
+    """Queue ``item``, polling so a producer blocked on a full queue
+    notices the consumer abandoning the stream; False once abandoned."""
+    while not abandoned.is_set():
+        try:
+            q.put(item, timeout=timeout)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
 class _Raised:
     """A producer/worker exception, carried through the queue so it
     re-raises on the CONSUMER side instead of vanishing in a daemon
@@ -107,29 +119,44 @@ def compose(*readers, **kwargs):
 def buffered(reader, size):
     """Decouple producer from consumer: a daemon thread pumps the wrapped
     reader into a queue bounded at ``size`` samples, hiding producer
-    latency behind consumption."""
+    latency behind consumption.
+
+    A consumer that abandons iteration early (breaks out, drops the
+    generator) closes it, which flips the ``abandoned`` event — the pump
+    thread sees it at its next (timeout-polled) ``put`` and exits
+    instead of blocking forever on the full queue."""
 
     def _read():
         q = queue.Queue(maxsize=size)
+        abandoned = threading.Event()
 
         def pump():
             try:
                 for sample in reader():
                     _chaos.fire("reader.pump")
-                    q.put(sample)
+                    if not _put_unless(abandoned, q, sample):
+                        return
             except BaseException as e:  # re-raised consumer-side
-                q.put(_Raised(e))
+                _put_unless(abandoned, q, _Raised(e))
             else:
-                q.put(_STOP)
+                _put_unless(abandoned, q, _STOP)
 
         threading.Thread(target=pump, daemon=True).start()
-        while True:
-            sample = q.get()
-            if sample is _STOP:
-                return
-            if isinstance(sample, _Raised):
-                raise sample.exc
-            yield sample
+        try:
+            while True:
+                sample = q.get()
+                if sample is _STOP:
+                    return
+                if isinstance(sample, _Raised):
+                    raise sample.exc
+                yield sample
+        finally:
+            abandoned.set()
+            try:  # unblock a put stuck on the (bounded) queue right now
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
 
     return _read
 
@@ -179,22 +206,33 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         inq = queue.Queue(maxsize=buffer_size)
         outq = queue.Queue()     # bounded by the window semaphore
         window = threading.Semaphore(2 * buffer_size + process_num)
+        # flipped when the consumer abandons the generator: the feeder's
+        # window.acquire/inq.put and the workers' loops poll it so no
+        # thread is left blocked forever on a stream nobody reads
+        abandoned = threading.Event()
 
         def feed():
             try:
                 for tagged in enumerate(reader()):
-                    window.acquire()
-                    inq.put(tagged)
+                    while not window.acquire(timeout=0.1):
+                        if abandoned.is_set():
+                            return
+                    if not _put_unless(abandoned, inq, tagged):
+                        return
             except BaseException as e:
                 outq.put(_Raised(e))
             finally:
                 for _ in range(process_num):
-                    inq.put(_STOP)
+                    if not _put_unless(abandoned, inq, _STOP):
+                        break
 
         def work():
             try:
-                while True:
-                    item = inq.get()
+                while not abandoned.is_set():
+                    try:
+                        item = inq.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
                     if item is _STOP:
                         return
                     pos, sample = item
@@ -214,29 +252,32 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 raise item.exc
             return item
 
-        live_workers = process_num
-        if not order:
-            while live_workers:
-                item = drain()
-                if item is _STOP:
-                    live_workers -= 1
-                else:
-                    window.release()
-                    yield item[1]
-            return
+        try:
+            live_workers = process_num
+            if not order:
+                while live_workers:
+                    item = drain()
+                    if item is _STOP:
+                        live_workers -= 1
+                    else:
+                        window.release()
+                        yield item[1]
+                return
 
-        ahead = []              # results that arrived before their turn
-        next_pos = 0
-        while live_workers or ahead:
-            if ahead and ahead[0][0] == next_pos:
-                window.release()
-                yield heapq.heappop(ahead)[1]
-                next_pos += 1
-            else:
-                item = drain()
-                if item is _STOP:
-                    live_workers -= 1
+            ahead = []          # results that arrived before their turn
+            next_pos = 0
+            while live_workers or ahead:
+                if ahead and ahead[0][0] == next_pos:
+                    window.release()
+                    yield heapq.heappop(ahead)[1]
+                    next_pos += 1
                 else:
-                    heapq.heappush(ahead, item)
+                    item = drain()
+                    if item is _STOP:
+                        live_workers -= 1
+                    else:
+                        heapq.heappush(ahead, item)
+        finally:
+            abandoned.set()
 
     return _read

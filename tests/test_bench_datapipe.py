@@ -1,0 +1,56 @@
+"""bench_datapipe smoke: the datapipe stack must beat the serial
+DataFeeder loop on the input-bound workload, and the JSON summary must
+keep its schema (BENCH_DATAPIPE.json records the full acceptance run,
+which demands >= 2x; CI keeps the fast schema + beats-serial check)."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+import bench_datapipe  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def smoke_summary():
+    return bench_datapipe.run_bench(n_samples=192, payload_floats=1 << 13,
+                                    io_ms=1.0, workers=8, smoke=True)
+
+
+def test_summary_schema(smoke_summary):
+    assert {"workload", "smoke", "serial", "datapipe",
+            "speedup"} <= set(smoke_summary)
+    for mode in ("serial", "datapipe"):
+        stats = smoke_summary[mode]
+        assert {"mode", "steps", "elapsed_sec",
+                "samples_per_sec"} <= set(stats)
+        assert stats["steps"] > 0
+        assert stats["samples_per_sec"] > 0
+    assert {"n_samples", "batch_size", "io_ms", "workers",
+            "steps"} <= set(smoke_summary["workload"])
+
+
+def test_modes_ran_equal_steps(smoke_summary):
+    assert smoke_summary["serial"]["steps"] == \
+        smoke_summary["datapipe"]["steps"]
+
+
+def test_pipeline_counters_recorded(smoke_summary):
+    items = smoke_summary["datapipe"]["pipeline_items"]
+    assert items.get("datapipe.source.items", 0) > 0
+    assert items.get("datapipe.prefetch.items", 0) > 0
+    assert smoke_summary["datapipe"]["prefetch_stall_sec_total"] is not None
+
+
+def test_datapipe_beats_serial(smoke_summary):
+    # the overlap win is structural (parallel fetch + prefetch); even a
+    # noisy 2-core CI box shows >1x on the io-bound smoke workload
+    assert smoke_summary["speedup"] is not None
+    assert smoke_summary["speedup"] > 1.0, smoke_summary
+
+
+@pytest.mark.slow
+def test_acceptance_2x():
+    summary = bench_datapipe.run_bench()
+    assert summary["speedup"] >= 2.0, summary

@@ -240,3 +240,42 @@ class TestFeedShapeError:
         out = feeder.feed([([1.0, 2.0, 3.0, 4.0],),
                            ([5.0, 6.0, 7.0, 8.0],)])
         assert out["xok"].shape == (2, 4)
+
+    def test_float_into_int_slot_rejected_not_truncated(self):
+        """Float samples fed to a declared integer slot (labels/features
+        swapped) used to silently truncate through np.array(dtype=)."""
+        from paddle_tpu.data_feeder import FeedShapeError
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            lb = layers.data(name="lbl", shape=[1], dtype="int64")
+            feeder = fluid.DataFeeder(feed_list=[lb],
+                                      place=fluid.CPUPlace(),
+                                      program=main)
+        with pytest.raises(FeedShapeError, match="lbl"):
+            feeder.feed([(np.array([0.7], "float32"),)])
+        # one float sample hidden in an otherwise-int batch is caught
+        # too (the stacked batch promotes to float)
+        with pytest.raises(FeedShapeError, match="lbl"):
+            feeder.feed([(np.array([3], "int64"),),
+                         (np.array([0.7], "float32"),)])
+        # integer samples into the integer slot still pass
+        out = feeder.feed([(np.array([3], "int64"),),
+                           (np.array([5], "int64"),)])
+        assert out["lbl"].dtype == np.int64
+
+    def test_converters_cached_across_feed_calls(self):
+        """One converter set per feeder, reset per batch — not rebuilt
+        per feed() call — and batches stay independent."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="xc", shape=[2])
+            feeder = fluid.DataFeeder(feed_list=[x],
+                                      place=fluid.CPUPlace(),
+                                      program=main)
+        out1 = feeder.feed([([1.0, 2.0],)])
+        convs = feeder._converters
+        out2 = feeder.feed([([3.0, 4.0],), ([5.0, 6.0],)])
+        assert feeder._converters is convs          # reused, not rebuilt
+        assert out1["xc"].shape == (1, 2)           # no cross-batch bleed
+        assert out2["xc"].shape == (2, 2)
+        np.testing.assert_allclose(out2["xc"][0], [3.0, 4.0])

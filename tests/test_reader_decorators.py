@@ -105,6 +105,55 @@ class TestV2Plot:
         p.plot()  # must not raise without matplotlib state
 
 
+class TestAbandonedConsumerThreadCleanup:
+    """An abandoned iteration (break/close/GC) used to leave the pump
+    and xmap worker threads blocked forever on full queues."""
+
+    def _wait_threads(self, baseline, timeout=5.0):
+        import threading
+        import time
+        deadline = time.time() + timeout
+        while threading.active_count() > baseline and \
+                time.time() < deadline:
+            time.sleep(0.01)
+        return threading.active_count()
+
+    def test_buffered_abandon_releases_pump_thread(self):
+        import threading
+        baseline = threading.active_count()
+
+        def endless():
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+        it = dec.buffered(lambda: endless(), 2)()
+        assert next(it) == 0
+        it.close()  # abandon with the queue full and the pump blocked
+        assert self._wait_threads(baseline) <= baseline
+
+    def test_xmap_abandon_releases_feeder_and_workers(self):
+        import threading
+        baseline = threading.active_count()
+
+        def endless():
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+        for order in (False, True):
+            it = dec.xmap_readers(lambda x: x * 2, lambda: endless(),
+                                  3, 2, order=order)()
+            assert next(it) is not None
+            it.close()
+            assert self._wait_threads(baseline) <= baseline, order
+
+    def test_buffered_still_completes_normally_after_fix(self):
+        assert list(dec.buffered(r10(), 2)()) == list(range(10))
+
+
 class TestThreadedErrorPropagation:
     def test_buffered_reraises_producer_exception(self):
         def bad():
