@@ -58,6 +58,7 @@ from paddle_tpu import reader
 from paddle_tpu import dataset
 from paddle_tpu import fault
 from paddle_tpu import datapipe
+from paddle_tpu import obs
 
 __version__ = "0.1.0"
 

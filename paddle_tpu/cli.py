@@ -18,7 +18,11 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              --compile-cache DIR)
   stats   --addr HOST:PORT                   runtime metrics snapshot of
                                              a serving replica (/stats);
-                                             --local for this process
+                                             --local for this process;
+                                             --prom for Prometheus text
+  trace   dump [--addr HOST:PORT|--local]    Chrome trace-event JSON of
+                                             the span ring (PADDLE_TPU_
+                                             TRACE); load in Perfetto
   profile [--model transformer|resnet ...]   per-op device-time table of
                                              one compiled training step
   version
@@ -142,6 +146,20 @@ def _cmd_stats(args):
     of an in-process run)."""
     import json as _json
 
+    if args.prom:
+        # Prometheus text exposition (the /metrics body) — what a
+        # node-exporter-style scraper or a debugging curl wants
+        if args.local:
+            from paddle_tpu.obs.prom import render_prometheus
+            print(render_prometheus(), end="")
+        elif args.addr:
+            from paddle_tpu.serving import ServingClient
+            print(ServingClient(args.addr).prom_metrics(), end="")
+        else:
+            print("stats: need --addr HOST:PORT or --local",
+                  file=sys.stderr)
+            return 2
+        return 0
     if args.local:
         from paddle_tpu.profiler import runtime_metrics
         snap = runtime_metrics.snapshot()
@@ -170,6 +188,33 @@ def _cmd_stats(args):
     if srv:
         print("server: " + " ".join(f"{k}={v}"
                                     for k, v in sorted(srv.items())))
+    return 0
+
+
+def _cmd_trace(args):
+    """Dump the span ring as Chrome trace-event JSON — this process's
+    ring with --local (enable PADDLE_TPU_TRACE first), or a serving
+    replica's via its /trace endpoint.  The output loads directly in
+    Perfetto (ui.perfetto.dev) or chrome://tracing."""
+    import json as _json
+
+    if args.action != "dump":
+        print(f"trace: unknown action {args.action!r} (want: dump)",
+              file=sys.stderr)
+        return 2
+    if args.addr:
+        from paddle_tpu.serving import ServingClient
+        obj = ServingClient(args.addr).trace()
+    else:
+        from paddle_tpu.obs import trace as _trace
+        obj = _trace.chrome_trace()
+    body = _json.dumps(obj)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(body)
+        print(f"wrote {len(obj['traceEvents'])} span(s) to {args.output}")
+    else:
+        print(body)
     return 0
 
 
@@ -325,7 +370,23 @@ def main(argv=None):
                         "a remote server (datapipe/executor counters)")
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the formatted table")
+    p.add_argument("--prom", action="store_true",
+                   help="Prometheus text exposition format (the /metrics "
+                        "body) instead of the snapshot table")
     p.set_defaults(fn=_cmd_stats)
+
+    p = sub.add_parser("trace", help="dump the span ring as Chrome "
+                                     "trace-event JSON (Perfetto)")
+    p.add_argument("action", choices=["dump"])
+    p.add_argument("--addr", default=None,
+                   help="host:port of a serving replica (/trace); "
+                        "default: this process's ring (--local)")
+    p.add_argument("--local", action="store_true",
+                   help="this process's span ring (the default when "
+                        "--addr is not given)")
+    p.add_argument("--output", default=None,
+                   help="write the JSON here instead of stdout")
+    p.set_defaults(fn=_cmd_trace)
 
     p = sub.add_parser("profile", help="per-op device-time table of one "
                                        "compiled training step")

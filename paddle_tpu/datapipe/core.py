@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import time
 
+from paddle_tpu.obs.trace import record_span
 from paddle_tpu.profiler import runtime_metrics
 
 __all__ = ["Stage", "PipelineStateError", "stats"]
@@ -135,11 +136,15 @@ class Stage:
 
     def _pull(self, iterator):
         """``next(iterator)`` with the upstream wait observed as this
-        stage's stall time.  Raises StopIteration through."""
+        stage's stall time (and, under tracing, one
+        ``datapipe.<stage>.pull`` span per sample — the per-stage
+        timeline every pipeline stage contributes through this choke
+        point).  Raises StopIteration through."""
         t0 = time.perf_counter()
         item = next(iterator)
-        runtime_metrics.observe(self._metrics + ".wait_seconds",
-                                time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        runtime_metrics.observe(self._metrics + ".wait_seconds", dt)
+        record_span(self._metrics + ".pull", t0, dt)
         return item
 
     # -- fluent builders ------------------------------------------------

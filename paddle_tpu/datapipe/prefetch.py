@@ -29,6 +29,7 @@ import jax
 import numpy as np
 
 from paddle_tpu.datapipe.core import Stage, _Raised
+from paddle_tpu.obs.trace import record_span
 from paddle_tpu.profiler import runtime_metrics
 
 __all__ = ["DevicePrefetch"]
@@ -107,9 +108,10 @@ class DevicePrefetch(Stage):
                         deliver(q, stop, overflow, _EOF)
                         return
                     dev = _to_device(batch, self.device)
+                    dt = time.perf_counter() - t0
                     runtime_metrics.observe(
-                        self._metrics + ".fill_seconds",
-                        time.perf_counter() - t0)
+                        self._metrics + ".fill_seconds", dt)
+                    record_span(self._metrics + ".fill", t0, dt)
                     if not deliver(q, stop, overflow, dev):
                         return
                     runtime_metrics.set_gauge(
@@ -141,8 +143,9 @@ class DevicePrefetch(Stage):
             self._ensure_thread()
             t0 = time.perf_counter()
             item = self._q.get()
-            runtime_metrics.observe(self._metrics + ".stall_seconds",
-                                    time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            runtime_metrics.observe(self._metrics + ".stall_seconds", dt)
+            record_span(self._metrics + ".stall", t0, dt)
             runtime_metrics.set_gauge(self._metrics + ".queue_depth",
                                       self._q.qsize())
             if item is _EOF:

@@ -25,6 +25,7 @@ import numpy as np
 
 from paddle_tpu import framework
 from paddle_tpu.framework import Program, default_main_program
+from paddle_tpu.obs.trace import span as _span, record_span as _record_span
 from paddle_tpu.place import CPUPlace, TPUPlace
 from paddle_tpu.scope import Scope, global_scope
 from paddle_tpu.ops import registry
@@ -357,61 +358,78 @@ class Executor:
         fetch_names = [f.name if isinstance(f, framework.Variable) else f
                        for f in fetch_list]
 
+        with _span("executor.run"):
+            return self._run_traced(program, block, feed, fetch_names,
+                                    scope, return_numpy)
+
+    def _run_traced(self, program, block, feed, fetch_names, scope,
+                    return_numpy):
+        """Body of :meth:`run`, phase-annotated: ``executor.feed``
+        (host->device conversion + reader pre-pass), ``executor.dispatch``
+        (compile lookup + XLA launch), ``executor.fetch`` (state
+        write-back + host conversion) — the spans that answer "where did
+        step N spend its time"."""
         feed_arrays = {}
         device = self._feed_device()
-        for name, value in feed.items():
-            var = block.var(name) if block.has_var(name) else None
-            lod = None
-            if isinstance(value, tuple) and len(value) == 2 and \
-                    isinstance(value[1], (list, tuple)):
-                value, lod = value
-            dtype = var.dtype if var is not None else None
-            _enforce_feed(name, value, var)
-            if lod is not None and len(lod) == 1 and \
-                    _lod_buckets_enabled(program):
-                # bucketed ragged mode (lod.py): pad rows to a bucket and
-                # feed the row-splits as data, so the jit key is the
-                # bucket, not the exact lod
-                from paddle_tpu.lod import bucket_ragged_feed, SPLITS_SUFFIX
-                value, splits, meta = bucket_ragged_feed(
-                    name, np.asarray(value), lod)
+        with _span("executor.feed"):
+            for name, value in feed.items():
+                var = block.var(name) if block.has_var(name) else None
+                lod = None
+                if isinstance(value, tuple) and len(value) == 2 and \
+                        isinstance(value[1], (list, tuple)):
+                    value, lod = value
+                dtype = var.dtype if var is not None else None
+                _enforce_feed(name, value, var)
+                if lod is not None and len(lod) == 1 and \
+                        _lod_buckets_enabled(program):
+                    # bucketed ragged mode (lod.py): pad rows to a bucket
+                    # and feed the row-splits as data, so the jit key is
+                    # the bucket, not the exact lod
+                    from paddle_tpu.lod import (bucket_ragged_feed,
+                                                SPLITS_SUFFIX)
+                    value, splits, meta = bucket_ragged_feed(
+                        name, np.asarray(value), lod)
+                    feed_arrays[name] = _as_device_array(value, dtype,
+                                                         device)
+                    feed_arrays[name + SPLITS_SUFFIX] = _as_device_array(
+                        splits, "int32", device)
+                    scope.set_lod(name, meta)
+                    continue
                 feed_arrays[name] = _as_device_array(value, dtype, device)
-                feed_arrays[name + SPLITS_SUFFIX] = _as_device_array(
-                    splits, "int32", device)
-                scope.set_lod(name, meta)
-                continue
-            feed_arrays[name] = _as_device_array(value, dtype, device)
-            # a dense feed must also CLEAR any stale lod from a previous
-            # ragged feed of the same variable
-            scope.set_lod(name, lod)
+                # a dense feed must also CLEAR any stale lod from a
+                # previous ragged feed of the same variable
+                scope.set_lod(name, lod)
 
-        _run_reader_ops(block, scope, feed_arrays, device)
+            _run_reader_ops(block, scope, feed_arrays, device)
 
-        compiled = self._get_compiled(program, block, feed_arrays,
-                                      tuple(fetch_names), scope)
+        with _span("executor.dispatch") as dsp:
+            compiled = self._get_compiled(program, block, feed_arrays,
+                                          tuple(fetch_names), scope)
 
-        ro_state = {n: self._state_value(scope, n, device)
-                    for n in compiled.ro_names}
-        inout_state = {n: self._state_value(scope, n, device)
-                       for n in compiled.inout_names}
+            ro_state = {n: self._state_value(scope, n, device)
+                        for n in compiled.ro_names}
+            inout_state = {n: self._state_value(scope, n, device)
+                           for n in compiled.inout_names}
 
-        self._run_counter += 1
-        key = jax.random.PRNGKey(
-            (program.random_seed or 0) * 1000003 + self._run_counter)
+            self._run_counter += 1
+            key = jax.random.PRNGKey(
+                (program.random_seed or 0) * 1000003 + self._run_counter)
 
-        t0 = time.perf_counter()
-        fetches, new_state = compiled.fn(feed_arrays, ro_state, inout_state,
-                                         key)
+            t0 = time.perf_counter()
+            fetches, new_state = compiled.fn(feed_arrays, ro_state,
+                                             inout_state, key)
+            dsp.set(fetches=len(fetch_names))
         from paddle_tpu import profiler as _profiler
         _profiler.runtime_metrics.observe("executor.step_seconds",
                                           time.perf_counter() - t0)
-        if _check_nan_inf_enabled(program):
-            _check_nan_inf(fetch_names, fetches, new_state)
-        for n, v in new_state.items():
-            scope.set_var(n, v)
-        if return_numpy:
-            return [np.asarray(v) for v in fetches]
-        return list(fetches)
+        with _span("executor.fetch"):
+            if _check_nan_inf_enabled(program):
+                _check_nan_inf(fetch_names, fetches, new_state)
+            for n, v in new_state.items():
+                scope.set_var(n, v)
+            if return_numpy:
+                return [np.asarray(v) for v in fetches]
+            return list(fetches)
 
     # ------------------------------------------------------------------
     def warmup(self, program=None, feed_shapes=None, fetch_list=None,
@@ -707,18 +725,25 @@ class Executor:
             # check the budget BEFORE pulling: a batch pulled past the
             # limit would be dropped (lost from the resume sequence)
             while max_steps is None or step < max_steps:
+                t0 = time.perf_counter()
                 try:
                     batch = next(it)
                 except StopIteration:
                     break
+                # recorded only on success: a normal epoch-end
+                # StopIteration is not an error-tagged span
+                _record_span("datapipe.next", t0,
+                             time.perf_counter() - t0, step=step)
                 _chaos.fire("train.step", step=step)
-                with _profiler.record_latency("datapipe.step_seconds"):
-                    fetches = self.run(program, feed=batch,
-                                       fetch_list=fetch_list, scope=scope,
-                                       return_numpy=return_numpy)
+                with _span("train.step", step=step):
+                    with _profiler.record_latency("datapipe.step_seconds"):
+                        fetches = self.run(program, feed=batch,
+                                           fetch_list=fetch_list,
+                                           scope=scope,
+                                           return_numpy=return_numpy)
+                    if on_step is not None:
+                        on_step(step, fetches)
                 outs.append(fetches)
-                if on_step is not None:
-                    on_step(step, fetches)
                 step += 1
         finally:
             close = getattr(it, "close", None)  # plain iterables lack it

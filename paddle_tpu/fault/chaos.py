@@ -138,6 +138,17 @@ def fire(name, **context):
     if delay:
         time.sleep(delay)
     if kill:
+        # last act before the hard exit: flight-recorder dump (no-op
+        # unless PADDLE_TPU_POSTMORTEM is set; write_postmortem never
+        # raises).  A chaos kill is the drill for a real crash — the
+        # post-mortem is the artifact the drill validates.
+        try:
+            from paddle_tpu.obs import flight
+            flight.write_postmortem(
+                reason=f"chaos kill at failpoint {name!r}",
+                extra={"failpoint": name, "context": repr(context)})
+        except Exception:
+            pass
         os._exit(KILL_EXIT_CODE)   # hard crash: no atexit, no finally
     if error is not None or delay is None:
         detail = f" ({context})" if context else ""

@@ -20,6 +20,7 @@ import pickle
 import shutil
 
 from paddle_tpu.fault import chaos
+from paddle_tpu.obs.trace import span as _span
 
 __all__ = ["CheckpointManager", "CorruptCheckpoint", "MANIFEST_NAME",
            "DATAPIPE_STATE_NAME", "write_manifest", "verify_checkpoint",
@@ -122,25 +123,28 @@ def commit_checkpoint(tmp_path, final_path, step=None):
     before the rename — a kill there must leave the previous committed
     checkpoint as the restore target.
     """
-    write_manifest(tmp_path, step=step)
-    _fsync_dir(tmp_path)
+    with _span("ckpt.manifest", step=step):
+        write_manifest(tmp_path, step=step)
+        _fsync_dir(tmp_path)
     chaos.fire("ckpt.commit", step=step)
-    displaced = None
-    if os.path.exists(final_path):
-        # overwriting a committed step (rollback + retrain): displace it
-        # by ATOMIC rename rather than rmtree, so a crash in this window
-        # still leaves a complete dir on disk (restore falls back to an
-        # earlier step; the displaced dir is swept by the next GC)
-        displaced = os.path.join(
-            os.path.dirname(final_path),
-            _TMP_PREFIX + "old-" + os.path.basename(final_path))
-        if os.path.exists(displaced):
-            shutil.rmtree(displaced)
-        os.rename(final_path, displaced)
-    os.rename(tmp_path, final_path)
-    _fsync_dir(os.path.dirname(final_path) or ".")
-    if displaced is not None:
-        shutil.rmtree(displaced, ignore_errors=True)
+    with _span("ckpt.rename", step=step):
+        displaced = None
+        if os.path.exists(final_path):
+            # overwriting a committed step (rollback + retrain): displace
+            # it by ATOMIC rename rather than rmtree, so a crash in this
+            # window still leaves a complete dir on disk (restore falls
+            # back to an earlier step; the displaced dir is swept by the
+            # next GC)
+            displaced = os.path.join(
+                os.path.dirname(final_path),
+                _TMP_PREFIX + "old-" + os.path.basename(final_path))
+            if os.path.exists(displaced):
+                shutil.rmtree(displaced)
+            os.rename(final_path, displaced)
+        os.rename(tmp_path, final_path)
+        _fsync_dir(os.path.dirname(final_path) or ".")
+        if displaced is not None:
+            shutil.rmtree(displaced, ignore_errors=True)
     return final_path
 
 
@@ -215,15 +219,17 @@ class CheckpointManager:
         """Commit the current training state as ``ckpt-<step>`` (plus the
         datapipe iterator position, when a pipeline is attached)."""
         from paddle_tpu import io
-        extras = None
-        if self.datapipe is not None:
-            extras = {_datapipe_state_name(): pickle.dumps(
-                self.datapipe.state_dict(), protocol=4)}
-        path = io.save_checkpoint(self.executor, self.dirname,
-                                  main_program=self.main_program,
-                                  step=step, scope=self.scope,
-                                  extras=extras)
-        self._gc()
+        with _span("ckpt.save", step=step):
+            extras = None
+            if self.datapipe is not None:
+                extras = {_datapipe_state_name(): pickle.dumps(
+                    self.datapipe.state_dict(), protocol=4)}
+            path = io.save_checkpoint(self.executor, self.dirname,
+                                      main_program=self.main_program,
+                                      step=step, scope=self.scope,
+                                      extras=extras)
+            with _span("ckpt.gc", step=step):
+                self._gc()
         return path
 
     def _gc(self):

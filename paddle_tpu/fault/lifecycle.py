@@ -55,8 +55,30 @@ class GracefulShutdown:
         if self.received is None:
             self.received = signum
         self._event.set()
+        # flight-recorder snapshot at the moment of preemption (no-op
+        # unless PADDLE_TPU_POSTMORTEM is armed) — if the grace window
+        # runs out mid-commit, this is what survives.  MUST NOT run in
+        # this frame: request() is called from the signal handler, i.e.
+        # on the main thread, which may be interrupted INSIDE a
+        # runtime-metrics lock — snapshotting here would deadlock.  A
+        # daemon thread acquires that lock normally once the handler
+        # returns and the main thread releases it; __exit__ writes a
+        # final synchronous dump as the deterministic backstop.
+        try:
+            self._dump_async(signum)
+        except Exception:
+            pass
         if self.on_shutdown is not None:
             self.on_shutdown(signum)
+
+    def _dump_async(self, signum):
+        from paddle_tpu.obs import flight
+        if flight.postmortem_path() is None:
+            return
+        reason = f"graceful shutdown (signal {signum})"
+        threading.Thread(target=flight.write_postmortem, daemon=True,
+                         kwargs={"reason": reason},
+                         name="paddle-tpu-postmortem").start()
 
     # -- context -----------------------------------------------------------
     def _handler(self, signum, frame):
@@ -74,6 +96,16 @@ class GracefulShutdown:
         for sig, prev in self._previous.items():
             signal.signal(sig, prev)
         self._previous.clear()
+        if self.received is not None:
+            # deterministic final dump from loop context (the async
+            # handler-side dump is best-effort; the write is atomic and
+            # idempotent, so doubling up is safe)
+            try:
+                from paddle_tpu.obs import flight
+                flight.write_postmortem(
+                    reason=f"graceful shutdown (signal {self.received})")
+            except Exception:
+                pass
         return False
 
 

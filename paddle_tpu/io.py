@@ -301,14 +301,16 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
     if jax.process_index() == 0 and os.path.exists(tmp):
         shutil.rmtree(tmp)
     chaos.fire("ckpt.save", step=step)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(tmp, state, force=True)
-    ckptr.wait_until_finished()
-    for name, blob in (extras or {}).items():
-        with open(os.path.join(tmp, name), "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
+    from paddle_tpu.obs.trace import span as _span
+    with _span("ckpt.write", step=int(step), vars=len(state)):
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(tmp, state, force=True)
+        ckptr.wait_until_finished()
+        for name, blob in (extras or {}).items():
+            with open(os.path.join(tmp, name), "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
     if jax.process_count() > 1:
         # all hosts' extras must land before the coordinator manifests
         # the tmp dir — without this barrier a late host's sidecar file

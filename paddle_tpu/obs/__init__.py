@@ -1,0 +1,37 @@
+"""Observability spine: structured tracing, flight recorder, Prometheus.
+
+Three layers over the existing ``profiler.RuntimeMetrics`` counters:
+
+- :mod:`paddle_tpu.obs.trace` — Dapper-style spans with contextvar
+  nesting and cross-process trace-context propagation, recorded into a
+  bounded ring (``PADDLE_TPU_TRACE``), exported as Chrome trace-event
+  JSON (``/trace``, ``paddle_tpu trace dump``).
+- :mod:`paddle_tpu.obs.flight` — post-mortem dumps of the span tail +
+  metrics snapshot on crash / graceful shutdown / chaos kill
+  (``PADDLE_TPU_POSTMORTEM``).
+- :mod:`paddle_tpu.obs.prom` — Prometheus text exposition of the
+  runtime metrics (``/metrics``, ``paddle_tpu stats --prom``).
+
+See ``docs/observability.md`` for the span API, the trace-context
+headers, the post-mortem file format, and the metric-name registry.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.obs import trace
+from paddle_tpu.obs import flight
+from paddle_tpu.obs import prom
+from paddle_tpu.obs.trace import (span, record_span, trace_context,
+                                  current_trace_id, new_trace_id,
+                                  chrome_trace, dump_chrome_trace)
+from paddle_tpu.obs.flight import write_postmortem, read_postmortem
+from paddle_tpu.obs.prom import render_prometheus
+
+__all__ = ["trace", "flight", "prom", "span", "record_span",
+           "trace_context", "current_trace_id", "new_trace_id",
+           "chrome_trace", "dump_chrome_trace", "write_postmortem",
+           "read_postmortem", "render_prometheus"]
+
+# arm the uncaught-exception post-mortem hook iff the operator asked
+# for one (PADDLE_TPU_POSTMORTEM); unarmed this changes nothing
+flight.install_from_env()

@@ -21,6 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.obs.trace import span as _span
 from paddle_tpu.ops.registry import (
     register_op, LowerContext, infer_shape_unary, ShapeInferenceSkip)
 
@@ -29,33 +30,43 @@ __all__ = ["all_reduce", "all_gather", "reduce_scatter", "all_to_all",
 
 
 # -- python helpers (require an active named axis) --------------------------
+#
+# The spans here measure STAGING time (these run at trace time inside a
+# jit/shard_map lowering — device-side collective time lives in the
+# XProf trace); what they buy the span timeline is WHICH collectives a
+# step emits, with axis names, in program order.
 
 def all_reduce(x, axis_name, op="sum"):
-    return {"sum": jax.lax.psum, "max": jax.lax.pmax,
-            "min": jax.lax.pmin}[op](x, axis_name)
+    with _span("collective.all_reduce", axis=str(axis_name), op=op):
+        return {"sum": jax.lax.psum, "max": jax.lax.pmax,
+                "min": jax.lax.pmin}[op](x, axis_name)
 
 
 def all_gather(x, axis_name, axis=0, tiled=True):
-    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    with _span("collective.all_gather", axis=str(axis_name)):
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name, scatter_dimension=0):
-    return jax.lax.psum_scatter(x, axis_name,
-                                scatter_dimension=scatter_dimension,
-                                tiled=True)
+    with _span("collective.reduce_scatter", axis=str(axis_name)):
+        return jax.lax.psum_scatter(x, axis_name,
+                                    scatter_dimension=scatter_dimension,
+                                    tiled=True)
 
 
 def all_to_all(x, axis_name, split_axis, concat_axis):
-    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
-                              concat_axis=concat_axis, tiled=True)
+    with _span("collective.all_to_all", axis=str(axis_name)):
+        return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
 
 
 def broadcast(x, axis_name, root=0):
-    # select root's value on every member of the axis
-    idx = jax.lax.axis_index(axis_name)
-    src = jax.lax.all_gather(x, axis_name, axis=0)
-    del idx
-    return src[root]
+    with _span("collective.broadcast", axis=str(axis_name)):
+        # select root's value on every member of the axis
+        idx = jax.lax.axis_index(axis_name)
+        src = jax.lax.all_gather(x, axis_name, axis=0)
+        del idx
+        return src[root]
 
 
 # -- IR ops -----------------------------------------------------------------

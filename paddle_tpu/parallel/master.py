@@ -20,6 +20,9 @@ import os
 import threading
 import time
 
+from paddle_tpu.obs import trace as _trace
+from paddle_tpu.obs.trace import span as _span
+
 __all__ = ["Task", "MasterService", "partition_files",
            "MasterServer", "MasterClient", "MasterError"]
 
@@ -260,32 +263,41 @@ class _MasterRPCHandler(socketserver.StreamRequestHandler):
                 req = json.loads(line)
                 method = req.get("method")
                 params = req.get("params") or {}
-                if method == "get_task":
-                    t = svc.get_task(params.get("trainer_id"))
-                    result = t.to_dict() if t is not None else None
-                elif method == "heartbeat":
-                    result = svc.heartbeat(params["trainer_id"])
-                elif method == "task_finished":
-                    result = svc.task_finished(params["task_id"],
-                                               params.get("epoch"))
-                elif method == "task_failed":
-                    result = svc.task_failed(params["task_id"],
-                                             params.get("epoch"))
-                elif method == "all_done":
-                    result = svc.all_done()
-                elif method == "reset_pass":
-                    result = svc.reset_pass()
-                elif method == "stats":
-                    result = svc.stats()
-                elif method == "ping":
-                    result = "pong"
-                else:
-                    raise ValueError(f"unknown method {method!r}")
+                # trace-context hop: the caller's trace id rides the
+                # frame as _trace — this RPC's server-side span joins
+                # the calling trainer's timeline
+                caller_trace = params.pop("_trace", None)
+                with _trace.trace_context(caller_trace), \
+                        _span("master.serve", method=str(method)):
+                    result = self._dispatch(svc, method, params)
                 resp = {"result": result}
             except Exception as e:  # surface errors to the client
                 resp = {"error": str(e)}
             self.wfile.write((json.dumps(resp) + "\n").encode())
             self.wfile.flush()
+
+    @staticmethod
+    def _dispatch(svc, method, params):
+        if method == "get_task":
+            t = svc.get_task(params.get("trainer_id"))
+            return t.to_dict() if t is not None else None
+        if method == "heartbeat":
+            return svc.heartbeat(params["trainer_id"])
+        if method == "task_finished":
+            return svc.task_finished(params["task_id"],
+                                     params.get("epoch"))
+        if method == "task_failed":
+            return svc.task_failed(params["task_id"],
+                                   params.get("epoch"))
+        if method == "all_done":
+            return svc.all_done()
+        if method == "reset_pass":
+            return svc.reset_pass()
+        if method == "stats":
+            return svc.stats()
+        if method == "ping":
+            return "pong"
+        raise ValueError(f"unknown method {method!r}")
 
 
 class MasterServer:
@@ -368,6 +380,11 @@ class MasterClient:
 
     def _call(self, method, **params):
         from paddle_tpu.fault import chaos
+        rid = _trace.current_trace_id()
+        if rid is not None:
+            # caller's trace id crosses the process boundary in-frame;
+            # the master's handler spans join this trace
+            params["_trace"] = rid
 
         def attempt():
             chaos.fire("master.rpc", method=method)
@@ -393,7 +410,8 @@ class MasterClient:
                     raise ConnectionError(f"garbled master reply: {e}") \
                         from e
 
-        resp = self._retry.call(attempt)
+        with _span("master.rpc", method=method):
+            resp = self._retry.call(attempt)
         if "error" in resp:
             raise MasterError(f"master: {resp['error']}")
         return resp["result"]

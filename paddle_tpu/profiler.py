@@ -406,6 +406,9 @@ class RuntimeMetrics:
             return self._gauges.get(name)
 
     def percentiles(self, name, qs=(50, 95, 99)):
+        """Window percentiles of ``name``; an unknown or empty series
+        yields None per quantile (never raises — dashboards poll series
+        that may not have emitted yet)."""
         with self._lock:
             d = self._series.get(name)
             xs = sorted(d) if d else []
@@ -450,12 +453,21 @@ runtime_metrics = RuntimeMetrics()
 
 @contextlib.contextmanager
 def record_latency(name, metrics=None):
-    """Time the body and observe it as one sample of ``name``."""
+    """Time the body and observe it as one sample of ``name``.
+
+    A raising body still has its elapsed time observed (failures are
+    often the SLOW samples — dropping them would flatter the
+    percentiles) and additionally bumps the ``<name>.errors`` counter,
+    so error-rate and latency stay attributable to the same series."""
     m = metrics or runtime_metrics
     t0 = time.perf_counter()
     try:
         yield
-    finally:
+    except BaseException:
+        m.observe(name, time.perf_counter() - t0)
+        m.inc(name + ".errors")
+        raise
+    else:
         m.observe(name, time.perf_counter() - t0)
 
 

@@ -18,6 +18,9 @@ import time
 
 import numpy as np
 
+from paddle_tpu.obs import trace as _trace
+from paddle_tpu.obs.trace import span as _span, record_span as _record_span
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["Predictor", "serve", "InferenceServer", "MicroBatcher",
@@ -232,7 +235,7 @@ class _Pending:
     """One enqueued request awaiting its batch slot."""
 
     __slots__ = ("feed", "key", "rows", "event", "result", "error",
-                 "abandoned")
+                 "abandoned", "enqueue_t", "trace_id")
 
     def __init__(self, feed, key, rows):
         self.feed = feed
@@ -242,6 +245,11 @@ class _Pending:
         self.result = None
         self.error = None
         self.abandoned = False
+        # queue-wait measurement + cross-thread trace stitching: the
+        # batcher thread records this request's spans under the trace id
+        # the submitting handler was serving (the X-Request-Id)
+        self.enqueue_t = time.perf_counter()
+        self.trace_id = _trace.current_trace_id()
 
 
 class MicroBatcher:
@@ -354,6 +362,7 @@ class MicroBatcher:
                 first = self._queue.pop(0)
                 if first.abandoned:
                     continue
+                assembly_t0 = time.perf_counter()
                 batch.append(first)
                 budget = self.max_batch_rows - (first.rows or 0)
                 # linger up to max_batch_delay for co-batchable arrivals
@@ -366,25 +375,40 @@ class MicroBatcher:
                             len(batch) >= self.max_batch_size or budget <= 0:
                         break
                     self._cv.wait(remaining)
-            self._dispatch(batch)
+            self._dispatch(batch, assembly_t0)
 
-    def _dispatch(self, batch):
+    def _dispatch(self, batch, assembly_t0=None):
         from paddle_tpu import profiler as _profiler
         from paddle_tpu.fault import chaos
+        now = time.perf_counter()
+        lead = batch[0].trace_id
+        for p in batch:
+            # queue wait measured per request, stitched to ITS trace id
+            _record_span("serving.queue_wait", p.enqueue_t,
+                         now - p.enqueue_t, trace_id=p.trace_id)
+        if assembly_t0 is not None:
+            _record_span("serving.batch_assembly", assembly_t0,
+                         now - assembly_t0, trace_id=lead,
+                         size=len(batch))
         try:
             chaos.fire("serving.batch", size=len(batch))
             _profiler.runtime_metrics.bucket("serving.batch_occupancy",
                                              len(batch))
             _profiler.runtime_metrics.inc("serving.batches")
-            results = self._predictor.run_many([p.feed for p in batch])
+            with _trace.trace_context(lead):
+                with _span("serving.dispatch", size=len(batch)):
+                    results = self._predictor.run_many(
+                        [p.feed for p in batch])
         except BaseException as e:
             for p in batch:
                 p.error = e
                 p.event.set()
             return
-        for p, r in zip(batch, results):
-            p.result = r
-            p.event.set()
+        with _trace.trace_context(lead):
+            with _span("serving.scatter", size=len(batch)):
+                for p, r in zip(batch, results):
+                    p.result = r
+                    p.event.set()
 
 
 # ---------------------------------------------------------------------------
@@ -511,13 +535,21 @@ class InferenceServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _reply(self, code, obj):
-                body = json.dumps(obj).encode()
+            def _reply_raw(self, code, body, content_type):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                rid = getattr(self, "_request_id", None)
+                if rid:
+                    # echo the (accepted or generated) request id so the
+                    # caller can correlate logs/traces across the hop
+                    self.send_header("X-Request-Id", rid)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _reply(self, code, obj):
+                self._reply_raw(code, json.dumps(obj).encode(),
+                                "application/json")
 
             def _error(self, code, etype, message, retryable):
                 self._reply(code, {"error": {"type": etype,
@@ -539,6 +571,12 @@ class InferenceServer:
                 return server.predictor
 
             def do_GET(self):
+                # per-REQUEST id: a keep-alive connection reuses this
+                # handler instance, so a stale id from an earlier POST
+                # must not leak onto this reply (echo the caller's own
+                # header when present, else no header)
+                self._request_id = (self.headers.get("X-Request-Id")
+                                    or "").strip() or None
                 if self.path in ("/health", "/healthz"):
                     self._reply(200, {"status": "ok"})
                 elif self.path == "/readyz":
@@ -569,11 +607,28 @@ class InferenceServer:
                         warmup_batch_sizes=list(
                             server._warmup_batch_sizes))
                     self._reply(200, snap)
+                elif self.path == "/metrics":
+                    from paddle_tpu.obs import prom as _prom
+                    self._reply_raw(
+                        200, _prom.render_prometheus().encode(),
+                        _prom.CONTENT_TYPE)
+                elif self.path == "/trace":
+                    # Chrome trace-event JSON of the span ring: load the
+                    # body straight into Perfetto/chrome://tracing
+                    self._reply_raw(200,
+                                    _trace.dump_chrome_trace().encode(),
+                                    "application/json")
                 else:
                     self._error(404, "not_found", self.path,
                                 retryable=False)
 
             def do_POST(self):
+                # accept the caller's X-Request-Id (generate one when
+                # absent): every reply echoes it, every span of this
+                # request is tagged with it — the Dapper trace-context
+                # hop across the HTTP boundary
+                self._request_id = (self.headers.get("X-Request-Id")
+                                    or "").strip() or _trace.new_trace_id()
                 # drain the body FIRST: replying on an early-error path
                 # with unread body bytes would desync a keep-alive
                 # connection (the next request would parse mid-body)
@@ -606,20 +661,28 @@ class InferenceServer:
                     return
                 t0 = time.perf_counter()
                 try:
-                    chaos.fire("serving.run", path=self.path)
-                    req = json.loads(raw)
-                    feed = {k: np.asarray(v, dtype="float32")
-                            if not isinstance(v, dict)
-                            else np.asarray(v["data"],
-                                            dtype=v.get("dtype", "float32"))
-                            for k, v in req["feeds"].items()}
-                    if server._batcher is not None:
-                        outs = server._batcher.submit(
-                            feed, timeout=server._request_timeout)
-                    else:
-                        outs = predictor.run(
-                            feed, timeout=server._request_timeout)
-                    _profiler.runtime_metrics.inc("serving.requests_ok")
+                    with _trace.trace_context(self._request_id), \
+                            _span("serving.request",
+                                  request_id=self._request_id,
+                                  path=self.path):
+                        chaos.fire("serving.run", path=self.path)
+                        req = json.loads(raw)
+                        feed = {k: np.asarray(v, dtype="float32")
+                                if not isinstance(v, dict)
+                                else np.asarray(v["data"],
+                                                dtype=v.get("dtype",
+                                                            "float32"))
+                                for k, v in req["feeds"].items()}
+                        if server._batcher is not None:
+                            outs = server._batcher.submit(
+                                feed, timeout=server._request_timeout)
+                        else:
+                            with _span("serving.dispatch", size=1):
+                                outs = predictor.run(
+                                    feed,
+                                    timeout=server._request_timeout)
+                        _profiler.runtime_metrics.inc(
+                            "serving.requests_ok")
                     self._reply(200, {"outputs": [o.tolist() for o in outs],
                                       "shapes": [list(o.shape)
                                                  for o in outs],
@@ -711,11 +774,17 @@ class ServingClient:
         import urllib.request
 
         def attempt():
+            headers = {"Content-Type": "application/json"}
+            rid = _trace.current_trace_id()
+            if rid:
+                # the caller's active trace follows the request across
+                # the wire; the server tags its spans with the same id
+                headers["X-Request-Id"] = rid
             req = urllib.request.Request(
                 self._base + path,
                 data=None if payload is None
                 else json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"})
+                headers=headers)
             try:
                 with urllib.request.urlopen(
                         req, timeout=self._timeout) as r:
@@ -754,6 +823,19 @@ class ServingClient:
         """Runtime metrics snapshot (/stats): request latency
         percentiles, batch occupancy, compile/jit-cache counters."""
         return self._request("/stats")
+
+    def trace(self):
+        """The server's span ring as a Chrome trace-event JSON object
+        (/trace) — save it and load into Perfetto."""
+        return self._request("/trace")
+
+    def prom_metrics(self):
+        """The server's /metrics body: Prometheus text exposition of
+        the runtime metrics registry (plain text, not JSON)."""
+        import urllib.request
+        with urllib.request.urlopen(self._base + "/metrics",
+                                    timeout=self._timeout) as r:
+            return r.read().decode()
 
     def healthy(self):
         """Single-shot liveness probe (no retries — probes must be cheap)."""

@@ -1,0 +1,78 @@
+"""CI guard: disabled tracing must cost <5% of the step loop.
+
+The bench environment has 2 noisy vCPUs, so the guard does NOT race two
+sleep loops against each other (sleep scheduling jitter under load is
+tens of microseconds per step — the same order as the bound being
+checked).  Instead the step is sleep-MODELED: a production step is
+taken as 1 ms of device dispatch, the per-step cost of the disabled
+instrumentation shell (the spans + latency series Executor.run /
+run_pipeline wrap every step in) is measured directly over many
+iterations, and the guard asserts shell < 5% of the modeled step.
+That is the same contract — "instrumented loop <= 1.05x plain loop" —
+with the noise term removed instead of averaged over."""
+
+import time
+
+from paddle_tpu.obs import trace
+from paddle_tpu.profiler import RuntimeMetrics, record_latency
+
+# the modeled production step: 1 ms of compiled dispatch (the serving
+# fixture's tiny model dispatches in this order of magnitude; real
+# training steps are larger, making the bound only easier)
+STEP_SECONDS = 0.001
+MAX_OVERHEAD_FRACTION = 0.05
+
+
+def _shell_once(metrics, i):
+    """The per-step instrumentation shell of Executor.run_pipeline +
+    run: one step span, three phase spans, one latency series."""
+    with trace.span("train.step", step=i):
+        with record_latency("obs_overhead.step_seconds",
+                            metrics=metrics):
+            with trace.span("executor.feed"):
+                pass
+            with trace.span("executor.dispatch"):
+                pass
+            with trace.span("executor.fetch"):
+                pass
+
+
+def _per_step_shell_seconds(metrics, iters=2000):
+    t0 = time.perf_counter()
+    for i in range(iters):
+        _shell_once(metrics, i)
+    return (time.perf_counter() - t0) / iters
+
+
+class TestDisabledTracingOverhead:
+    def test_disabled_span_is_shared_noop(self):
+        trace.disable()
+        assert trace.span("a", x=1) is trace.span("b")
+
+    def test_step_loop_overhead_under_5_percent(self):
+        trace.disable()
+        m = RuntimeMetrics()
+        # best-of-5: a contended 2-vCPU runner inflates some rounds;
+        # the minimum is the shell's true cost
+        shell = min(_per_step_shell_seconds(m) for _ in range(5))
+        budget = STEP_SECONDS * MAX_OVERHEAD_FRACTION
+        assert shell <= budget, (
+            f"disabled instrumentation shell costs {shell * 1e6:.1f}us "
+            f"per step — over {MAX_OVERHEAD_FRACTION:.0%} of a "
+            f"{STEP_SECONDS * 1e3:.0f}ms step ({budget * 1e6:.0f}us)")
+        # the latency series keeps recording while spans are disabled
+        assert m.snapshot()["series"][
+            "obs_overhead.step_seconds"]["count"] == 5 * 2000
+
+    def test_enabled_tracing_records_bounded_spans(self):
+        trace.enable(ring_size=256)
+        trace.clear()
+        m = RuntimeMetrics()
+        for i in range(100):
+            _shell_once(m, i)
+        spans = trace.snapshot_spans()
+        assert len(spans) == 256          # ring bound respected (4/step)
+        assert {"train.step", "executor.feed", "executor.dispatch",
+                "executor.fetch"} <= {s["name"] for s in spans}
+        trace.clear()
+        trace.disable()
