@@ -23,6 +23,11 @@ Usage: ``python -m paddle_tpu <command> ...``
   trace   dump [--addr HOST:PORT|--local]    Chrome trace-event JSON of
                                              the span ring (PADDLE_TPU_
                                              TRACE); load in Perfetto
+  replay  BUNDLE.pkl                         re-execute a sentinel-
+                                             quarantined step on CPU and
+                                             report whether the numerical
+                                             fault reproduces (exit 0 =
+                                             reproduced, 1 = clean)
   profile [--model transformer|resnet ...]   per-op device-time table of
                                              one compiled training step
   version
@@ -218,6 +223,52 @@ def _cmd_trace(args):
     return 0
 
 
+def _cmd_replay(args):
+    """Re-execute a quarantined training step from its repro bundle
+    (``fault.Sentinel`` quarantine output) under the CPU platform — the
+    offline debugging loop for a numerical fault seen on the chip.
+    Exit code 0 when the non-finite/spike reproduces, 1 when the step
+    replays clean, 2 on a malformed bundle."""
+    import json as _json
+
+    # pin the CPU platform BEFORE any backend initializes: the bundle
+    # replays on CPU regardless of what killed the TPU run — even when
+    # the launcher environment exported JAX_PLATFORMS=tpu.  The env
+    # override is restored afterwards so in-process callers don't leak
+    # it into subprocesses they spawn later.
+    prev_platform = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # backend already initialized (in-process use): keep it
+        from paddle_tpu.fault.sentinel import replay_bundle
+        try:
+            report = replay_bundle(args.bundle)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"replay: cannot load bundle {args.bundle!r}: {e}",
+                  file=sys.stderr)
+            return 2
+    finally:
+        if prev_platform is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev_platform
+    if args.json:
+        print(_json.dumps(report, indent=2, sort_keys=True))
+    elif report["reproduced"]:
+        bad = ", ".join(report["bad"][:6]) or "(loss spike)"
+        print(f"step {report['step']}: fault REPRODUCED "
+              f"({report['reason']}) in: {bad}"
+              + (" [chaos-injected]" if report["injected"] else ""))
+    else:
+        print(f"step {report['step']}: replayed CLEAN — the fault did "
+              f"not reproduce on CPU (suspect hardware/nondeterminism)")
+    return 0 if report["reproduced"] else 1
+
+
 def _cmd_launch(args):
     """Spawn an N-process jax.distributed cluster on this host (the
     cluster_train launcher analog; each process gets the reference's
@@ -387,6 +438,15 @@ def main(argv=None):
     p.add_argument("--output", default=None,
                    help="write the JSON here instead of stdout")
     p.set_defaults(fn=_cmd_trace)
+
+    p = sub.add_parser("replay", help="re-execute a sentinel-quarantined "
+                                      "step on CPU (exit 0 = fault "
+                                      "reproduced)")
+    p.add_argument("bundle", help="pickled repro bundle from the "
+                                  "sentinel's quarantine dir")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report instead of prose")
+    p.set_defaults(fn=_cmd_replay)
 
     p = sub.add_parser("profile", help="per-op device-time table of one "
                                        "compiled training step")

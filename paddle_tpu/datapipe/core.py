@@ -107,6 +107,13 @@ class Stage:
         return d
 
     def load_state_dict(self, d):
+        """Restore the whole chain's position from a ``state_dict``
+        snapshot.  Safe to call MID-EPOCH with an abandoned iterator
+        still open (the sentinel's rollback path does exactly this):
+        every stage quiesces first, so the stale iterator's eventual
+        ``close()`` hits an idempotent ``_shutdown`` and cannot drain
+        pre-rollback in-flight samples over the restored state — reopen
+        with ``iter(pipe)`` to resume from the loaded position."""
         if not isinstance(d, dict) or d.get("kind") != self.kind:
             raise PipelineStateError(
                 f"stage {self.name!r} (kind {self.kind!r}) cannot load "

@@ -346,7 +346,14 @@ class Executor:
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, use_program_cache=True):
+            return_numpy=True, use_program_cache=True, sentinel=None):
+        """``sentinel``: an optional :class:`paddle_tpu.fault.Sentinel`
+        guarding this step — its device-side finite/spike checks run
+        before the state write-back, and a trip discards the update and
+        raises :class:`~paddle_tpu.fault.NumericalFault` (buffer
+        donation is disabled for guarded programs so the pre-step scope
+        state survives the discard).  ``sentinel=None`` is the donating
+        fast path with zero added synchronization."""
         program = program if program is not None else default_main_program()
         if not isinstance(program, Program):
             raise TypeError("executor requires a Program")
@@ -360,10 +367,10 @@ class Executor:
 
         with _span("executor.run"):
             return self._run_traced(program, block, feed, fetch_names,
-                                    scope, return_numpy)
+                                    scope, return_numpy, sentinel=sentinel)
 
     def _run_traced(self, program, block, feed, fetch_names, scope,
-                    return_numpy):
+                    return_numpy, sentinel=None):
         """Body of :meth:`run`, phase-annotated: ``executor.feed``
         (host->device conversion + reader pre-pass), ``executor.dispatch``
         (compile lookup + XLA launch), ``executor.fetch`` (state
@@ -404,7 +411,8 @@ class Executor:
 
         with _span("executor.dispatch") as dsp:
             compiled = self._get_compiled(program, block, feed_arrays,
-                                          tuple(fetch_names), scope)
+                                          tuple(fetch_names), scope,
+                                          donate=sentinel is None)
 
             ro_state = {n: self._state_value(scope, n, device)
                         for n in compiled.ro_names}
@@ -423,6 +431,15 @@ class Executor:
         _profiler.runtime_metrics.observe("executor.step_seconds",
                                           time.perf_counter() - t0)
         with _span("executor.fetch"):
+            if sentinel is not None:
+                # the guard runs BEFORE write-back: a NumericalFault here
+                # leaves the scope holding the (undonated) pre-step state
+                # — the skip-step rung of the escalation ladder
+                fetches, new_state = sentinel.after_step(
+                    fetch_names, fetches, new_state,
+                    repro=lambda: self._repro_payload(
+                        program, feed_arrays, ro_state, inout_state,
+                        fetch_names))
             if _check_nan_inf_enabled(program):
                 _check_nan_inf(fetch_names, fetches, new_state)
             for n, v in new_state.items():
@@ -430,6 +447,25 @@ class Executor:
             if return_numpy:
                 return [np.asarray(v) for v in fetches]
             return list(fetches)
+
+    # ------------------------------------------------------------------
+    def _repro_payload(self, program, feed_arrays, ro_state, inout_state,
+                       fetch_names):
+        """Self-contained replay payload for a sentinel quarantine
+        bundle: the program, PRE-step state, the batch, and the RNG
+        coordinates needed to re-execute this exact step offline
+        (``paddle_tpu replay``).  Built lazily — only on a trip."""
+        state = {}
+        for src in (ro_state, inout_state):
+            for n, v in src.items():
+                state[n] = np.asarray(v)
+        return {"program": program.to_dict(),
+                "random_seed": program.random_seed,
+                "run_counter": self._run_counter,
+                "feed": {n: np.asarray(v)
+                         for n, v in feed_arrays.items()},
+                "state": state,
+                "fetch_names": list(fetch_names)}
 
     # ------------------------------------------------------------------
     def warmup(self, program=None, feed_shapes=None, fetch_list=None,
@@ -490,7 +526,7 @@ class Executor:
                         feed[name] = jnp.zeros(shape, jnp.bfloat16)
                     else:
                         feed[name] = np.zeros(shape, np.dtype(dtype))
-                self.run(program, feed=feed, fetch_list=fetch_list,
+                self.run(program=program, feed=feed, fetch_list=fetch_list,
                          scope=scope)
         compiled = self._cache_inserts - before
         _profiler.runtime_metrics.inc("warmup.signatures", len(specs))
@@ -698,7 +734,7 @@ class Executor:
     # ------------------------------------------------------------------
     def run_pipeline(self, program=None, pipeline=None, fetch_list=None,
                      scope=None, max_steps=None, return_numpy=True,
-                     on_step=None):
+                     on_step=None, sentinel=None):
         """Drive one epoch (or ``max_steps`` batches) of a
         ``datapipe`` pipeline through :meth:`run`.
 
@@ -713,12 +749,36 @@ class Executor:
         ``pipeline.state_dict()`` checkpoints mid-epoch.
 
         ``on_step(step_index, fetches)`` runs after each batch (metrics,
-        checkpointing).  Returns the list of per-batch fetch lists."""
+        checkpointing).  Returns the list of per-batch fetch lists.
+
+        ``sentinel``: a :class:`paddle_tpu.fault.Sentinel` turns this
+        loop into the automatic recovery loop — a tripped check skips
+        the poisoned update, quarantines the batch as a repro bundle,
+        and after K strikes rolls back to the sentinel's last
+        known-good checkpoint (which also rewinds the pipeline's
+        iterator position) and resumes.  Skipped steps never appear in
+        the returned fetch lists, and a rollback also drops the entries
+        it rewound (their batches re-run and re-append), so each
+        applied batch appears exactly once."""
         from paddle_tpu import profiler as _profiler
         from paddle_tpu.fault import chaos as _chaos
+        from paddle_tpu.fault.sentinel import NumericalFault
         if pipeline is None:
             raise ValueError("run_pipeline requires a datapipe pipeline")
         outs = []
+        # checkpoint step -> len(outs) when the manager committed it,
+        # keyed by the step number the checkpoint was SAVED under (which
+        # need not match this loop's 0-based index — a resumed trainer
+        # may number globally); observed via the manager's in-process
+        # last_committed_step after each on_step so the rollback branch
+        # can truncate exactly.  NOT latest_step(): that lists the
+        # directory (per-step I/O), and a restarted trainer renumbering
+        # from 0 under a directory still holding a prior run's higher
+        # ckpt-N would never see its own commits through it
+        marks = {}
+        mgr = sentinel.manager if sentinel is not None else None
+        last_ckpt = getattr(mgr, "last_committed_step", None) \
+            if mgr is not None else None
         it = iter(pipeline)
         try:
             step = 0
@@ -735,15 +795,69 @@ class Executor:
                 _record_span("datapipe.next", t0,
                              time.perf_counter() - t0, step=step)
                 _chaos.fire("train.step", step=step)
-                with _span("train.step", step=step):
-                    with _profiler.record_latency("datapipe.step_seconds"):
-                        fetches = self.run(program, feed=batch,
-                                           fetch_list=fetch_list,
-                                           scope=scope,
-                                           return_numpy=return_numpy)
-                    if on_step is not None:
-                        on_step(step, fetches)
+                try:
+                    with _span("train.step", step=step):
+                        with _profiler.record_latency(
+                                "datapipe.step_seconds"):
+                            # program by KEYWORD: ParallelExecutor.run's
+                            # first positional is fetch_list, not program
+                            fetches = self.run(program=program, feed=batch,
+                                               fetch_list=fetch_list,
+                                               scope=scope,
+                                               return_numpy=return_numpy,
+                                               sentinel=sentinel)
+                        if on_step is not None:
+                            on_step(step, fetches)
+                except NumericalFault as fault:
+                    if sentinel is None:
+                        raise
+                    restored = sentinel.handle_fault(fault, step=step)
+                    if restored is not None:
+                        mgr = sentinel.manager
+                        if getattr(mgr, "last_restore_rewound", False) \
+                                and hasattr(pipeline, "load_state_dict"):
+                            # the rollback rewound the pipeline's
+                            # position; the open iterator still points
+                            # at the pre-rollback stream — reopen from
+                            # the restored state
+                            close = getattr(it, "close", None)
+                            if close is not None:
+                                close()
+                            it = iter(pipeline)
+                            # drop the entries the rollback undid:
+                            # their batches re-run from the rewound
+                            # stream, keeping the returned list
+                            # exactly-once.  The mark maps the restored
+                            # checkpoint number back to this loop's own
+                            # outs length; a checkpoint this loop never
+                            # committed (restart resuming a prior run's
+                            # ckpt) rewinds past everything we returned
+                            del outs[marks.get(restored, 0):]
+                        else:
+                            # params-only rollback: no datapipe on the
+                            # manager, or the restored checkpoint
+                            # carried no iterator state — the stream
+                            # cannot be rewound.  Keep consuming the
+                            # current iterator (reopening would restart
+                            # the epoch) and say what was lost
+                            logger.warning(
+                                "sentinel rollback restored step %s "
+                                "params-only (no datapipe state to "
+                                "rewind): batches since that step "
+                                "cannot be replayed — attach datapipe= "
+                                "to CheckpointManager for exact-once "
+                                "semantics", restored)
+                    step += 1
+                    continue
                 outs.append(fetches)
+                if mgr is not None and on_step is not None:
+                    # did on_step commit a checkpoint this step?  Its
+                    # saved position is AFTER this batch, so the mark
+                    # includes the entry just appended
+                    ckpt = getattr(mgr, "last_committed_step", None)
+                    if ckpt is not None and ckpt != last_ckpt:
+                        marks[ckpt] = len(outs)
+                        last_ckpt = ckpt
                 step += 1
         finally:
             close = getattr(it, "close", None)  # plain iterables lack it
@@ -934,10 +1048,14 @@ class Executor:
                 "uses_rng": uses_rng}
 
     # ------------------------------------------------------------------
-    def _get_compiled(self, program, block, feed_arrays, fetch_names, scope):
+    def _get_compiled(self, program, block, feed_arrays, fetch_names, scope,
+                      donate=True):
         from paddle_tpu import profiler as _profiler
+        # donation is part of the executable's identity: a sentinel-
+        # guarded step (donate=False) must be able to discard its update,
+        # so the pre-step state buffers have to stay valid
         sig = self._signature(program, block, feed_arrays, fetch_names,
-                              scope)
+                              scope) + (("donate", donate),)
         if sig in self._cache:
             self._cache[sig] = self._cache.pop(sig)  # LRU bump
             _profiler.runtime_metrics.inc("jit_cache.hits")
@@ -953,10 +1071,12 @@ class Executor:
             # analogous path is its per-op CPU-kernel interpreter
             fn = parts["step"]
         else:
-            fn = jax.jit(parts["step"], donate_argnums=(2,))
+            fn = jax.jit(parts["step"],
+                         donate_argnums=(2,) if donate else ())
         compiled = _CompiledBlock(fn, parts["feed_names"],
                                   parts["ro_names"], parts["inout_names"],
                                   tuple(fetch_names), parts["uses_rng"])
+        compiled.donated = donate and not parts["interpret"]
         self._cache_insert(sig, compiled)
         return compiled
 

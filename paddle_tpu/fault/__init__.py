@@ -20,6 +20,12 @@ survives failure:
 - :mod:`paddle_tpu.fault.lifecycle` — :class:`GracefulShutdown`:
   SIGTERM/SIGINT-aware stop flag so a preempted trainer finishes the
   current step, commits a checkpoint, and exits cleanly.
+- :mod:`paddle_tpu.fault.sentinel` — :class:`Sentinel`: numerical-fault
+  detection (fused device-side finite checks + EMA loss-spike detector)
+  with an escalation ladder — skip-step, quarantine (pickled repro
+  bundles replayable via ``paddle_tpu replay``), and automatic rollback
+  to the last known-good checkpoint
+  (``CheckpointManager.mark_good()/restore_last_good()``).
 """
 
 from __future__ import annotations
@@ -30,6 +36,8 @@ from paddle_tpu.fault.checkpoint import (CheckpointManager, CorruptCheckpoint,
                                          manager_from_env, verify_checkpoint)
 from paddle_tpu.fault.lifecycle import GracefulShutdown, graceful_shutdown
 from paddle_tpu.fault.retry import RetryError, RetryPolicy, retrying
+from paddle_tpu.fault.sentinel import (NumericalFault, Sentinel,
+                                       replay_bundle, sentinel_from_env)
 
 __all__ = [
     "chaos", "FaultInjected", "fire", "inject",
@@ -37,6 +45,7 @@ __all__ = [
     "verify_checkpoint",
     "GracefulShutdown", "graceful_shutdown",
     "RetryError", "RetryPolicy", "retrying",
+    "NumericalFault", "Sentinel", "replay_bundle", "sentinel_from_env",
 ]
 
 # parse PADDLE_TPU_CHAOS eagerly so a malformed spec fails fast at

@@ -7,23 +7,11 @@ freebsd/etcd ``failpoint`` idiom: a disarmed failpoint is one dict
 lookup on an (almost always) empty dict, so instrumentation can stay in
 hot-ish paths like the reader pump and the RPC client.
 
-Failpoint names currently wired through the codebase:
-
-========================  ====================================================
-``master.rpc``            :meth:`MasterClient._call`, before every request
-``ckpt.save``             ``io.save_checkpoint``, before the orbax write
-``ckpt.commit``           checkpoint commit, after the temp write and
-                          BEFORE the atomic rename (a kill here must
-                          leave the previous checkpoint restorable)
-``reader.pump``           ``reader.decorator.buffered`` producer, per sample
-``reader.worker``         ``reader.decorator.xmap_readers`` worker, per sample
-``datapipe.source``       ``datapipe.Source``, per emitted sample (breaks
-                          the input stream where a flaky FS/decoder would)
-``serving.run``           ``InferenceServer`` request handler, per request
-``train.step``            fired by training loops that opt in (the
-                          kill-and-resume drill's trainer does, and
-                          ``Executor.run_pipeline`` fires it per batch)
-========================  ====================================================
+The authoritative list of failpoint names wired through the codebase is
+the registry table in ``docs/fault_tolerance.md`` — it is
+scanner-enforced (``tests/test_chaos_failpoint_registry.py`` fails when
+a ``chaos.fire(...)`` site is missing from it), so unlike a docstring
+copy it cannot drift.
 
 Env grammar (``;`` or ``,`` separated)::
 
@@ -43,7 +31,8 @@ import threading
 import time
 
 __all__ = ["FaultInjected", "inject", "fire", "clear", "armed",
-           "failpoints", "scoped", "arm_from_env", "KILL_EXIT_CODE"]
+           "failpoints", "scoped", "swap", "arm_from_env",
+           "KILL_EXIT_CODE"]
 
 KILL_EXIT_CODE = 137
 
@@ -104,6 +93,19 @@ def clear(name=None):
 
 def armed(name):
     return name in _registry
+
+
+def swap(name, fp):
+    """Install failpoint object ``fp`` under ``name`` (remove it when
+    ``fp`` is None) and return the previously armed object, if any —
+    the save/restore idiom for code that must re-arm a failpoint
+    briefly without clobbering an operator's live spec (``scoped``
+    only disarms on exit; it cannot restore a prior arm)."""
+    with _lock:
+        prev = _registry.pop(name, None)
+        if fp is not None:
+            _registry[name] = fp
+        return prev
 
 
 def failpoints():

@@ -28,6 +28,7 @@ __all__ = ["CheckpointManager", "CorruptCheckpoint", "MANIFEST_NAME",
 
 MANIFEST_NAME = "MANIFEST.json"
 DATAPIPE_STATE_NAME = "datapipe_state.pkl"
+GOOD_POINTER_NAME = "last_good"
 
 
 def _datapipe_state_name():
@@ -188,6 +189,16 @@ class CheckpointManager:
         self.main_program = main_program
         self.scope = scope
         self.datapipe = datapipe
+        self._committed = set()       # every step saved by this process
+        self._verified = set()        # steps read-verified this process
+        self._verify_failed = set()   # ...and ones that failed, so a
+        # corrupt newer checkpoint is not re-hashed on EVERY save's GC
+        # pin scan for as long as it stays in the directory
+        self.last_committed_step = None   # most recent save() by THIS
+        # process — unlike latest_step() it ignores other runs' leftovers
+        # in the directory, so a restarted trainer renumbering from 0
+        # still observes its own commits (and reading it costs no I/O)
+        self.last_restore_rewound = False   # last restore moved the pipe
         os.makedirs(self.dirname, exist_ok=True)
 
     # -- introspection -----------------------------------------------------
@@ -219,6 +230,19 @@ class CheckpointManager:
         """Commit the current training state as ``ckpt-<step>`` (plus the
         datapipe iterator position, when a pipeline is attached)."""
         from paddle_tpu import io
+        import jax
+        if jax.process_index() == 0 and \
+                self.last_good_step() == int(step):
+            # re-saving the anchor's step displaces the PROMOTED state
+            # with one that has not yet earned its clean checks (the
+            # restart-renumbering pattern) — drop the pointer before
+            # the overwrite; the sentinel re-promotes after N clean
+            # checks.  Before, not after: a crash mid-commit must not
+            # leave the pointer naming an unpromoted checkpoint.
+            try:
+                os.remove(self._good_pointer())
+            except OSError:
+                pass
         with _span("ckpt.save", step=step):
             extras = None
             if self.datapipe is not None:
@@ -228,11 +252,15 @@ class CheckpointManager:
                                       main_program=self.main_program,
                                       step=step, scope=self.scope,
                                       extras=extras)
+            self._committed.add(int(step))
+            self._verified.discard(int(step))   # content just changed
+            self._verify_failed.discard(int(step))
+            self.last_committed_step = int(step)
             with _span("ckpt.gc", step=step):
-                self._gc()
+                self._gc(fresh=int(step))
         return path
 
-    def _gc(self):
+    def _gc(self, fresh=None):
         # GC mirrors the commit protocol: only the coordinator host
         # mutates the shared directory (non-coordinators would otherwise
         # sweep .tmp-ckpt-<step> out from under process 0's in-flight
@@ -241,8 +269,31 @@ class CheckpointManager:
         if jax.process_index() != 0:
             return
         steps = self.steps()
-        for step in steps[:-self.keep] if self.keep else []:
-            shutil.rmtree(self.path(step), ignore_errors=True)
+        victims = steps[:-self.keep] if self.keep else []
+        if victims:
+            # rotation must never leave only corrupt checkpoints behind:
+            # pin the newest step that actually verifies (plus the
+            # known-good pointer target), regardless of keep-N.  `fresh`
+            # names the step this very save just committed — trusted
+            # without a re-hash; everything else re-verifies, so
+            # externally-torn newer checkpoints cannot shadow the one
+            # restorable copy out of existence.
+            protect = set()
+            if fresh is not None:
+                # the step this save just committed: under restart
+                # renumbering it can sort BELOW older checkpoints and
+                # land in the victim window while "latest" names it
+                protect.add(fresh)
+            good = self.last_good_step()
+            if good is not None:
+                protect.add(good)
+            pinned = self._newest_verified(steps, fresh=fresh)
+            if pinned is not None:
+                protect.add(pinned)
+            for step in victims:
+                if step in protect:
+                    continue
+                shutil.rmtree(self.path(step), ignore_errors=True)
         # stale temp dirs from crashed saves are torn garbage by
         # definition — sweep them too.  (A checkpoint dir has ONE
         # writer: the trainer committing steps.  Concurrent savers into
@@ -253,6 +304,115 @@ class CheckpointManager:
             if name.startswith(_TMP_PREFIX):
                 shutil.rmtree(os.path.join(self.dirname, name),
                               ignore_errors=True)
+
+    def _newest_verified(self, steps, fresh=None):
+        """Newest committed step that passes manifest verification
+        (``fresh`` — the step committed microseconds ago by this very
+        save — is trusted without a re-hash).  Returns None when nothing
+        verifies.  Cost: verifies newest-first until one passes, so a
+        healthy directory pays at most one full verify per GC."""
+        for step in reversed(steps):
+            if fresh is not None and step == fresh:
+                return step
+            if step in self._verified:
+                # read-verified earlier by this process: don't re-hash
+                # the same foreign newest on EVERY save (restart
+                # renumbering keeps it newest for a long time).
+                # NOTE: _committed is deliberately NOT trusted here —
+                # the pin exists to catch post-commit external
+                # corruption of exactly those steps.
+                return step
+            if step in self._verify_failed:
+                continue
+            try:
+                verify_checkpoint(self.path(step))
+            except CorruptCheckpoint:
+                # remember the failure: a torn multi-GB checkpoint must
+                # not add a full re-hash to every subsequent save until
+                # it rotates out (save() clears this if rewritten)
+                self._verify_failed.add(step)
+                continue
+            self._verified.add(step)
+            return step
+        return None
+
+    # -- known-good promotion (the sentinel's rollback anchor) -------------
+    def _good_pointer(self):
+        return os.path.join(self.dirname, GOOD_POINTER_NAME)
+
+    def last_good_step(self):
+        """Step named by the ``last_good`` pointer, or None when the
+        pointer is absent/unreadable or its checkpoint dir is gone."""
+        try:
+            with open(self._good_pointer()) as f:
+                step = int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+        if not os.path.isdir(self.path(step)):
+            return None
+        return step
+
+    def mark_good(self, step=None, verify=True):
+        """Promote ``ckpt-<step>`` (default: newest committed) to
+        *known-good* — the sentinel's rollback target.  The pointer
+        write is atomic (tmp + rename) and ``_gc`` never collects the
+        step it names.  ``verify=True`` re-checks the manifest first so
+        a torn checkpoint can never become the rollback anchor; raises
+        :class:`CorruptCheckpoint` on failure.  Returns the step, or
+        None when there is nothing committed."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        step = int(step)
+        if not os.path.isdir(self.path(step)):
+            # keep-N rotation got there before the promotion did (the
+            # clean-check lag): nothing to anchor — the caller must NOT
+            # treat this as forward progress
+            return None
+        if verify and step not in self._committed:
+            # steps this process committed were hashed at write time;
+            # anything else (resume after restart) re-verifies — a torn
+            # checkpoint must never become the rollback anchor.  (The
+            # rollback itself re-verifies in restore_last_good either
+            # way.)
+            verify_checkpoint(self.path(step))
+        import jax
+        if jax.process_index() == 0:
+            from paddle_tpu.io import atomic_write
+            atomic_write(self._good_pointer(), str(step))
+        from paddle_tpu import profiler as _profiler
+        _profiler.runtime_metrics.inc("ckpt.marked_good")
+        return step
+
+    def restore_last_good(self, shardings=None):
+        """Restore the last known-good checkpoint (params + datapipe
+        position) — the rollback rung of the sentinel's escalation
+        ladder.  A corrupt/vanished known-good is quarantined and the
+        restore falls back to :meth:`restore_latest` (newest verifiable
+        wins).  Returns the restored step or None."""
+        from paddle_tpu import io
+        step = self.last_good_step()
+        if step is not None:
+            path = self.path(step)
+            try:
+                verify_checkpoint(path)
+            except CorruptCheckpoint:
+                self._quarantine(path)
+                step = None
+        if step is None:
+            try:
+                os.remove(self._good_pointer())
+            except OSError:
+                pass
+            return self.restore_latest(shardings=shardings)
+        got = io.load_checkpoint(self.executor, self.dirname,
+                                 main_program=self.main_program,
+                                 step=step, scope=self.scope,
+                                 shardings=shardings)
+        io._write_latest(self.dirname, step)
+        self._restore_datapipe(step)
+        return got
 
     # -- restore -----------------------------------------------------------
     def verify(self, step):
@@ -271,7 +431,11 @@ class CheckpointManager:
     def _restore_datapipe(self, step):
         """Load the iterator position saved next to ``ckpt-<step>`` into
         the attached pipeline (no-op without one; a checkpoint written
-        before a pipeline existed leaves the pipeline untouched)."""
+        before a pipeline existed leaves the pipeline untouched).
+        ``last_restore_rewound`` records the outcome so a caller acting
+        on a restore (the sentinel rollback) can tell a rewound stream
+        from a params-only restore."""
+        self.last_restore_rewound = False
         if self.datapipe is None:
             return False
         p = os.path.join(self.path(step), _datapipe_state_name())
@@ -282,6 +446,7 @@ class CheckpointManager:
                 return False
         with open(p, "rb") as f:
             self.datapipe.load_state_dict(pickle.load(f))
+        self.last_restore_rewound = True
         return True
 
     def restore_latest(self, shardings=None):

@@ -38,9 +38,17 @@ class RetryError(RuntimeError):
 
 
 class RetryPolicy:
-    """``delay(n) = min(max_delay, base_delay * multiplier**n)`` scaled
-    by ``1 ± jitter``; give up after ``max_attempts`` tries or when the
-    next sleep would cross ``deadline`` seconds from the first attempt.
+    """``cap(n) = min(max_delay, base_delay * multiplier**n)``, jittered;
+    give up after ``max_attempts`` tries or when the remaining
+    ``deadline`` budget is smaller than the next backoff (the policy
+    raises :class:`RetryError` immediately rather than sleeping through
+    — or past — the budget).
+
+    ``jitter`` is either a float ``j`` (equal-style: the cap scaled by
+    ``1 ± j``) or the string ``"full"`` (AWS full jitter:
+    ``uniform(0, cap)`` — the decorrelated choice for thundering-herd
+    retry storms, where every client re-dialing a restarted master at
+    the same instant is exactly the failure mode).
     """
 
     def __init__(self, max_attempts=5, base_delay=0.05, max_delay=2.0,
@@ -48,6 +56,17 @@ class RetryPolicy:
                  retryable=(ConnectionError, TimeoutError, OSError)):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
+        if jitter != "full":
+            try:
+                # coerce on store: a numeric string must not survive
+                # construction only to blow up inside backoff() mid-retry
+                jitter = None if jitter is None else float(jitter)
+                valid = jitter is None or 0 <= jitter <= 1
+            except (TypeError, ValueError):
+                valid = False
+            if not valid:
+                raise ValueError(
+                    'jitter must be "full", None, or a float in [0, 1]')
         self.max_attempts = max_attempts
         self.base_delay = base_delay
         self.max_delay = max_delay
@@ -60,17 +79,25 @@ class RetryPolicy:
         """Sleep before retry number ``attempt`` (1-based)."""
         delay = min(self.max_delay,
                     self.base_delay * self.multiplier ** (attempt - 1))
+        if self.jitter == "full":
+            return random.uniform(0.0, delay)
         if self.jitter:
             delay *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
         return max(0.0, delay)
 
-    def call(self, fn, *args, on_retry=None, **kwargs):
+    def call(self, fn, *args, on_retry=None, deadline=None, **kwargs):
         """Run ``fn(*args, **kwargs)``, retrying on ``self.retryable``.
 
         ``on_retry(attempt, exc, delay)`` is invoked before each sleep
-        (logging / reconnect hooks).  Non-retryable exceptions propagate
-        immediately; exhausted attempts raise :class:`RetryError`.
+        (logging / reconnect hooks).  ``deadline`` overrides the
+        policy's budget for this one call (seconds from the first
+        attempt) — when the remaining budget is smaller than the next
+        backoff, :class:`RetryError` is raised immediately instead of
+        sleeping.  Non-retryable exceptions propagate immediately;
+        exhausted attempts raise :class:`RetryError`.  (``deadline`` is
+        consumed by the policy, never forwarded to ``fn``.)
         """
+        deadline = self.deadline if deadline is None else deadline
         start = time.monotonic()
         attempt = 0
         while True:
@@ -82,11 +109,14 @@ class RetryPolicy:
                     raise RetryError(
                         f"gave up after {attempt} attempts: {e}", e) from e
                 delay = self.backoff(attempt)
-                if self.deadline is not None and \
-                        time.monotonic() - start + delay > self.deadline:
-                    raise RetryError(
-                        f"deadline {self.deadline}s exceeded after "
-                        f"{attempt} attempts: {e}", e) from e
+                if deadline is not None:
+                    remaining = deadline - (time.monotonic() - start)
+                    if delay > remaining:
+                        raise RetryError(
+                            f"deadline {deadline}s exceeded after "
+                            f"{attempt} attempts ({remaining:.3f}s "
+                            f"remaining < next backoff {delay:.3f}s): "
+                            f"{e}", e) from e
                 if on_retry is not None:
                     on_retry(attempt, e, delay)
                 time.sleep(delay)

@@ -1,0 +1,648 @@
+"""Training sentinel: numerical-fault detection, batch quarantine,
+automatic rollback, and deterministic replay.
+
+The elastic runtime (checkpoint.py, retry.py, lifecycle.py) survives
+*process* deaths; at scale the more common killer is *state* corruption
+— one NaN/Inf loss or gradient silently poisons the parameters and
+every step after it is wasted (the MegaScale-class failure mode).  The
+:class:`Sentinel` is the layer that composes the existing machinery
+into an automatic recovery loop:
+
+1. **detect** — a cheap device-side finite check (fused ``jnp.isfinite``
+   all-reduced to ONE scalar over loss/params/updated state, so a check
+   step pays exactly one host sync) plus an EMA-based loss-spike
+   detector, at a configurable cadence (``PADDLE_TPU_SENTINEL``);
+2. **skip-step** — on a trip, ``Executor.run`` discards the update
+   (the scope keeps the pre-step state; buffer donation is disabled
+   while a sentinel guards the program, which is what makes the discard
+   possible) and raises :class:`NumericalFault`;
+3. **quarantine** — the faulty step is dumped as a pickled repro bundle
+   (program, pre-step state, batch, RNG coordinates, trace id) for
+   offline forensics: ``paddle_tpu replay <bundle>`` re-executes it
+   under ``JAX_PLATFORMS=cpu`` and reports whether the non-finite
+   reproduces;
+4. **rollback** — after K consecutive strikes,
+   ``CheckpointManager.restore_last_good()`` rewinds params AND the
+   datapipe iterator to the last *verified-good* checkpoint (marked by
+   :meth:`Sentinel.note_checkpoint` after N clean checks; the GC never
+   collects it) and training resumes, emitting a flight-recorder
+   post-mortem.
+
+The ``sentinel.nan`` chaos failpoint injects NaNs into the loss and the
+updated state exactly where a real numerical fault would appear, so the
+full ladder can be drilled end to end (``tests/test_sentinel.py``).
+
+With no sentinel attached, ``Executor.run`` is byte-for-byte the
+donating fast path — no extra device sync, no host transfer (the
+structural guarantee ``tests/test_sentinel.py`` locks).
+
+Caveat: in interpret (host-op) mode persistables write through the
+scope *during* the step, so skip-step cannot fully discard a poisoned
+update there — detection still works and rollback is the recovery.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import time
+
+from paddle_tpu.fault import chaos
+from paddle_tpu.obs.trace import span as _span, current_trace_id
+
+__all__ = ["Sentinel", "NumericalFault", "sentinel_from_env",
+           "replay_bundle", "BUNDLE_FORMAT"]
+
+logger = logging.getLogger(__name__)
+
+BUNDLE_FORMAT = 1
+
+
+class NumericalFault(RuntimeError):
+    """A sentinel check tripped: non-finite values or a loss spike.
+
+    Raised by ``Executor.run`` BEFORE the poisoned update reaches the
+    scope (the step is skipped).  ``reason`` is ``"non_finite"`` or
+    ``"loss_spike"``; ``bad`` names the offending tensors; ``repro`` is
+    the self-contained replay payload (see :func:`replay_bundle`);
+    ``injected`` marks faults manufactured by the ``sentinel.nan``
+    failpoint.
+    """
+
+    def __init__(self, message, step=None, reason=None, bad=None,
+                 repro=None, injected=False):
+        super().__init__(message)
+        self.step = step
+        self.reason = reason
+        self.bad = list(bad or [])
+        self.repro = repro
+        self.injected = injected
+
+
+def _metrics():
+    from paddle_tpu.profiler import runtime_metrics
+    return runtime_metrics
+
+
+class _NullMetrics:
+    """Sink for sentinels that must not touch the process-global
+    counters — the replay guard, which would otherwise inflate the
+    production ``sentinel.*`` fault metrics of a process that also
+    trains or serves ``/stats``."""
+
+    def inc(self, name, n=1):
+        pass
+
+    def observe(self, name, value):
+        pass
+
+
+_NULL_METRICS = _NullMetrics()
+
+
+class Sentinel:
+    """Numerical-fault guard for ``Executor.run`` / ``run_pipeline``.
+
+    ::
+
+        sentinel = Sentinel(manager=mgr, cadence=1, strikes=3)
+        exe.run_pipeline(main, pipe, fetch_list=[loss],
+                         sentinel=sentinel,
+                         on_step=lambda s, _: (mgr.save(s),
+                                               sentinel.note_checkpoint(s)))
+
+    Parameters
+    ----------
+    manager : CheckpointManager, optional
+        Rollback target provider.  Without one, the ladder ends at
+        quarantine and the K-th strike re-raises the fault.
+    cadence : int
+        Check every ``cadence``-th step (1 = every step).  Each check
+        costs one device sync; off-cadence steps pay nothing.
+    strikes : int
+        Consecutive faulty checks before rollback.  A clean check
+        resets the count.
+    spike_factor : float or None
+        Trip when ``|loss - ema| > spike_factor * (|ema| + 1e-9)`` after
+        ``spike_warmup`` observations.  None disables the detector.
+    ema_beta : float
+        EMA smoothing for the loss baseline.
+    loss_name : str, optional
+        Fetch name of the loss; default: the first scalar float fetch.
+    quarantine_dir : str, optional
+        Where repro bundles land (default:
+        ``<manager.dirname>/quarantine`` or ``./sentinel_quarantine``).
+    mark_good_after : int
+        Clean checks after ``note_checkpoint(step)`` before the
+        checkpoint is promoted to known-good.
+    max_rollbacks : int
+        Rollbacks allowed without forward progress (a successful
+        mark-good resets the budget); exceeding it re-raises the fault
+        instead of looping on a poisoned known-good.
+    """
+
+    def __init__(self, manager=None, cadence=1, strikes=3,
+                 spike_factor=10.0, ema_beta=0.9, spike_warmup=5,
+                 loss_name=None, quarantine_dir=None, mark_good_after=1,
+                 max_rollbacks=3):
+        self.manager = manager
+        self.cadence = max(1, int(cadence))
+        self.strikes = max(1, int(strikes))
+        self.spike_factor = None if spike_factor is None \
+            else float(spike_factor)
+        self.ema_beta = float(ema_beta)
+        self.spike_warmup = max(1, int(spike_warmup))
+        self.loss_name = loss_name
+        self.mark_good_after = max(0, int(mark_good_after))
+        self.max_rollbacks = max(0, int(max_rollbacks))
+        if quarantine_dir is None and manager is not None:
+            quarantine_dir = os.path.join(manager.dirname, "quarantine")
+        self.quarantine_dir = quarantine_dir or "sentinel_quarantine"
+        self._tick = 0            # steps seen
+        self._strikes = 0         # consecutive faulty checks
+        self._rollbacks = 0       # rollbacks since last forward progress
+        self._ema = None
+        self._ema_n = 0
+        self._pending_good = []   # [step, clean checks still needed]
+        self._bundle_seq = 0
+        self._check_fn = None     # lazily-jitted fused finite check
+        self._metrics_enabled = True   # replay guards flip this off
+        self._warned_loss_name = False
+
+    def _m(self):
+        return _metrics() if self._metrics_enabled else _NULL_METRICS
+
+    # -- detection (called by Executor.run on guarded steps) ------------
+
+    def after_step(self, fetch_names, fetches, new_state, repro=None):
+        """Inspect one step's results BEFORE scope write-back.
+
+        Applies the ``sentinel.nan`` poison when that failpoint fires,
+        then — on cadence steps — runs the fused device-side finite
+        check and the EMA spike detector.  Returns the (possibly
+        poisoned) ``(fetches, new_state)`` for write-back; raises
+        :class:`NumericalFault` on a trip, in which case the executor
+        discards the update."""
+        self._tick += 1
+        if self._tick % self.cadence:
+            return fetches, new_state
+        injected = False
+        # the failpoint fires only on CHECKED steps: an off-cadence
+        # poison would be committed unseen and the next check would
+        # quarantine an innocent batch — injection means "poison the
+        # next step the sentinel actually inspects"
+        if chaos.armed("sentinel.nan"):
+            try:
+                chaos.fire("sentinel.nan", step=self._tick)
+            except chaos.FaultInjected:
+                injected = True
+                fetches, new_state = self._poison(fetch_names, fetches,
+                                                  new_state)
+        t0 = time.perf_counter()
+        try:
+            with _span("sentinel.check", step=self._tick):
+                self._inspect(fetch_names, fetches, new_state, repro,
+                              injected)
+        finally:
+            # a tripped check raises out of _inspect — exactly the
+            # expensive case (it pays the host-side culprit sweep), so
+            # the latency series the docs tune cadence against must
+            # still record it
+            self._m().observe("sentinel.check_seconds",
+                              time.perf_counter() - t0)
+        return fetches, new_state
+
+    def _inspect(self, fetch_names, fetches, new_state, repro, injected):
+        m = self._m()
+        m.inc("sentinel.checks")
+        named = list(zip(fetch_names, fetches))
+        named += list(new_state.items())
+        finite = self._device_all_finite([v for _, v in named])
+        if not finite:
+            bad = [n for n, v in named if not _host_finite(v)]
+            m.inc("sentinel.non_finite")
+            self._trip("non_finite", bad, repro, injected,
+                       f"non-finite values in {bad[:4]} at guarded "
+                       f"step {self._tick}")
+        loss = self._loss_value(fetch_names, fetches)
+        if loss is not None and self.spike_factor is not None:
+            if self._ema_n >= self.spike_warmup and \
+                    abs(loss - self._ema) > \
+                    self.spike_factor * (abs(self._ema) + 1e-9):
+                m.inc("sentinel.loss_spikes")
+                self._trip("loss_spike", [], repro, injected,
+                           f"loss {loss:g} spiked against EMA "
+                           f"{self._ema:g} at guarded step {self._tick}")
+            beta = self.ema_beta
+            self._ema = loss if self._ema is None \
+                else beta * self._ema + (1.0 - beta) * loss
+            self._ema_n += 1
+        # clean check: strikes reset, pending checkpoints age toward good
+        self._strikes = 0
+        self._advance_good()
+
+    def _trip(self, reason, bad, repro, injected, message):
+        self._m().inc("sentinel.skipped_steps")
+        payload = None
+        if repro is not None:
+            try:
+                payload = repro() if callable(repro) else repro
+            except Exception:
+                logger.warning("sentinel: repro payload capture failed",
+                               exc_info=True)
+        raise NumericalFault(message, step=self._tick, reason=reason,
+                             bad=bad, repro=payload, injected=injected)
+
+    def _device_all_finite(self, values):
+        """Fused ``jnp.isfinite(...).all()`` over every floating tensor,
+        all-reduced to ONE device scalar — the single host sync a check
+        step pays.  Culprit naming (rare) happens host-side after."""
+        import jax
+        import jax.numpy as jnp
+        leaves = [jnp.asarray(v) for v in values
+                  if hasattr(v, "dtype") or _is_arraylike(v)]
+        leaves = [l for l in leaves
+                  if jnp.issubdtype(l.dtype, jnp.floating)]
+        if not leaves:
+            return True
+        if self._check_fn is None:
+            def _all_finite(arrs):
+                return jnp.all(jnp.stack(
+                    [jnp.isfinite(a).all() for a in arrs]))
+            self._check_fn = jax.jit(_all_finite)
+        return bool(self._check_fn(leaves))
+
+    def _loss_value(self, fetch_names, fetches):
+        idx = self._loss_index(fetch_names, fetches)
+        if idx is None:
+            return None
+        import numpy as np
+        try:
+            return float(np.asarray(fetches[idx],
+                                    dtype="float32").reshape(-1)[0])
+        except (TypeError, ValueError, IndexError):
+            return None
+
+    def _loss_index(self, fetch_names, fetches):
+        if self.loss_name is not None:
+            try:
+                return list(fetch_names).index(self.loss_name)
+            except ValueError:
+                if not self._warned_loss_name:
+                    # a typo'd loss= must not SILENTLY disable the spike
+                    # detector the operator believes is active
+                    self._warned_loss_name = True
+                    logger.warning(
+                        "sentinel: configured loss_name %r is not among "
+                        "the fetches %s — the loss-spike detector is "
+                        "inactive until it matches",
+                        self.loss_name, list(fetch_names))
+                return None
+        import numpy as np
+        for i, v in enumerate(fetches):
+            if not _is_arraylike(v):
+                continue
+            a = np.asarray(v) if not hasattr(v, "dtype") else v
+            try:
+                floating = np.issubdtype(np.dtype(str(a.dtype)),
+                                         np.floating)
+            except TypeError:
+                floating = "float" in str(a.dtype)
+            if floating and _size_of(a) == 1:
+                return i
+        return None
+
+    def _poison(self, fetch_names, fetches, new_state):
+        """``sentinel.nan`` failpoint action: NaN out the loss fetch and
+        every floating tensor of the updated state — the shape of a real
+        numerical blow-up (bad loss + poisoned params)."""
+        import jax.numpy as jnp
+        fetches = list(fetches)
+        idx = self._loss_index(fetch_names, fetches)
+        if idx is not None:
+            fetches[idx] = jnp.full_like(jnp.asarray(fetches[idx]),
+                                         jnp.nan)
+        poisoned = {}
+        for n, v in new_state.items():
+            a = jnp.asarray(v)
+            if jnp.issubdtype(a.dtype, jnp.floating):
+                poisoned[n] = a * jnp.nan
+            else:
+                poisoned[n] = v
+        return fetches, poisoned
+
+    # -- escalation ladder (called by Executor.run_pipeline) -------------
+
+    def handle_fault(self, fault, step=None):
+        """Quarantine the faulty step and count the strike; after K
+        consecutive strikes roll back to the last known-good checkpoint.
+        Returns the restored step on rollback, else None (the caller
+        skips the batch and continues).  Re-raises when unrecoverable
+        (no manager, nothing restorable, rollback budget exhausted)."""
+        self._strikes += 1
+        # a fault invalidates the clean-streak countdown of every save
+        # not yet promoted — a poisoned step may already be inside them
+        self._pending_good.clear()
+        try:
+            self.quarantine(fault, step=step)
+        except Exception:
+            logger.warning("sentinel: quarantine dump failed",
+                           exc_info=True)
+        if self._strikes >= self.strikes:
+            return self.rollback(fault)
+        return None
+
+    def quarantine(self, fault, step=None):
+        """Dump the fault as a pickled bundle under ``quarantine_dir``
+        (atomic tmp+rename); returns the path.  A fault whose repro
+        capture failed still records the event (step, reason, culprits,
+        trace id) — such a bundle cannot replay (``paddle_tpu replay``
+        exits 2 on it) but keeps the forensic trail."""
+        os.makedirs(self.quarantine_dir, exist_ok=True)
+        self._bundle_seq += 1
+        name = (f"quarantine-step{step if step is not None else fault.step}"
+                f"-{os.getpid()}-{self._bundle_seq}.pkl")
+        path = os.path.join(self.quarantine_dir, name)
+        bundle = {
+            "format": BUNDLE_FORMAT,
+            "step": step if step is not None else fault.step,
+            "reason": fault.reason,
+            "bad": fault.bad,
+            "injected": bool(fault.injected),
+            "trace_id": current_trace_id(),
+            "time_unix": time.time(),
+            # detector state at the trip: replaying a loss-spike bundle
+            # needs the EMA baseline the loss spiked AGAINST
+            "detector": {"ema": self._ema, "ema_n": self._ema_n,
+                         "spike_factor": self.spike_factor,
+                         "ema_beta": self.ema_beta,
+                         "loss_name": self.loss_name},
+            "repro": fault.repro,
+        }
+        with _span("sentinel.quarantine", step=bundle["step"]):
+            from paddle_tpu.io import atomic_write
+            atomic_write(path, pickle.dumps(bundle, protocol=4))
+        self._m().inc("sentinel.quarantined")
+        logger.warning("sentinel: quarantined step %s (%s) -> %s",
+                       bundle["step"], fault.reason, path)
+        return path
+
+    def rollback(self, fault=None):
+        """Restore the last known-good checkpoint (params + datapipe
+        position) through the attached manager and reset the detector
+        state.  Emits a flight-recorder post-mortem (no-op unless
+        ``PADDLE_TPU_POSTMORTEM`` is armed).  Returns the restored
+        step."""
+        err = fault or NumericalFault("sentinel rollback requested",
+                                      reason="manual")
+        if self.manager is None:
+            raise err
+        if self._rollbacks >= self.max_rollbacks:
+            logger.error("sentinel: rollback budget (%d) exhausted with "
+                         "no forward progress — giving up",
+                         self.max_rollbacks)
+            raise err
+        self._rollbacks += 1
+        with _span("sentinel.rollback", strikes=self._strikes):
+            restored = self.manager.restore_last_good()
+        if restored is None:
+            raise err
+        self._strikes = 0
+        self._ema = None
+        self._ema_n = 0
+        self._pending_good.clear()
+        self._m().inc("sentinel.rollbacks")
+        try:
+            from paddle_tpu.obs import flight
+            flight.write_postmortem(
+                reason=f"sentinel rollback to step {restored}",
+                extra={"restored_step": int(restored),
+                       "fault": str(fault) if fault else None,
+                       "quarantine_dir": self.quarantine_dir})
+        except Exception:
+            pass
+        logger.warning("sentinel: rolled back to known-good step %s",
+                       restored)
+        return restored
+
+    # -- known-good promotion --------------------------------------------
+
+    def note_checkpoint(self, step):
+        """Register a freshly-saved checkpoint; after ``mark_good_after``
+        clean checks it is promoted via ``manager.mark_good(step)``."""
+        if self.manager is None:
+            return
+        if self.mark_good_after <= 0:
+            self._promote(int(step))
+        else:
+            self._pending_good.append([int(step), self.mark_good_after])
+
+    def _advance_good(self):
+        if not self._pending_good:
+            return
+        promoted = None
+        for entry in self._pending_good:
+            entry[1] -= 1
+            if entry[1] <= 0:
+                promoted = entry[0]   # newest eligible wins
+        if promoted is not None:
+            self._pending_good = [e for e in self._pending_good
+                                  if e[1] > 0]
+            self._promote(promoted)
+
+    def _promote(self, step):
+        try:
+            got = self.manager.mark_good(step)
+        except Exception:
+            logger.warning("sentinel: mark_good(%s) failed", step,
+                           exc_info=True)
+            return
+        if got is None:
+            # the checkpoint was rotated away before its promotion
+            # caught up: no new anchor, no forward progress — the
+            # rollback budget must NOT refill on a phantom promotion
+            logger.warning("sentinel: checkpoint %s vanished before "
+                           "promotion (keep-N rotation outran the "
+                           "clean-check lag)", step)
+            return
+        self._rollbacks = 0   # forward progress: refill rollback budget
+
+
+def sentinel_from_env(manager=None, spec=None, **overrides):
+    """Build a :class:`Sentinel` from ``PADDLE_TPU_SENTINEL`` (or an
+    explicit ``spec``); returns None when unset/disabled — training
+    scripts guard only when the operator asked.
+
+    Grammar (``;`` or ``,`` separated)::
+
+        PADDLE_TPU_SENTINEL="1"                              # defaults
+        PADDLE_TPU_SENTINEL="cadence=4;strikes=3;spike=10"
+        PADDLE_TPU_SENTINEL="cadence=1;spike=off;quarantine=/tmp/q"
+
+    Keys: ``cadence``, ``strikes``, ``spike`` (factor, or ``off``),
+    ``ema``, ``warmup``, ``good_after``, ``max_rollbacks``,
+    ``quarantine`` (dir), ``loss`` (fetch name)."""
+    spec = spec if spec is not None \
+        else os.environ.get("PADDLE_TPU_SENTINEL", "")
+    spec = spec.strip()
+    if not spec or spec.lower() in ("0", "false", "off", "no"):
+        return None
+    kwargs = {}
+    if spec.lower() not in ("1", "true", "on", "yes"):
+        keymap = {"cadence": ("cadence", int),
+                  "strikes": ("strikes", int),
+                  "spike": ("spike_factor",
+                            lambda v: None if v.lower() in ("off", "none")
+                            else float(v)),
+                  "ema": ("ema_beta", float),
+                  "warmup": ("spike_warmup", int),
+                  "good_after": ("mark_good_after", int),
+                  "max_rollbacks": ("max_rollbacks", int),
+                  "quarantine": ("quarantine_dir", str),
+                  "loss": ("loss_name", str)}
+        for clause in spec.replace(",", ";").split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            key, _, value = clause.partition("=")
+            key = key.strip().lower()
+            if key not in keymap:
+                raise ValueError(
+                    f"PADDLE_TPU_SENTINEL: unknown key {key!r} in "
+                    f"{clause!r} (want {sorted(keymap)})")
+            dest, conv = keymap[key]
+            kwargs[dest] = conv(value.strip())
+    kwargs.update(overrides)
+    return Sentinel(manager=manager, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# offline replay (`paddle_tpu replay <bundle>`)
+# ---------------------------------------------------------------------------
+
+def replay_bundle(path):
+    """Re-execute a quarantined step from its repro bundle and report
+    whether the numerical fault reproduces.
+
+    Rebuilds the program, pre-step state, batch, and RNG coordinates
+    recorded at quarantine time, runs ONE step under a detect-only
+    sentinel, and returns ``{"reproduced": bool, "reason", "bad",
+    "step", "injected"}``.  Bundles whose fault was manufactured by the
+    ``sentinel.nan`` failpoint re-arm it for one fire, so injected
+    drills replay deterministically too.  Run under
+    ``JAX_PLATFORMS=cpu`` (the CLI does this) to debug a TPU fault on a
+    workstation."""
+    try:
+        with open(path, "rb") as f:
+            bundle = pickle.load(f)
+        if not isinstance(bundle, dict):
+            raise ValueError("not a bundle dict")
+    except OSError:
+        raise
+    except Exception as e:
+        # pickle raises a zoo on truncated/corrupt input
+        # (UnpicklingError, EOFError, AttributeError, ...): normalize to
+        # the CLI's "malformed bundle" verdict (exit 2) — never the
+        # "replayed clean" one
+        raise ValueError(f"{path}: malformed bundle: {e}") from e
+    repro = bundle.get("repro")
+    if not repro:
+        raise ValueError(f"{path}: bundle carries no repro payload")
+    from paddle_tpu.executor import Executor
+    from paddle_tpu.framework import Program
+    from paddle_tpu.place import CPUPlace
+    from paddle_tpu.scope import Scope
+
+    try:
+        program = Program.from_dict(repro["program"])
+        program.random_seed = repro.get("random_seed")
+        scope = Scope()
+        for name, value in (repro.get("state") or {}).items():
+            scope.set_var(name, value)
+        # the step's PRNGKey is (seed * 1000003 + run_counter); rewind
+        # the counter so the replayed step folds in the exact same key
+        run_counter = int(repro.get("run_counter", 1)) - 1
+    except Exception as e:
+        # a bundle that unpickles but whose payload cannot rebuild
+        # (version skew, corrupt arrays) is still "malformed" (exit 2),
+        # never "replayed clean"
+        raise ValueError(
+            f"{path}: cannot rebuild repro payload: {e}") from e
+    exe = Executor(CPUPlace())
+    exe._run_counter = run_counter
+    det = bundle.get("detector") or {}
+    if bundle.get("reason") == "loss_spike" and \
+            det.get("spike_factor") is not None and \
+            det.get("ema") is not None:
+        # re-arm the spike detector against the recorded EMA baseline —
+        # a deterministic spike (bad batch) reproduces, a transient one
+        # replays clean
+        guard = Sentinel(cadence=1, strikes=1 << 30,
+                         spike_factor=det["spike_factor"],
+                         ema_beta=det.get("ema_beta", 0.9),
+                         spike_warmup=1,
+                         loss_name=det.get("loss_name"))
+        guard._ema = det.get("ema")
+        guard._ema_n = max(int(det.get("ema_n") or 1), 1)
+    else:
+        guard = Sentinel(cadence=1, strikes=1 << 30, spike_factor=None)
+    # the replay guard must not inflate the process-global sentinel.*
+    # fault counters (an in-process replay is forensics, not a fault)
+    guard._metrics_enabled = False
+    prev_nan_fp = None
+    if bundle.get("injected"):
+        # swap, don't inject+clear: an in-process caller may have a live
+        # drill armed on sentinel.nan (e.g. PADDLE_TPU_CHAOS
+        # "sentinel.nan=error@100*3" waiting for step 100) — the replay
+        # must not clobber it on the way in or disarm it on the way out
+        prev_nan_fp = chaos.swap("sentinel.nan", None)
+        chaos.inject("sentinel.nan", times=1)
+    report = {"reproduced": False, "reason": None, "bad": [],
+              "step": bundle.get("step"),
+              "injected": bool(bundle.get("injected"))}
+    try:
+        try:
+            exe.run(program, feed=dict(repro["feed"]),
+                    fetch_list=list(repro["fetch_names"]), scope=scope,
+                    sentinel=guard)
+        except NumericalFault as f:
+            report.update(reproduced=True, reason=f.reason, bad=f.bad)
+        except Exception as e:
+            # a step that cannot re-execute at all (version skew hitting
+            # jit tracing, an XLA runtime error) is "unreplayable" (the
+            # CLI's exit 2) — it must never fall through to exit 1, the
+            # "replayed CLEAN, suspect hardware" verdict automated
+            # triage trusts
+            raise ValueError(
+                f"{path}: bundle does not re-execute: {e}") from e
+    finally:
+        if bundle.get("injected"):
+            chaos.swap("sentinel.nan", prev_nan_fp)
+    return report
+
+
+def _is_arraylike(v):
+    return hasattr(v, "shape") or hasattr(v, "dtype")
+
+
+def _size_of(a):
+    try:
+        return int(a.size)
+    except (AttributeError, TypeError):
+        return None
+
+
+def _host_finite(v):
+    import numpy as np
+    try:
+        a = np.asarray(v)
+    except TypeError:
+        return True
+    if getattr(a.dtype, "kind", None) in ("i", "u", "b"):
+        return True
+    try:
+        # cast through float32: covers ml_dtypes (bfloat16) too
+        return bool(np.isfinite(a.astype("float32", copy=False)).all())
+    except (TypeError, ValueError):
+        return True

@@ -242,14 +242,24 @@ def load_inference_model(dirname, executor, model_filename=None,
 # multi-host meshes without gathering.
 # ---------------------------------------------------------------------------
 
-def _write_latest(dirname, step):
-    latest = os.path.join(dirname, "latest")
-    tmp = latest + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(str(int(step)))
+def atomic_write(path, data):
+    """Crash-safe small-file write (tmp + fsync + rename): a crash
+    mid-write keeps the old file.  ``data`` may be str or bytes.  The
+    shared idiom behind every pointer/bundle file the runtime commits
+    (``latest``, ``last_good``, sentinel quarantine bundles).  The temp
+    name is deterministic (single-writer protocol), so a crashed
+    write's orphan is overwritten by the next attempt instead of
+    accumulating per-pid litter."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb" if isinstance(data, bytes) else "w") as f:
+        f.write(data)
         f.flush()
         os.fsync(f.fileno())
-    os.replace(tmp, latest)  # atomic: a crash mid-save keeps the old ptr
+    os.replace(tmp, path)
+
+
+def _write_latest(dirname, step):
+    atomic_write(os.path.join(dirname, "latest"), str(int(step)))
 
 
 def save_checkpoint(executor, dirname, main_program=None, step=0,
@@ -354,12 +364,18 @@ def load_checkpoint(executor, dirname, main_program=None, step=None,
     from paddle_tpu.framework import default_main_program
     from paddle_tpu.scope import global_scope
 
+    from paddle_tpu.fault import chaos
+
     main_program = main_program or default_main_program()
     scope = scope or global_scope()
     if step is None:
         with open(os.path.join(dirname, "latest")) as f:
             step = int(f.read().strip())
     path = os.path.abspath(os.path.join(dirname, f"ckpt-{int(step)}"))
+    # the restore boundary: a kill here (crash mid-rollback) must leave
+    # the directory restorable by the next boot — restores never mutate
+    # committed checkpoints, so the drill validates exactly that
+    chaos.fire("ckpt.restore", step=int(step))
     ckptr = ocp.StandardCheckpointer()
     if shardings:
         meta = ckptr.metadata(path)

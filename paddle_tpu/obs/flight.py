@@ -1,9 +1,11 @@
 """Flight recorder: "what was the process doing when it died".
 
 On a crash — an uncaught exception, a :class:`fault.GracefulShutdown`
-signal, or a chaos-failpoint hard kill — the recorder atomically writes
-a post-mortem JSON file holding the last N spans from the trace ring
-plus a full ``RuntimeMetrics.snapshot()``.  The span tail reconstructs
+signal, a chaos-failpoint hard kill, or a ``fault.Sentinel`` rollback
+(the numerical-fault analog of a crash: the run survived, the state
+did not) — the recorder atomically writes a post-mortem JSON file
+holding the last N spans from the trace ring plus a full
+``RuntimeMetrics.snapshot()``.  The span tail reconstructs
 the final step's phase timeline (feed/dispatch/fetch, datapipe pulls,
 checkpoint commits); the metrics snapshot carries the counters the
 grafana board would have shown at the moment of death.
@@ -22,9 +24,11 @@ JSON (the same commit discipline as ``fault.checkpoint``).
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import sys
+import threading
 import time
 import traceback
 
@@ -37,6 +41,7 @@ POSTMORTEM_ENV = "PADDLE_TPU_POSTMORTEM"
 POSTMORTEM_FORMAT = 1
 
 _excepthook_installed = False
+_dump_seq = itertools.count()
 
 
 def postmortem_path(path=None):
@@ -74,7 +79,14 @@ def write_postmortem(path=None, reason="", extra=None):
         }
         if extra:
             body["extra"] = extra
-        tmp = f"{target}.tmp-{os.getpid()}"
+        # the tmp name must be unique PER CALL, not per process: a
+        # graceful shutdown dumps twice concurrently (the async
+        # signal-handler thread and the __exit__ backstop), and two
+        # writers sharing one tmp inode interleave into torn JSON —
+        # unique names keep every rename a complete document, last
+        # writer wins
+        tmp = (f"{target}.tmp-{os.getpid()}"
+               f"-{threading.get_ident()}-{next(_dump_seq)}")
         with open(tmp, "w") as f:
             json.dump(body, f)
             f.flush()

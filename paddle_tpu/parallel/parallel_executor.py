@@ -71,15 +71,16 @@ class ParallelExecutor(Executor):
         return int(np.prod(self.mesh.devices.shape))
 
     def run(self, fetch_list=None, feed=None, feed_dict=None,
-            program=None, return_numpy=True, scope=None):
+            program=None, return_numpy=True, scope=None, sentinel=None):
         feed = feed if feed is not None else (feed_dict or {})
         program = program or self._main_program or default_main_program()
         return super().run(program=program, feed=feed,
                            fetch_list=fetch_list, scope=scope,
-                           return_numpy=return_numpy)
+                           return_numpy=return_numpy, sentinel=sentinel)
 
     # -- sharding-aware compile ----------------------------------------
-    def _get_compiled(self, program, block, feed_arrays, fetch_names, scope):
+    def _get_compiled(self, program, block, feed_arrays, fetch_names, scope,
+                      donate=True):
         from paddle_tpu.executor import _freeze_lod
         feed_lods = tuple(sorted(
             (n, _freeze_lod(scope.find_lod(n))) for n in feed_arrays
@@ -89,7 +90,7 @@ class ParallelExecutor(Executor):
                tuple(sorted((n, str(a.dtype), a.shape)
                             for n, a in feed_arrays.items())),
                feed_lods,
-               fetch_names)
+               fetch_names, donate)
         if sig in self._cache:
             self._cache[sig] = self._cache.pop(sig)  # LRU bump
             _profiler.runtime_metrics.inc("jit_cache.hits")
@@ -101,7 +102,7 @@ class ParallelExecutor(Executor):
         _profiler.runtime_metrics.inc("jit_cache.misses")
 
         base = super()._get_compiled(program, block, feed_arrays,
-                                     fetch_names, scope)
+                                     fetch_names, scope, donate=donate)
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
         data_size = dict(zip(mesh.axis_names,
@@ -163,7 +164,7 @@ class ParallelExecutor(Executor):
                                 for n in out_state_names})
         jitted = jax.jit(step, in_shardings=in_shardings,
                          out_shardings=out_shardings,
-                         donate_argnums=(2,))
+                         donate_argnums=(2,) if donate else ())
         feed_shardings = in_shardings[0]
 
         def place(a, sharding):
@@ -185,6 +186,7 @@ class ParallelExecutor(Executor):
 
         compiled = _CompiledBlock(fn, base.feed_names, base.ro_names,
                                   base.inout_names, tuple(fetch_names), True)
+        compiled.donated = donate
         self._cache_insert(sig, compiled)
         return compiled
 

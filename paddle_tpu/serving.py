@@ -24,7 +24,8 @@ from paddle_tpu.obs.trace import span as _span, record_span as _record_span
 logger = logging.getLogger(__name__)
 
 __all__ = ["Predictor", "serve", "InferenceServer", "MicroBatcher",
-           "DeadlineExceeded", "QueueFull", "ServingClient", "ServingError"]
+           "DeadlineExceeded", "QueueFull", "BatcherCrashed",
+           "ServingClient", "ServingError"]
 
 
 class DeadlineExceeded(RuntimeError):
@@ -34,6 +35,13 @@ class DeadlineExceeded(RuntimeError):
 class QueueFull(RuntimeError):
     """The batcher's bounded request queue is full (load shedding — the
     caller gets a retryable 503 instead of queueing unboundedly)."""
+
+
+class BatcherCrashed(RuntimeError):
+    """The batcher thread died on an unexpected exception.  Every
+    pending request fails with this (a retryable 503 at the HTTP layer)
+    instead of hanging until its client timeout; the batcher restarts
+    itself within a bounded budget."""
 
 
 class ServingError(RuntimeError):
@@ -266,10 +274,18 @@ class MicroBatcher:
     Degradation semantics mirror the serialized path: a full queue raises
     :class:`QueueFull` (503 load shedding), a request whose result does
     not arrive within its timeout raises :class:`DeadlineExceeded` (504)
-    and its queue slot is abandoned."""
+    and its queue slot is abandoned.
+
+    An UNEXPECTED exception escaping the batcher thread (a bug, not a
+    per-batch dispatch failure — those already route to their batch)
+    must not leave queued requests hanging until client timeout: every
+    pending request fails immediately with :class:`BatcherCrashed`
+    (503, retryable) and the thread restarts, up to ``max_restarts``
+    times (``serving.batcher_restarts`` counts them); past the budget
+    the batcher is dead and ``submit`` fails fast."""
 
     def __init__(self, predictor, max_batch_size=8, max_batch_delay=0.005,
-                 queue_size=128, max_batch_rows=None):
+                 queue_size=128, max_batch_rows=None, max_restarts=5):
         from paddle_tpu.lod import row_bucket
         self._predictor = predictor
         self.max_batch_size = max(1, int(max_batch_size))
@@ -277,17 +293,67 @@ class MicroBatcher:
         self.queue_size = max(1, int(queue_size))
         self.max_batch_rows = int(max_batch_rows) if max_batch_rows \
             else max(row_bucket(self.max_batch_size), self.max_batch_size)
+        self.max_restarts = max(0, int(max_restarts))
         self._queue = []
         self._cv = threading.Condition()
         self._closed = False
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="paddle-tpu-batcher")
-        self._thread.start()
+        self._restarts = 0
+        self._failed = None       # terminal crash after restart budget
+        self._assembling = None   # batch popped but not yet dispatched
+        self._thread = self._spawn_thread()
+
+    def _spawn_thread(self):
+        t = threading.Thread(target=self._run, daemon=True,
+                             name="paddle-tpu-batcher")
+        t.start()
+        return t
+
+    def _run(self):
+        try:
+            self._loop()
+        except BaseException as e:   # batcher bug: recover, don't hang
+            self._crash(e)
+
+    def _crash(self, exc):
+        from paddle_tpu import profiler as _profiler
+        logger.exception("batcher thread crashed")
+        with self._cv:
+            pending, self._queue = self._queue, []
+            assembling, self._assembling = self._assembling, None
+            restart = not self._closed and \
+                self._restarts < self.max_restarts
+            if restart:
+                self._restarts += 1
+            elif not self._closed:
+                self._failed = exc
+        # record the restart BEFORE waking any waiter: "submit raised
+        # BatcherCrashed" must imply "restart already observable" (the
+        # counter and the live thread), or observers race the dying
+        # thread's tail
+        if restart:
+            _profiler.runtime_metrics.inc("serving.batcher_restarts")
+            self._thread = self._spawn_thread()
+        err = BatcherCrashed(
+            f"batcher thread crashed ({type(exc).__name__}: {exc}); "
+            f"request aborted — retry")
+        err.__cause__ = exc
+        for p in (assembling or []) + pending:
+            if not p.abandoned:
+                p.error = err
+                p.event.set()
 
     @property
     def queue_depth(self):
         with self._cv:
             return len(self._queue)
+
+    @property
+    def failed(self):
+        """Terminal crash exception once the restart budget is spent
+        (None while the batcher is alive) — the signal /readyz uses to
+        pull a permanently-503 replica out of rotation."""
+        with self._cv:
+            return self._failed
 
     def submit(self, feed, timeout=None):
         """Enqueue one request feed and block for its outputs."""
@@ -300,6 +366,12 @@ class MicroBatcher:
         with self._cv:
             if self._closed:
                 raise RuntimeError("batcher is shut down")
+            if self._failed is not None:
+                # restart budget exhausted: fail fast (still a 503 so a
+                # load balancer retries a healthy replica)
+                raise BatcherCrashed(
+                    f"batcher is down after {self._restarts} restarts: "
+                    f"{self._failed}")
             if len(self._queue) >= self.queue_size:
                 _profiler.runtime_metrics.inc("serving.queue_rejections")
                 raise QueueFull(
@@ -350,6 +422,7 @@ class MicroBatcher:
         return rows_budget
 
     def _loop(self):
+        from paddle_tpu.fault import chaos
         while True:
             batch = []
             with self._cv:
@@ -362,6 +435,9 @@ class MicroBatcher:
                 first = self._queue.pop(0)
                 if first.abandoned:
                     continue
+                # visible to _crash: a thread death between pop and
+                # scatter must fail THESE requests too, not strand them
+                self._assembling = batch
                 assembly_t0 = time.perf_counter()
                 batch.append(first)
                 budget = self.max_batch_rows - (first.rows or 0)
@@ -375,7 +451,20 @@ class MicroBatcher:
                             len(batch) >= self.max_batch_size or budget <= 0:
                         break
                     self._cv.wait(remaining)
+            # OUTSIDE _dispatch's per-batch try: an armed failpoint here
+            # models a bug in the batcher thread itself (the per-batch
+            # dispatch path already routes ITS failures to the batch)
+            chaos.fire("serving.batcher.crash", size=len(batch))
             self._dispatch(batch, assembly_t0)
+            with self._cv:
+                self._assembling = None
+                # a completed assemble->dispatch cycle is forward
+                # progress: refill the restart budget (mirroring the
+                # sentinel's max_rollbacks refill) so rare-but-recovered
+                # crashes spread over a long uptime never accumulate
+                # into a terminal outage — the budget bounds CONSECUTIVE
+                # crashes, not lifetime ones
+                self._restarts = 0
 
     def _dispatch(self, batch, assembly_t0=None):
         from paddle_tpu import profiler as _profiler
@@ -580,9 +669,18 @@ class InferenceServer:
                 if self.path in ("/health", "/healthz"):
                     self._reply(200, {"status": "ok"})
                 elif self.path == "/readyz":
+                    batcher = server._batcher
                     if server._load_error is not None:
                         self._error(500, "model_load_failed",
                                     str(server._load_error),
+                                    retryable=False)
+                    elif batcher is not None and \
+                            batcher.failed is not None:
+                        # terminal batcher death: every /predict would
+                        # 503 forever — stop reporting ready so the
+                        # load balancer pulls this replica
+                        self._error(500, "batcher_down",
+                                    f"batcher is down: {batcher.failed}",
                                     retryable=False)
                     elif server._ready.is_set():
                         self._reply(200, {"status": "ready"})
@@ -690,6 +788,11 @@ class InferenceServer:
                                                  for o in outs]})
                 except QueueFull as e:
                     self._error(503, "overloaded", str(e), retryable=True)
+                except BatcherCrashed as e:
+                    # the batcher died under this request and restarted:
+                    # retryable by contract, same as load shedding
+                    self._error(503, "batcher_restarted", str(e),
+                                retryable=True)
                 except DeadlineExceeded as e:
                     self._error(504, "deadline_exceeded", str(e),
                                 retryable=True)

@@ -156,6 +156,62 @@ class TestRetryPolicy:
         assert [p.backoff(n) for n in (1, 2, 3, 4)] == \
             [0.1, 0.2, 0.3, 0.3]
 
+    def test_full_jitter_bounds(self):
+        """jitter="full" (AWS full jitter): every delay lands in
+        [0, cap] and actually varies — the decorrelation that spreads a
+        thundering herd."""
+        p = RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=0.4,
+                        jitter="full")
+        for attempt, cap in ((1, 0.1), (2, 0.2), (3, 0.4), (4, 0.4)):
+            delays = [p.backoff(attempt) for _ in range(200)]
+            assert all(0.0 <= d <= cap for d in delays)
+            assert max(delays) - min(delays) > cap * 0.1  # not constant
+
+    def test_bad_jitter_rejected(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=2.0)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=-0.1)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter="bogus")
+        # None = jitter off, the pre-existing falsy convention
+        assert RetryPolicy(jitter=None).backoff(1) == \
+            RetryPolicy(jitter=0).backoff(1)
+        # numeric strings coerce at construction, not crash in backoff
+        assert 0.0 <= RetryPolicy(jitter="0.5").backoff(1) <= 0.1
+
+    def test_deadline_raises_immediately_not_after_sleeping(self):
+        """When the remaining budget is smaller than the next backoff,
+        the policy must raise NOW — not sleep through (or past) the
+        deadline first."""
+        p = RetryPolicy(max_attempts=100, base_delay=5.0, jitter=0,
+                        deadline=0.05)
+        t0 = time.monotonic()
+        with pytest.raises(RetryError, match="deadline"):
+            p.call(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+        assert time.monotonic() - t0 < 1.0  # never slept the 5s backoff
+
+    def test_per_call_deadline_overrides_policy(self):
+        p = RetryPolicy(max_attempts=100, base_delay=0.2, jitter=0,
+                        deadline=None)   # policy itself would retry long
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        t0 = time.monotonic()
+        with pytest.raises(RetryError, match="deadline"):
+            p.call(always, deadline=0.05)
+        assert time.monotonic() - t0 < 1.0
+        assert len(calls) >= 1
+        # and a generous per-call deadline still allows retries
+        calls.clear()
+        with pytest.raises(RetryError):
+            RetryPolicy(max_attempts=3, base_delay=0.001, jitter=0) \
+                .call(always, deadline=30.0)
+        assert len(calls) == 3
+
 
 # ---------------------------------------------------------------------------
 # reader resilience: worker/producer exceptions reach the consumer
@@ -458,6 +514,119 @@ class TestCheckpointManager:
         mgr = CheckpointManager(str(tmp_path), executor=exe)
         assert mgr.restore_latest() is None
 
+    def test_gc_pins_newest_verified_when_newer_are_corrupt(self,
+                                                            tmp_path):
+        """keep-N rotation must never delete the only checkpoint that
+        still verifies: with the newer ones torn on disk, the newest
+        VERIFIED step is pinned regardless of rotation."""
+        from conftest import corrupt_largest_file
+        mgr, _, _ = self._train_and_save(tmp_path, steps=3, keep=10)
+        corrupt_largest_file(mgr.path(2))
+        corrupt_largest_file(mgr.path(3))
+        mgr.keep = 1
+        mgr._gc()          # rotation alone would keep only corrupt ckpt-3
+        assert mgr.steps() == [1, 3]   # ckpt-1 pinned, ckpt-2 collected
+        assert mgr.restore_latest() == 1
+
+    def test_gc_trusts_the_step_it_just_committed(self, tmp_path):
+        """The pin scan trusts the save's own fresh commit (hashed at
+        write time) — a healthy directory pays no re-verify, and GC
+        still rotates normally."""
+        mgr, _, _ = self._train_and_save(tmp_path, steps=5, keep=2)
+        assert mgr.steps() == [4, 5]
+
+    def test_mark_good_and_restore_last_good(self, tmp_path):
+        mgr, exe, _ = self._train_and_save(tmp_path, steps=3)
+        assert mgr.mark_good(2) == 2
+        assert mgr.last_good_step() == 2
+        assert mgr.restore_last_good() == 2
+        # the latest pointer follows the known-good restore
+        assert fluid.io.load_checkpoint(exe, str(tmp_path)) == 2
+
+    def test_gc_never_collects_known_good(self, tmp_path):
+        mgr, exe, loss = self._train_and_save(tmp_path, steps=2, keep=2)
+        mgr.mark_good(1)
+        for s in (3, 4, 5):
+            exe.run(fluid.default_main_program(), feed=_tiny_feed(s),
+                    fetch_list=[loss])
+            mgr.save(s)
+        # rotation keeps the newest 2 AND the known-good anchor
+        assert mgr.steps() == [1, 4, 5]
+        assert mgr.last_good_step() == 1
+
+    def test_resaving_the_anchor_step_drops_the_pointer(self, tmp_path):
+        """Overwriting the known-good step (restart renumbering) must
+        invalidate the pointer: the replacement has not earned its
+        clean checks and must not inherit promoted status."""
+        mgr, exe, loss = self._train_and_save(tmp_path, steps=2)
+        mgr.mark_good(2)
+        exe.run(fluid.default_main_program(), feed=_tiny_feed(9),
+                fetch_list=[loss])
+        mgr.save(2)                  # displaces the promoted ckpt-2
+        assert mgr.last_good_step() is None
+        assert mgr.restore_last_good() == 2   # falls back to latest
+
+    def test_restore_reports_params_only_when_no_datapipe_state(
+            self, tmp_path):
+        """A known-good checkpoint saved before a pipeline was attached
+        restores params only: last_restore_rewound must say so (the
+        sentinel rollback branches on it instead of guessing)."""
+        mgr, _, _ = self._train_and_save(tmp_path, steps=1)
+        mgr.mark_good(1)
+
+        class _Pipe:
+            def load_state_dict(self, d):
+                raise AssertionError("no state to load")
+
+        mgr.datapipe = _Pipe()
+        assert mgr.restore_last_good() == 1
+        assert mgr.last_restore_rewound is False
+
+    def test_mark_good_of_rotated_away_step_returns_none(self, tmp_path):
+        """keep-N can delete a step before its promotion catches up
+        (the clean-check lag): mark_good must refuse the phantom, not
+        write a pointer to a nonexistent dir."""
+        import shutil as _shutil
+        mgr, _, _ = self._train_and_save(tmp_path, steps=2)
+        _shutil.rmtree(mgr.path(1))
+        assert mgr.mark_good(1) is None
+        assert mgr.last_good_step() is None
+
+    def test_gc_protects_fresh_commit_under_restart_renumbering(
+            self, tmp_path):
+        """A restart that renumbers from 0 into a directory holding
+        higher steps must not let the save's own GC collect the
+        checkpoint it just committed (the 'latest' pointer names it)."""
+        mgr, exe, loss = self._train_and_save(tmp_path, steps=6, keep=3)
+        exe.run(fluid.default_main_program(), feed=_tiny_feed(9),
+                fetch_list=[loss])
+        mgr.save(0)          # renumbered: sorts below every victim
+        assert 0 in mgr.steps()
+        assert fluid.io.load_checkpoint(exe, str(tmp_path)) == 0
+
+    def test_mark_good_reverifies_foreign_checkpoints(self, tmp_path):
+        """A manager that did not write the checkpoint itself (restart)
+        must re-verify before promoting — a torn checkpoint can never
+        become the rollback anchor."""
+        from conftest import corrupt_largest_file
+        mgr, exe, _ = self._train_and_save(tmp_path, steps=1)
+        corrupt_largest_file(mgr.path(1))
+        fresh = CheckpointManager(str(tmp_path), executor=exe)
+        with pytest.raises(CorruptCheckpoint):
+            fresh.mark_good(1)
+        assert fresh.last_good_step() is None
+
+    def test_restore_last_good_falls_back_when_good_is_corrupt(
+            self, tmp_path):
+        from conftest import corrupt_largest_file
+        mgr, _, _ = self._train_and_save(tmp_path, steps=3)
+        mgr.mark_good(2)
+        corrupt_largest_file(mgr.path(2))
+        got = mgr.restore_last_good()
+        assert got == 3                      # newest verifiable wins
+        assert any("ckpt-2" in q for q in mgr.quarantined())
+        assert mgr.last_good_step() is None  # stale pointer dropped
+
     def test_kill_at_commit_leaves_previous_restorable(self, tmp_path):
         """A crash between the temp write and the atomic rename must not
         produce a partial ckpt-* dir; the previous step stays latest."""
@@ -570,6 +739,69 @@ with open(args.out, "w") as f:
 '''
 
 
+SENTINEL_TRAINER = r'''
+"""Pipeline trainer for the crash-during-rollback drill: datapipe-driven
+run_pipeline under a Sentinel guard, per-step checkpoints promoted to
+known-good, resume via restore_last_good().  PADDLE_TPU_CHAOS arms
+sentinel.nan (force the rollback) and ckpt.restore (kill mid-restore)."""
+import argparse
+import json
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.datapipe as dp
+from paddle_tpu import layers
+from paddle_tpu.fault import CheckpointManager, Sentinel
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--ckpt", required=True)
+ap.add_argument("--out", required=True)
+args = ap.parse_args()
+
+main, startup = fluid.Program(), fluid.Program()
+main.random_seed = startup.random_seed = 11
+with fluid.program_guard(main, startup):
+    x = layers.data("x", shape=[6], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, 1, param_attr="w", bias_attr="b")
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9) \
+        .minimize(loss)
+
+rng = np.random.RandomState(7)
+w_true = np.arange(1.0, 7.0, dtype="float32").reshape(6, 1)
+xs = rng.rand(40, 6).astype("float32")
+samples = [{"x": xs[i], "y": (xs[i:i + 1] @ w_true)[0].astype("float32")}
+           for i in range(40)]
+pipe = dp.InMemorySource(samples).shuffle(8, seed=3) \
+    .batch(4, drop_last=True)
+
+exe = fluid.Executor()
+exe.run(startup)
+mgr = CheckpointManager(args.ckpt, keep=4, executor=exe,
+                        main_program=main, datapipe=pipe)
+resumed = mgr.restore_last_good()
+sentinel = Sentinel(manager=mgr, cadence=1, strikes=2, mark_good_after=1)
+
+losses = []
+
+def on_step(step, fetches):
+    losses.append(float(np.asarray(fetches[0]).reshape(-1)[0]))
+    mgr.save(step)
+    sentinel.note_checkpoint(step)
+
+exe.run_pipeline(main, pipe, fetch_list=[loss.name], sentinel=sentinel,
+                 on_step=on_step)
+
+with open(args.out, "w") as f:
+    json.dump({"final_loss": losses[-1], "resumed_from": resumed,
+               "steps": len(losses)}, f)
+'''
+
+
 @pytest.mark.chaos
 @pytest.mark.slow  # full kill/resume drill: 5 subprocess boots; the
                    # in-process failpoint tests above are the tier-1
@@ -619,6 +851,63 @@ class TestKillAndResume:
         assert got["resumed_from"] == 4
         np.testing.assert_allclose(got["final_loss"], ref["final_loss"],
                                    rtol=1e-5)
+
+    def test_crash_during_rollback_restarts_clean(self, tmp_path):
+        """Chaos-kill the trainer mid-``restore_last_good()`` (the
+        sentinel's rollback rung) and assert the subsequent restart
+        still restores a verified checkpoint with MATCHING datapipe
+        state: the resumed run must reach the same final loss as an
+        uninterrupted reference run, because restores never mutate
+        committed checkpoints."""
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH",
+                                                             "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PADDLE_TPU_CHAOS", None)
+        trainer = tmp_path / "trainer.py"
+        trainer.write_text(SENTINEL_TRAINER)
+
+        def run(ckpt, out, chaos_spec=None, expect_rc=0):
+            e = dict(env)
+            if chaos_spec:
+                e["PADDLE_TPU_CHAOS"] = chaos_spec
+            r = subprocess.run(
+                [sys.executable, str(trainer), "--ckpt", str(ckpt),
+                 "--out", str(out)],
+                cwd=repo_root, env=e, capture_output=True, text=True,
+                timeout=300)
+            assert r.returncode == expect_rc, \
+                (r.returncode, r.stderr[-2000:])
+            return r
+
+        # uninterrupted reference: 40 samples / batch 4 -> 10 steps
+        ref_out = tmp_path / "ref.json"
+        run(tmp_path / "ref_ckpt", ref_out)
+        ref = json.loads(ref_out.read_text())
+        assert ref["resumed_from"] is None and ref["steps"] == 10
+
+        # chaos run: NaNs at steps 5-6 force a rollback, and the
+        # rollback's restore itself is chaos-killed mid-read
+        ckpt = tmp_path / "ckpt"
+        out = tmp_path / "got.json"
+        run(ckpt, out,
+            chaos_spec="sentinel.nan=error@4*2;ckpt.restore=kill",
+            expect_rc=chaos.KILL_EXIT_CODE)
+        assert not out.exists()          # it really died mid-rollback
+
+        # restart without chaos: restore_last_good() must verify and
+        # load the known-good checkpoint (params + datapipe position)
+        run(ckpt, out)
+        got = json.loads(out.read_text())
+        assert got["resumed_from"] == 2  # newest PROMOTED known-good
+        assert got["steps"] == 7         # batches 3..9 replayed
+        np.testing.assert_allclose(got["final_loss"], ref["final_loss"],
+                                   rtol=1e-5)
+        # the quarantine bundles from the poisoned steps survived too
+        qdir = ckpt / "quarantine"
+        assert qdir.is_dir() and len(list(qdir.glob("*.pkl"))) == 2
 
     def test_resume_skips_truncated_checkpoint(self, tmp_path):
         """Kill + corrupt the newest surviving checkpoint: recovery must

@@ -325,6 +325,30 @@ class TestFlightRecorder:
         # atomic: no tmp leftovers
         assert [p.name for p in tmp_path.iterdir()] == ["pm.json"]
 
+    def test_concurrent_dumps_never_tear(self, tmp_path):
+        """Regression: a graceful shutdown dumps twice concurrently
+        (async handler thread + __exit__ backstop); two writers sharing
+        one tmp inode used to interleave into torn JSON ("Extra data").
+        Whatever interleaving happens, the file must parse whole."""
+        target = tmp_path / "pm.json"
+        barrier = threading.Barrier(4)
+
+        def dump():
+            barrier.wait()
+            for _ in range(10):
+                flight.write_postmortem(path=str(target),
+                                        reason="concurrent")
+
+        threads = [threading.Thread(target=dump) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        body = flight.read_postmortem(str(target))
+        assert body["reason"] == "concurrent"
+        # every writer renamed its own tmp: no leftovers, no torn file
+        assert [p.name for p in tmp_path.iterdir()] == ["pm.json"]
+
     def test_env_dir_maps_to_pid_file(self, tmp_path, monkeypatch):
         monkeypatch.setenv(flight.POSTMORTEM_ENV, str(tmp_path))
         got = flight.write_postmortem(reason="dir")

@@ -71,6 +71,33 @@ class TestDataParallel:
                                    atol=1e-6)
 
 
+class TestRunPipelineParallel:
+    def test_run_pipeline_drives_parallel_executor(self):
+        """Regression: run_pipeline passed program POSITIONALLY into
+        self.run, but ParallelExecutor.run's first positional is
+        fetch_list — guarded parallel training (the sentinel's loop)
+        died with a TypeError on the first batch."""
+        import paddle_tpu.datapipe as dp
+        batch = 8
+        main, startup, loss = _mnist_like_program(batch)
+        mesh = make_mesh((8,), ("data",))
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            pexe = ParallelExecutor(loss_name=loss.name,
+                                    main_program=main, mesh=mesh)
+            rng = np.random.RandomState(0)
+            rows = [{"img": rng.rand(32).astype("float32"),
+                     "label": rng.randint(0, 10, (1,)).astype("int64")}
+                    for _ in range(batch * 2)]
+            pipe = dp.InMemorySource(rows).batch(batch, drop_last=True)
+            outs = pexe.run_pipeline(main, pipe, fetch_list=[loss.name])
+        assert len(outs) == 2
+        for o in outs:
+            assert np.isfinite(np.asarray(o[0])).all()
+
+
 class TestTensorParallel:
     def test_tp_transformer_matches_replicated(self):
         from paddle_tpu.models import transformer as T

@@ -1,0 +1,465 @@
+"""Training sentinel: numerical-fault detection, batch quarantine,
+automatic rollback, and deterministic replay (docs/fault_tolerance.md
+"Numerical faults").
+
+The acceptance drill runs the full escalation ladder IN-PROCESS (no
+subprocess boots — tier-1-safe): with ``sentinel.nan`` armed at step k,
+``run_pipeline`` (i) skips the poisoned updates and quarantines repro
+bundles, (ii) rolls back to the last known-good checkpoint after K
+strikes, (iii) resumes and reaches the SAME final loss as an uninjected
+run; the bundle re-triggers the non-finite under ``paddle_tpu replay``;
+and with the sentinel disabled ``Executor.run`` keeps the donating fast
+path with zero sentinel work (structural check, not wall-clock)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.datapipe as dp
+import paddle_tpu.layers as layers
+from paddle_tpu import cli, profiler
+from paddle_tpu.fault import (CheckpointManager, NumericalFault, Sentinel,
+                              chaos, replay_bundle, sentinel_from_env)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _counter(name):
+    return profiler.runtime_metrics.counter(name)
+
+
+def build_model(seed=11, lr=0.05):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, 1, param_attr="w", bias_attr="b")
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.Momentum(learning_rate=lr, momentum=0.9) \
+            .minimize(loss)
+    return main, startup, loss
+
+
+def make_samples(n=40, seed=7):
+    rng = np.random.RandomState(seed)
+    w_true = np.arange(1.0, 7.0, dtype="float32").reshape(6, 1)
+    xs = rng.rand(n, 6).astype("float32")
+    return [{"x": xs[i], "y": (xs[i:i + 1] @ w_true)[0].astype("float32")}
+            for i in range(n)]
+
+
+def make_pipe(samples):
+    # shuffle (RNG + buffer state) AND a threaded prefetch stage: the
+    # rollback must restore/requiesce both kinds of state correctly
+    return dp.InMemorySource(samples).shuffle(8, seed=3) \
+        .batch(4, drop_last=True).prefetch(depth=2)
+
+
+def _feed(step):
+    rng = np.random.RandomState(step)
+    xs = rng.rand(8, 6).astype("float32")
+    return {"x": xs, "y": xs.sum(1, keepdims=True).astype("float32")}
+
+
+# ---------------------------------------------------------------------------
+# detection unit tests
+# ---------------------------------------------------------------------------
+
+class TestDetection:
+    def test_non_finite_state_trips_and_names_culprit(self):
+        s = Sentinel(cadence=1, strikes=99, spike_factor=None)
+        bad = np.array([1.0, np.nan], "float32")
+        with pytest.raises(NumericalFault) as ei:
+            s.after_step(["loss"], [np.float32(1.0)], {"w": bad})
+        assert ei.value.reason == "non_finite"
+        assert "w" in ei.value.bad
+
+    def test_non_finite_loss_trips(self):
+        s = Sentinel(cadence=1, strikes=99, spike_factor=None)
+        with pytest.raises(NumericalFault):
+            s.after_step(["loss"], [np.float32(np.inf)], {})
+
+    def test_integer_state_never_trips(self):
+        s = Sentinel(cadence=1, strikes=99, spike_factor=None)
+        fetches, state = s.after_step(
+            ["step"], [np.int64(3)], {"count": np.arange(4)})
+        assert state["count"].shape == (4,)
+
+    def test_cadence_skips_off_steps(self):
+        s = Sentinel(cadence=3, strikes=99, spike_factor=None)
+        bad = {"w": np.array([np.nan], "float32")}
+        s.after_step([], [], bad)       # tick 1: unchecked
+        s.after_step([], [], bad)       # tick 2: unchecked
+        with pytest.raises(NumericalFault):
+            s.after_step([], [], bad)   # tick 3: checked
+        assert _counter("sentinel.checks") >= 1
+
+    def test_ema_spike_detector(self):
+        s = Sentinel(cadence=1, strikes=99, spike_factor=3.0,
+                     spike_warmup=3)
+        for v in (1.0, 1.1, 0.9, 1.0):
+            s.after_step(["loss"], [np.float32(v)], {})
+        with pytest.raises(NumericalFault) as ei:
+            s.after_step(["loss"], [np.float32(50.0)], {})
+        assert ei.value.reason == "loss_spike"
+
+    def test_spike_detector_warms_up_first(self):
+        s = Sentinel(cadence=1, strikes=99, spike_factor=3.0,
+                     spike_warmup=5)
+        # huge swings inside the warmup window must not trip
+        for v in (1.0, 99.0, 0.01):
+            s.after_step(["loss"], [np.float32(v)], {})
+
+    def test_clean_check_resets_strikes(self, tmp_path):
+        s = Sentinel(cadence=1, strikes=2, spike_factor=None,
+                     quarantine_dir=str(tmp_path))
+        f = NumericalFault("x", reason="non_finite")
+        assert s.handle_fault(f, step=1) is None     # strike 1
+        assert s._strikes == 1
+        s.after_step([], [], {"w": np.ones(2, "float32")})  # clean
+        assert s._strikes == 0
+
+    def test_phantom_promotion_keeps_rollback_budget(self):
+        """mark_good returning None (checkpoint rotated away before
+        promotion) is not forward progress: the rollback budget must
+        not refill."""
+        class Mgr:
+            dirname = "."
+
+            def mark_good(self, step):
+                return None
+
+        s = Sentinel(manager=Mgr())
+        s._rollbacks = 2
+        s._promote(5)
+        assert s._rollbacks == 2
+
+    def test_sentinel_from_env_grammar(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SENTINEL", "0")
+        assert sentinel_from_env() is None
+        monkeypatch.setenv("PADDLE_TPU_SENTINEL", "1")
+        assert isinstance(sentinel_from_env(), Sentinel)
+        monkeypatch.setenv(
+            "PADDLE_TPU_SENTINEL",
+            "cadence=4;strikes=2,spike=off;good_after=3")
+        s = sentinel_from_env()
+        assert (s.cadence, s.strikes, s.spike_factor,
+                s.mark_good_after) == (4, 2, None, 3)
+        monkeypatch.setenv("PADDLE_TPU_SENTINEL", "bogus=1")
+        with pytest.raises(ValueError):
+            sentinel_from_env()
+
+
+# ---------------------------------------------------------------------------
+# skip-step semantics inside Executor.run
+# ---------------------------------------------------------------------------
+
+class TestSkipStep:
+    def test_tripped_step_discards_update(self):
+        main, startup, loss = build_model()
+        exe = fluid.Executor()
+        exe.run(startup)
+        s = Sentinel(cadence=1, strikes=99, spike_factor=None)
+        exe.run(main, feed=_feed(1), fetch_list=[loss], sentinel=s)
+        w_before = np.asarray(fluid.executor.fetch_var("w")).copy()
+        chaos.inject("sentinel.nan", times=1)
+        with pytest.raises(NumericalFault) as ei:
+            exe.run(main, feed=_feed(2), fetch_list=[loss], sentinel=s)
+        assert ei.value.injected
+        # the poisoned update never reached the scope
+        w_after = np.asarray(fluid.executor.fetch_var("w"))
+        np.testing.assert_array_equal(w_before, w_after)
+        assert np.isfinite(w_after).all()
+        # and the guard recovers: the next clean step trains normally
+        chaos.clear("sentinel.nan")
+        exe.run(main, feed=_feed(3), fetch_list=[loss], sentinel=s)
+        assert not np.array_equal(
+            w_after, np.asarray(fluid.executor.fetch_var("w")))
+
+    def test_injection_defers_to_the_next_checked_step(self):
+        """With cadence>1 the failpoint must poison a CHECKED step —
+        an off-cadence poison would be committed unseen and the later
+        check would quarantine an innocent batch."""
+        s = Sentinel(cadence=2, strikes=99, spike_factor=None)
+        chaos.inject("sentinel.nan", times=1)
+        state = {"w": np.ones(3, "float32")}
+        # tick 1 is off-cadence: unpoisoned, unchecked, returned as-is
+        _, out = s.after_step(["loss"], [np.float32(1.0)], state)
+        assert np.isfinite(np.asarray(out["w"])).all()
+        with pytest.raises(NumericalFault) as ei:
+            s.after_step(["loss"], [np.float32(1.0)], state)  # tick 2
+        assert ei.value.injected and ei.value.step == 2
+
+    def test_direct_run_without_pipeline_propagates_fault(self):
+        main, startup, loss = build_model()
+        exe = fluid.Executor()
+        exe.run(startup)
+        s = Sentinel(cadence=1, strikes=1, spike_factor=None)
+        chaos.inject("sentinel.nan", times=1)
+        with pytest.raises(NumericalFault):
+            exe.run(main, feed=_feed(1), fetch_list=[loss], sentinel=s)
+
+    def test_disabled_sentinel_is_structurally_free(self, monkeypatch):
+        """With sentinel=None the executor must never touch the sentinel
+        (no check, no device sync) and must keep donating state buffers
+        — the structural form of the 'no per-step sync' guarantee (the
+        2-vCPU bench host makes wall-clock checks meaningless)."""
+        main, startup, loss = build_model()
+        exe = fluid.Executor()
+        exe.run(startup)
+        seen = []
+        orig = Sentinel.after_step
+
+        def spy(self, *a, **k):
+            seen.append(1)
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(Sentinel, "after_step", spy)
+        exe.run(main, feed=_feed(1), fetch_list=[loss])
+        assert not seen, "sentinel code ran on an unguarded step"
+        compiled = [c for c in exe._cache.values()
+                    if hasattr(c, "donated")]
+        assert compiled and all(c.donated for c in compiled), \
+            "unguarded steps must keep the donating executable"
+        # the guarded variant is a SEPARATE, non-donating executable
+        s = Sentinel(cadence=1, strikes=99, spike_factor=None)
+        exe.run(main, feed=_feed(2), fetch_list=[loss], sentinel=s)
+        assert seen, "sentinel guard did not run on a guarded step"
+        assert [c for c in exe._cache.values()
+                if hasattr(c, "donated") and not c.donated]
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end chaos drill (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+class TestEscalationLadderEndToEnd:
+    @pytest.fixture(scope="class")
+    def drill(self, tmp_path_factory):
+        """One reference run + one chaos-injected run, shared by every
+        assertion in this class (the drill is the expensive part: ~20
+        checkpointed steps; the assertions are cheap reads)."""
+        root = tmp_path_factory.mktemp("ladder")
+        before = {n: _counter(n) for n in
+                  ("sentinel.skipped_steps", "sentinel.quarantined",
+                   "sentinel.rollbacks")}
+        ref_outs, _, _ = self._run_training(root, "ref", inject=False)
+        got_outs, mgr, sentinel = self._run_training(root, "chaos",
+                                                     inject=True)
+        delta = {n: _counter(n) - before[n] for n in before}
+        return {"ref_outs": ref_outs, "got_outs": got_outs, "mgr": mgr,
+                "sentinel": sentinel, "delta": delta}
+
+    def _run_training(self, tmp_path, tag, inject=False):
+        samples = make_samples()
+        main, startup, loss = build_model()
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        pipe = make_pipe(samples)
+        mgr = None
+        sentinel = None
+        on_step = None
+        if inject:
+            mgr = CheckpointManager(str(tmp_path / tag), keep=4,
+                                    executor=exe, main_program=main,
+                                    scope=scope, datapipe=pipe)
+            sentinel = Sentinel(manager=mgr, cadence=1, strikes=2,
+                                mark_good_after=1)
+
+            def on_step(step, fetches):
+                mgr.save(step)
+                sentinel.note_checkpoint(step)
+
+            # poison steps 5 and 6 (after=4, times=2): two consecutive
+            # strikes -> rollback
+            chaos.inject("sentinel.nan", after=4, times=2)
+        outs = exe.run_pipeline(main, pipe, fetch_list=[loss.name],
+                                scope=scope, sentinel=sentinel,
+                                on_step=on_step)
+        chaos.clear("sentinel.nan")
+        return outs, mgr, sentinel
+
+    def test_skip_quarantine_rollback_resume_same_loss(self, drill):
+        sentinel, mgr = drill["sentinel"], drill["mgr"]
+        # (i) the two poisoned steps were skipped + quarantined
+        assert drill["delta"]["sentinel.skipped_steps"] == 2
+        assert drill["delta"]["sentinel.quarantined"] == 2
+        bundles = sorted(os.listdir(sentinel.quarantine_dir))
+        assert len(bundles) == 2
+        # (ii) one rollback to the last known-good checkpoint
+        assert drill["delta"]["sentinel.rollbacks"] == 1
+        # rollback target: step 2 was the newest promoted known-good
+        # (step 3's promotion window was voided by the strikes)
+        assert mgr.last_good_step() is not None
+        # (iii) resumed and converged to the SAME losses: the rollback
+        # rewound params AND datapipe position, and run_pipeline dropped
+        # the rewound entries, so the returned list is the reference
+        # sequence — every batch applied exactly once, skipped/undone
+        # steps absent
+        ref_losses = [float(np.asarray(o[0]).reshape(-1)[0])
+                      for o in drill["ref_outs"]]
+        got_losses = [float(np.asarray(o[0]).reshape(-1)[0])
+                      for o in drill["got_outs"]]
+        assert len(ref_losses) == 10
+        np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-5)
+
+    def test_quarantine_bundle_replays_the_fault(self, drill):
+        sentinel = drill["sentinel"]
+        bundles = sorted(os.listdir(sentinel.quarantine_dir))
+        path = os.path.join(sentinel.quarantine_dir, bundles[0])
+        # the bundle is a self-contained pickle
+        with open(path, "rb") as f:
+            bundle = pickle.load(f)
+        assert bundle["reason"] == "non_finite" and bundle["injected"]
+        assert bundle["repro"]["feed"] and bundle["repro"]["state"]
+        # library replay reproduces the non-finite on CPU
+        report = replay_bundle(path)
+        assert report["reproduced"] and report["reason"] == "non_finite"
+        # ... and so does the CLI (exit 0 = reproduced)
+        assert cli.main(["replay", path]) == 0
+        assert cli.main(["replay", "--json", path]) == 0
+
+    def test_replay_clean_bundle_exits_nonzero(self, drill, tmp_path):
+        """A bundle whose step replays clean (fault not injected, math
+        fine) reports no repro — exit 1, the 'suspect hardware' verdict."""
+        sentinel = drill["sentinel"]
+        bundles = sorted(os.listdir(sentinel.quarantine_dir))
+        path = os.path.join(sentinel.quarantine_dir, bundles[0])
+        with open(path, "rb") as f:
+            bundle = pickle.load(f)
+        bundle["injected"] = False   # pretend the NaN came from the chip
+        clean = str(tmp_path / "clean.pkl")
+        with open(clean, "wb") as f:
+            pickle.dump(bundle, f, protocol=4)
+        assert cli.main(["replay", clean]) == 1
+        assert cli.main(["replay", str(tmp_path / "missing.pkl")]) == 2
+        # a truncated/garbage bundle is "malformed" (2) — never the
+        # "replayed clean, suspect hardware" verdict (1)
+        garbage = tmp_path / "garbage.pkl"
+        garbage.write_bytes(b"\x80\x04not a pickle")
+        assert cli.main(["replay", str(garbage)]) == 2
+
+    def test_replay_preserves_live_armed_failpoint(self, drill):
+        """Regression: in-process replay of an injected bundle used to
+        inject+clear sentinel.nan, silently clobbering (then disarming)
+        a live drill armed for a later step."""
+        sentinel = drill["sentinel"]
+        bundles = sorted(os.listdir(sentinel.quarantine_dir))
+        path = os.path.join(sentinel.quarantine_dir, bundles[0])
+        chaos.inject("sentinel.nan", after=100, times=3)   # live drill
+        report = replay_bundle(path)
+        assert report["reproduced"]
+        fp = chaos.swap("sentinel.nan", None)   # inspect AND disarm
+        assert fp is not None, "replay disarmed the live drill"
+        assert fp.after == 100 and fp.times == 3
+
+    def test_unreplayable_bundle_exits_two(self, drill, tmp_path):
+        """A bundle whose step cannot RE-EXECUTE (version skew, shape
+        drift) must exit 2 (unreplayable), never 1 — exit 1 is the
+        'replayed clean, suspect hardware' verdict automated triage
+        trusts."""
+        sentinel = drill["sentinel"]
+        bundles = sorted(os.listdir(sentinel.quarantine_dir))
+        path = os.path.join(sentinel.quarantine_dir, bundles[0])
+        with open(path, "rb") as f:
+            bundle = pickle.load(f)
+        # drift the feature width: re-execution dies inside the jitted
+        # step (a raw XLA shape error, not a bundle-load error)
+        feed = dict(bundle["repro"]["feed"])
+        feed["x"] = np.zeros((4, 3), "float32")
+        bundle["repro"] = dict(bundle["repro"], feed=feed)
+        skewed = str(tmp_path / "skewed.pkl")
+        with open(skewed, "wb") as f:
+            pickle.dump(bundle, f, protocol=4)
+        assert cli.main(["replay", skewed]) == 2
+
+    def test_loss_spike_bundle_replays(self, tmp_path):
+        """A deterministic loss spike (bad batch, finite values) must
+        reproduce under replay: the bundle carries the EMA baseline the
+        loss spiked against."""
+        main, startup, loss = build_model()
+        exe = fluid.Executor()
+        exe.run(startup)
+        s = Sentinel(cadence=1, strikes=99, spike_factor=0.5,
+                     spike_warmup=1, quarantine_dir=str(tmp_path))
+        # seed a baseline far below any real loss: the first step spikes
+        s._ema, s._ema_n = 1e-6, 5
+        with pytest.raises(NumericalFault) as ei:
+            exe.run(main, feed=_feed(1), fetch_list=[loss], sentinel=s)
+        assert ei.value.reason == "loss_spike"
+        path = s.quarantine(ei.value)
+        report = replay_bundle(path)
+        assert report["reproduced"] and report["reason"] == "loss_spike"
+        assert cli.main(["replay", path]) == 0
+
+    def test_rollback_exact_once_under_restart_renumbering(self, tmp_path):
+        """Regression: a restarted trainer renumbering its steps from 0
+        under a directory still holding a prior run's higher ckpt-N.
+        run_pipeline used to detect commits by diffing latest_step()
+        (the directory max, stuck at the stale N), so no rollback mark
+        was ever recorded and the rollback truncated the ENTIRE returned
+        list — the batches before the restore point never re-ran and
+        vanished from it.  Commit detection must key off the manager's
+        own in-process saves."""
+        samples = make_samples()
+        main, startup, loss = build_model()
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        # the prior run's leftover: a checkpoint numbered far above
+        # anything this loop will save
+        stale = CheckpointManager(str(tmp_path), keep=8, executor=exe,
+                                  main_program=main, scope=scope,
+                                  datapipe=make_pipe(samples))
+        stale.save(50)
+        pipe = make_pipe(samples)
+        mgr = CheckpointManager(str(tmp_path), keep=8, executor=exe,
+                                main_program=main, scope=scope,
+                                datapipe=pipe)
+        assert mgr.latest_step() == 50      # the trap this test locks
+        sentinel = Sentinel(manager=mgr, cadence=1, strikes=2,
+                            mark_good_after=1)
+
+        def on_step(step, fetches):
+            mgr.save(step)                  # renumbered from 0
+            sentinel.note_checkpoint(step)
+
+        chaos.inject("sentinel.nan", after=4, times=2)
+        outs = exe.run_pipeline(main, pipe, fetch_list=[loss.name],
+                                scope=scope, sentinel=sentinel,
+                                on_step=on_step)
+        chaos.clear("sentinel.nan")
+        # the ladder ran: rollback to one of THIS loop's checkpoints
+        assert mgr.last_good_step() is not None
+        assert mgr.last_good_step() < 50
+        # exactly-once: all 10 batches present (40 samples / batch 4),
+        # the rewound entries re-ran and re-appended
+        assert len(outs) == 10
+
+    def test_unrecoverable_without_manager_reraises(self, tmp_path):
+        samples = make_samples(16)
+        main, startup, loss = build_model()
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        exe.run(startup, scope=scope)
+        pipe = dp.InMemorySource(samples).batch(4)
+        sentinel = Sentinel(manager=None, cadence=1, strikes=1,
+                            spike_factor=None,
+                            quarantine_dir=str(tmp_path))
+        chaos.inject("sentinel.nan", times=1)
+        with pytest.raises(NumericalFault):
+            exe.run_pipeline(main, pipe, fetch_list=[loss.name],
+                             scope=scope, sentinel=sentinel)
+        # the fault was still quarantined on the way out
+        assert any(n.startswith("quarantine-")
+                   for n in os.listdir(str(tmp_path)))

@@ -377,3 +377,126 @@ class TestMicroBatcher:
                 b.submit({"nope": np.zeros((1, 4), "float32")}, timeout=5)
         finally:
             b.close()
+
+
+class TestBatcherCrashRecovery:
+    """An unexpected exception in the batcher thread must fail queued
+    requests fast (503-class error, not a hang until client timeout)
+    and restart the thread within a bounded budget."""
+
+    def test_crash_fails_pending_fast_and_restarts(self, model_dir):
+        from paddle_tpu.fault import chaos
+        from paddle_tpu.serving import BatcherCrashed
+        p = Predictor(model_dir)
+        b = MicroBatcher(p, max_batch_size=4, max_batch_delay=0.0)
+        try:
+            chaos.inject("serving.batcher.crash", times=1)
+            before = profiler.runtime_metrics.counter(
+                "serving.batcher_restarts")
+            t0 = time.monotonic()
+            with pytest.raises(BatcherCrashed):
+                # generous timeout: the crash path must beat it by a mile
+                b.submit({"x": np.zeros((1, 4), "float32")}, timeout=60)
+            assert time.monotonic() - t0 < 10, \
+                "pending request hung instead of failing on the crash"
+            assert profiler.runtime_metrics.counter(
+                "serving.batcher_restarts") == before + 1
+            # the restarted thread serves the next request normally
+            (out,) = b.submit({"x": np.zeros((1, 4), "float32")},
+                              timeout=60)
+            assert out.shape == (1, 2)
+        finally:
+            chaos.clear()
+            b.close()
+
+    def test_restart_budget_exhaustion_fails_fast(self, model_dir):
+        from paddle_tpu.fault import chaos
+        from paddle_tpu.serving import BatcherCrashed
+        p = Predictor(model_dir)
+        b = MicroBatcher(p, max_batch_delay=0.0, max_restarts=0)
+        try:
+            chaos.inject("serving.batcher.crash", times=1)
+            with pytest.raises(BatcherCrashed):
+                b.submit({"x": np.zeros((1, 4), "float32")}, timeout=60)
+            chaos.clear()
+            # no restart budget: the batcher is terminally down and
+            # sheds immediately instead of queueing into the void
+            t0 = time.monotonic()
+            with pytest.raises(BatcherCrashed):
+                b.submit({"x": np.zeros((1, 4), "float32")}, timeout=60)
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            chaos.clear()
+            b.close()
+
+    def test_restart_budget_refills_on_forward_progress(self, model_dir):
+        """Regression: the budget bounds CONSECUTIVE crashes, not
+        lifetime ones — a replica that fully recovers from each rare
+        crash must not drift into terminal failure over a long uptime."""
+        from paddle_tpu.fault import chaos
+        from paddle_tpu.serving import BatcherCrashed
+        p = Predictor(model_dir)
+        b = MicroBatcher(p, max_batch_delay=0.0, max_restarts=1)
+        try:
+            for _ in range(3):   # lifetime crashes > max_restarts
+                chaos.inject("serving.batcher.crash", times=1)
+                with pytest.raises(BatcherCrashed):
+                    b.submit({"x": np.zeros((1, 4), "float32")},
+                             timeout=60)
+                chaos.clear()
+                # a successful dispatch is forward progress: refill
+                (out,) = b.submit({"x": np.zeros((1, 4), "float32")},
+                                  timeout=60)
+                assert out.shape == (1, 2)
+            assert b.failed is None
+        finally:
+            chaos.clear()
+            b.close()
+
+    def test_terminal_batcher_death_flips_readyz(self, model_dir):
+        """Past the restart budget every /predict 503s forever — the
+        replica must stop reporting ready so a load balancer pulls it."""
+        from paddle_tpu.fault import chaos
+        from paddle_tpu.serving import InferenceServer
+        server = InferenceServer(model_dir, port=0, batching=True,
+                                 max_batch_delay=0.0)
+        server.start_background()
+        host, port = server.addr
+        try:
+            code, _ = _get(host, port, "/readyz")
+            assert code == 200
+            # default budget is 5: the 6th consecutive crash (no
+            # successful dispatch in between) is terminal
+            chaos.inject("serving.batcher.crash", times=6)
+            for _ in range(6):
+                code, body = _post(host, port, "/predict",
+                                   {"feeds": {"x": [[0.0] * 4]}})
+                assert code == 503
+            code, body = _get(host, port, "/readyz")
+            assert code == 500
+            assert body["error"]["type"] == "batcher_down"
+            assert body["retryable"] is False
+        finally:
+            chaos.clear()
+            server.shutdown()
+
+    def test_http_handler_maps_crash_to_retryable_503(self, model_dir):
+        from paddle_tpu.fault import chaos
+        from paddle_tpu.serving import InferenceServer
+        server = InferenceServer(model_dir, port=0, batching=True,
+                                 max_batch_delay=0.0)
+        server.start_background()
+        host, port = server.addr
+        try:
+            chaos.inject("serving.batcher.crash", times=1)
+            code, body = _post(host, port, "/predict",
+                               {"feeds": {"x": [[0.0] * 4]}})
+            assert code == 503 and body["retryable"] is True
+            assert body["error"]["type"] == "batcher_restarted"
+            # the replica recovered: the retry the 503 asks for works
+            code, body = _post(host, port, "/predict",
+                               {"feeds": {"x": [[0.0] * 4]}})
+            assert code == 200 and body["outputs"]
+        finally:
+            chaos.clear()
+            server.shutdown()
