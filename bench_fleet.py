@@ -1,0 +1,191 @@
+"""Fleet-scaling benchmark: aggregate RPS across router-fronted serving
+replicas, plus the chaos kill drill.
+
+Device work is MODELED WITH A SLEEP — the ``serving.predict`` failpoint
+(armed ``delay:SECS``) fires inside the predictor lock, so each replica
+behaves like one device that serves requests serially at a fixed
+service time while the GIL stays free.  On the 2-vCPU bench host that
+is the honest cost model: real per-replica accelerator time cannot be
+reproduced with CPU threads, but its queueing behavior can.  What the
+bench then measures is exactly the fleet capability: N replicas ≈ N
+devices' worth of aggregate throughput behind one router, and a
+hard-killed replica mid-load losing zero requests to failover.
+
+    python bench_fleet.py --clients 8 --duration 3 --out BENCH_FLEET.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+
+def build_model(dirname, feature_dim=4):
+    """A minimal fc model: the compute is deliberately negligible — the
+    armed ``serving.predict`` delay IS the device time."""
+    import paddle_tpu as fluid
+    import paddle_tpu.layers as layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[feature_dim])
+        pred = layers.fc(input=x, size=2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(dirname, ["x"], [pred], exe,
+                                      main_program=main)
+    return dirname
+
+
+def _percentile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q / 100.0 * len(xs)))]
+
+
+def run_fleet(model_dir, n_replicas, clients, duration, service_ms,
+              kill_mid_load=False, feature_dim=4):
+    """One fleet run: master + N replicas + router, closed-loop clients
+    for ``duration`` seconds; optionally hard-kill one replica mid-load
+    via the ``fleet.replica.kill`` failpoint.  Returns a stats dict."""
+    from paddle_tpu import profiler
+    from paddle_tpu.fault import RetryPolicy, chaos
+    from paddle_tpu.fleet import FleetReplica, FleetRouter
+    from paddle_tpu.parallel.master import MasterServer, MasterService
+    from paddle_tpu.serving import ServingClient
+
+    profiler.runtime_metrics.reset()
+    chaos.clear()
+    # the device-time model: one serialized sleep per dispatch
+    chaos.inject("serving.predict", delay=service_ms / 1000.0)
+    svc = MasterService(replica_ttl=5.0)
+    master = MasterServer(svc, port=0)
+    master.start_background()
+    maddr = f"{master.addr[0]}:{master.addr[1]}"
+    replicas = [
+        FleetReplica(model_dir, maddr, replica_id=f"r{i}",
+                     lease_ttl=5.0, heartbeat_interval=0.25,
+                     warmup=True, warmup_batch_sizes=(1,),
+                     request_timeout=30.0).start()
+        for i in range(n_replicas)]
+    router = FleetRouter(master_addr=maddr, poll_interval=0.1)
+    router.start_background()
+    try:
+        deadline = time.time() + 30
+        while len(router.live_replicas()) < n_replicas and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        payload = {"x": np.random.RandomState(0)
+                   .rand(1, feature_dim).astype("float32")}
+        warm = ServingClient(router.addr)
+        for _ in range(n_replicas * 2):  # touch every replica pre-clock
+            warm.predict(payload)
+
+        stats = [{"latencies": [], "failures": []}
+                 for _ in range(clients)]
+
+        def loop(out, stop_at):
+            client = ServingClient(
+                router.addr, deadline=30.0,
+                retry=RetryPolicy(max_attempts=8, base_delay=0.05,
+                                  max_delay=0.5, jitter="full"))
+            while time.monotonic() < stop_at:
+                t0 = time.perf_counter()
+                try:
+                    client.predict(payload)
+                    out["latencies"].append(time.perf_counter() - t0)
+                except Exception as e:       # a LOST request
+                    out["failures"].append(repr(e))
+
+        stop_at = time.monotonic() + duration
+        threads = [threading.Thread(target=loop,
+                                    args=(stats[i], stop_at))
+                   for i in range(clients)]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        if kill_mid_load:
+            time.sleep(duration * 0.4)
+            chaos.inject("fleet.replica.kill", error=True, times=1)
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t_start
+        lats = [x for s in stats for x in s["latencies"]]
+        failures = [f for s in stats for f in s["failures"]]
+        killed = [r.replica_id for r in replicas if r.killed]
+        return {
+            "replicas": n_replicas,
+            "clients": clients,
+            "requests_ok": len(lats),
+            "failures": len(failures),
+            "failure_samples": failures[:3],
+            "elapsed_sec": elapsed,
+            "rps": len(lats) / elapsed if elapsed > 0 else 0.0,
+            "latency_ms": {
+                "p50": (_percentile(lats, 50) or 0) * 1e3,
+                "p99": (_percentile(lats, 99) or 0) * 1e3,
+            },
+            "failovers": profiler.runtime_metrics.counter(
+                "fleet.failovers"),
+            "retries": profiler.runtime_metrics.counter("fleet.retries"),
+            "killed": killed,
+        }
+    finally:
+        chaos.clear()
+        for r in replicas:
+            if not r.killed:
+                r.drain()
+        router.shutdown()
+        master.shutdown()
+
+
+def run_bench(clients=8, duration=2.5, service_ms=30.0, model_dir=None,
+              scale_to=3):
+    """1 replica vs ``scale_to`` replicas over the same router, then the
+    kill drill at ``scale_to``; returns the JSON-ready summary."""
+    own = model_dir is None
+    if own:
+        model_dir = build_model(
+            tempfile.mkdtemp(prefix="ptfleet_") + "/model")
+    kw = dict(clients=clients, duration=duration, service_ms=service_ms)
+    one = run_fleet(model_dir, 1, **kw)
+    many = run_fleet(model_dir, scale_to, **kw)
+    drill = run_fleet(model_dir, scale_to, kill_mid_load=True, **kw)
+    scaling = many["rps"] / one["rps"] if one["rps"] else None
+    return {
+        "clients": clients,
+        "duration_sec": duration,
+        "service_ms": service_ms,
+        "fleet": {"1": one, str(scale_to): many},
+        "scaling": scaling,
+        "kill_drill": drill,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=2.5)
+    ap.add_argument("--service-ms", type=float, default=30.0)
+    ap.add_argument("--scale-to", type=int, default=3)
+    ap.add_argument("--out", default=None, help="write the JSON summary")
+    args = ap.parse_args(argv)
+    summary = run_bench(clients=args.clients, duration=args.duration,
+                        service_ms=args.service_ms,
+                        scale_to=args.scale_to)
+    text = json.dumps(summary, indent=2, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,7 +15,11 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              this host (jax.distributed)
   serve   --model DIR --port P               HTTP inference server
                                              (--batch --warmup
-                                             --compile-cache DIR)
+                                             --compile-cache DIR;
+                                             --master HOST:PORT enrolls
+                                             the replica in a fleet)
+  router  --master HOST:PORT --port P        health-aware fleet router
+                                             (or --replicas a,b,c)
   stats   --addr HOST:PORT                   runtime metrics snapshot of
                                              a serving replica (/stats);
                                              --local for this process;
@@ -127,7 +131,9 @@ def _cmd_master(args):
 
 
 def _cmd_serve(args):
-    """HTTP inference server over a saved model (L6 serving runtime)."""
+    """HTTP inference server over a saved model (L6 serving runtime).
+    With --master the replica enrolls in the serving fleet: register on
+    readiness, heartbeat-renew the lease, drain cleanly on SIGTERM."""
     from paddle_tpu.serving import serve
     if args.compile_cache:
         # before the predictor's Executor exists, so its compiles persist
@@ -135,13 +141,55 @@ def _cmd_serve(args):
     warmup_sizes = None
     if args.warmup_batch_sizes:
         warmup_sizes = [int(s) for s in args.warmup_batch_sizes.split(",")]
-    serve(args.model, host=args.host, port=args.port,
-          async_load=args.async_load, max_inflight=args.max_inflight,
-          request_timeout=args.request_timeout, batching=args.batch,
-          max_batch_size=args.max_batch_size,
-          max_batch_delay=args.max_batch_delay,
-          batch_queue_size=args.batch_queue_size, warmup=args.warmup,
-          warmup_batch_sizes=warmup_sizes)
+    server_kwargs = dict(
+        async_load=args.async_load,
+        max_inflight=args.max_inflight,
+        request_timeout=args.request_timeout, batching=args.batch,
+        max_batch_size=args.max_batch_size,
+        max_batch_delay=args.max_batch_delay,
+        batch_queue_size=args.batch_queue_size, warmup=args.warmup,
+        warmup_batch_sizes=warmup_sizes)
+    if args.master:
+        from paddle_tpu.fault import GracefulShutdown
+        from paddle_tpu.fleet import FleetReplica
+        replica = FleetReplica(args.model, args.master,
+                               replica_id=args.replica_id,
+                               host=args.host, port=args.port,
+                               lease_ttl=args.lease_ttl,
+                               advertise_host=args.advertise_host,
+                               **server_kwargs)
+        replica.start()
+        print(f"fleet replica {replica.replica_id} serving {args.model} "
+              f"on {replica.addr[0]}:{replica.addr[1]} "
+              f"(master {args.master})", flush=True)
+        # rolling restart contract: SIGTERM -> deregister (router stops
+        # routing), finish in-flight, release the lease, exit 0
+        with GracefulShutdown() as stop:
+            stop.wait()
+        replica.drain()
+        return 0
+    serve(args.model, host=args.host, port=args.port, **server_kwargs)
+    return 0
+
+
+def _cmd_router(args):
+    """Serve the health-aware fleet router (master-discovered or static
+    replica list)."""
+    from paddle_tpu.fleet import FleetRouter
+    replicas = [a for a in (args.replicas or "").split(",") if a]
+    router = FleetRouter(master_addr=args.master or None,
+                         replicas=replicas or None,
+                         host=args.host, port=args.port,
+                         default_deadline=args.default_deadline,
+                         poll_interval=args.poll_interval)
+    n = len(router.live_replicas())
+    print(f"fleet router on {router.addr[0]}:{router.addr[1]} "
+          f"({'master ' + args.master if args.master else 'static'}; "
+          f"{n} replica(s) live)", flush=True)
+    try:
+        router.serve_forever()
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -411,7 +459,36 @@ def main(argv=None):
                    help="persistent XLA compilation cache dir "
                         "(PADDLE_TPU_COMPILE_CACHE): restarts reuse "
                         "compiled executables instead of recompiling")
+    p.add_argument("--master", default=None,
+                   help="HOST:PORT of the fleet master: register this "
+                        "replica for discovery and heartbeat-renew its "
+                        "lease (SIGTERM drains cleanly)")
+    p.add_argument("--replica-id", default=None,
+                   help="stable replica id (default: generated)")
+    p.add_argument("--lease-ttl", type=float, default=5.0,
+                   help="fleet lease TTL seconds; missing renews this "
+                        "long drops the replica from routing")
+    p.add_argument("--advertise-host", default=None,
+                   help="host other machines should dial (default: the "
+                        "bind host)")
     p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("router", help="health-aware fleet router over "
+                                      "serving replicas")
+    p.add_argument("--master", default=None,
+                   help="HOST:PORT of the fleet master (live replica "
+                        "discovery)")
+    p.add_argument("--replicas", default=None,
+                   help="comma-separated host:port list (static fleet, "
+                        "no master)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8868)
+    p.add_argument("--default-deadline", type=float, default=30.0,
+                   help="end-to-end budget seconds for requests without "
+                        "an X-Deadline-Ms header")
+    p.add_argument("--poll-interval", type=float, default=0.25,
+                   help="master discovery poll interval seconds")
+    p.set_defaults(fn=_cmd_router)
 
     p = sub.add_parser("stats", help="fetch a serving replica's /stats "
                                      "metrics snapshot")

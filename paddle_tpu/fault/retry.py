@@ -14,7 +14,7 @@ import random
 import time
 
 __all__ = ["RetryPolicy", "RetryError", "retrying", "DEFAULT_RPC_POLICY",
-           "parse_hostport"]
+           "parse_hostport", "parse_deadline_ms"]
 
 
 def parse_hostport(addr):
@@ -28,13 +28,34 @@ def parse_hostport(addr):
     return host, int(port)
 
 
+def parse_deadline_ms(value):
+    """Seconds of budget from an ``X-Deadline-Ms`` header value, or
+    None when absent/blank — the shared deadline convention of the
+    serving/fleet HTTP surface.  Raises ValueError on anything
+    non-finite: nan compares False everywhere and inf breaks int()
+    downstream, so both must be rejected at the edge, identically by
+    every consumer."""
+    import math
+    value = (value or "").strip()
+    if not value:
+        return None
+    budget = float(value) / 1000.0   # ValueError on garbage propagates
+    if not math.isfinite(budget):
+        raise ValueError(f"non-finite deadline {value!r}")
+    return budget
+
+
 class RetryError(RuntimeError):
     """All attempts exhausted (or deadline hit); ``.last`` is the final
-    underlying exception, also chained as ``__cause__``."""
+    underlying exception, also chained as ``__cause__``.  ``.history``
+    is the per-attempt context trail (e.g. the replica each attempt hit,
+    attached by failover callers like ``FleetRouter``/``ServingClient``)
+    — empty when the caller recorded none."""
 
-    def __init__(self, message, last):
+    def __init__(self, message, last, history=None):
         super().__init__(message)
         self.last = last
+        self.history = list(history) if history else []
 
 
 class RetryPolicy:

@@ -1,0 +1,33 @@
+"""Multi-replica serving fleet: master-backed discovery, health-aware
+routing, and chaos-proof failover.
+
+The reference framework's production story is a *cluster* — trainers
+and pservers coordinated by a Go master with leases and fault
+tolerance (``go/master/service.go``).  This package re-aims that
+machinery at inference:
+
+- :class:`~paddle_tpu.fleet.replica.FleetReplica` — an
+  :class:`~paddle_tpu.serving.InferenceServer` that registers with the
+  master on readiness and renews a TTL lease via heartbeat; an expired
+  lease = unhealthy, dropped from the routing table, and `/readyz`
+  answers ``503 lease_lost`` while the process is alive.
+- :class:`~paddle_tpu.fleet.router.FleetRouter` — a thin front-end
+  that discovers live replicas from the master, spreads traffic by
+  least-outstanding requests, and retries failed attempts on a
+  *different* replica under a full-jitter
+  :class:`~paddle_tpu.fault.RetryPolicy`, bounded end to end by the
+  caller's ``X-Deadline-Ms`` budget; ``X-Request-Id`` makes one
+  request traceable across replicas.
+- the client-side alternative: ``ServingClient(master=...)`` (or a
+  list of addresses) balances and fails over without a router hop.
+
+See ``docs/serving_fleet.md`` for topology, failover semantics, the
+rolling-restart runbook, and the chaos drills.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.fleet.replica import FleetReplica
+from paddle_tpu.fleet.router import FleetRouter
+
+__all__ = ["FleetReplica", "FleetRouter"]

@@ -1,0 +1,478 @@
+"""Health-aware HTTP router over a fleet of serving replicas.
+
+A thin front-end: discovers live replicas from the master's lease table
+(or a static list), spreads `/predict` traffic by least-outstanding
+requests, and on connection failure / retryable 503 / lease expiry
+retries the request on a *different* replica under a
+:class:`~paddle_tpu.fault.RetryPolicy` with full jitter — bounded end
+to end by the caller's deadline, which rides the ``X-Deadline-Ms``
+header into the replica's own :class:`MicroBatcher` timeout so a
+failover chain can never spend more than the original budget.  The
+caller's ``X-Request-Id`` (minted here when absent) is forwarded on
+every attempt, making one request traceable across replicas in their
+``/trace`` rings.
+
+The router holds no model state and does no JSON re-encoding of predict
+bodies — request and reply bytes pass through verbatim — so it stays
+cheap enough to front many replicas from one process.
+
+Failpoints: ``fleet.route.blackhole`` fires per forward attempt (armed
+``error`` turns the attempt into a connection failure — the drill for a
+partitioned replica the lease hasn't expired yet).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+
+from paddle_tpu.obs import trace as _trace
+from paddle_tpu.obs.trace import span as _span
+
+__all__ = ["FleetRouter"]
+
+
+class _NoReplicas(ConnectionError):
+    """No live replica to route to (retryable: one may re-register)."""
+
+
+class _Transient(ConnectionError):
+    """Upstream replied retryable (503/504-class): fail over."""
+
+
+class _DeadlineExhausted(RuntimeError):
+    """The caller's end-to-end budget ran out (non-retryable)."""
+
+
+class FleetRouter:
+    """Route `/predict` across replicas with health-aware failover.
+
+    ``master_addr`` enables discovery from
+    :meth:`MasterService.list_replicas` (polled every
+    ``poll_interval``); ``replicas`` is the static-list alternative.
+    ``retry`` defaults to full-jitter exponential backoff; the
+    effective deadline per request is the caller's ``X-Deadline-Ms``
+    when present, else ``default_deadline`` seconds.
+    """
+
+    def __init__(self, master_addr=None, replicas=None, host="127.0.0.1",
+                 port=0, retry=None, poll_interval=0.25,
+                 default_deadline=30.0, attempt_timeout=30.0,
+                 down_cooldown=1.0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from paddle_tpu.fault.retry import RetryPolicy, parse_hostport
+        if master_addr is None and not replicas:
+            raise ValueError("FleetRouter needs master_addr or replicas")
+        self._master_addr = master_addr
+        self._master = None
+        self._retry = retry or RetryPolicy(
+            max_attempts=6, base_delay=0.05, max_delay=0.5, jitter="full")
+        self._default_deadline = float(default_deadline)
+        self._attempt_timeout = float(attempt_timeout)
+        self._down_cooldown = float(down_cooldown)
+        self._poll_interval = float(poll_interval)
+        self._lock = threading.Lock()
+        # addr ("host:port") -> per-replica health/load book-keeping
+        self._table = {}
+        for a in replicas or []:
+            h, p = parse_hostport(a)
+            self._table[f"{h}:{p}"] = self._fresh_entry(f"{h}:{p}")
+        self._static = master_addr is None
+        self._stop = threading.Event()
+        # per-handler-thread keep-alive connections to replicas (the
+        # replica side speaks HTTP/1.1 exactly so the router does not
+        # pay a TCP handshake + server thread spawn per forwarded
+        # request); entries die with their handler thread
+        self._tl = threading.local()
+        # last N failovers: (request_id, failed addrs..., served-by) —
+        # the drill's evidence that a specific request changed replicas
+        self.failover_log = collections.deque(maxlen=256)
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply_raw(self, code, body, content_type):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                rid = getattr(self, "_request_id", None)
+                if rid:
+                    self.send_header("X-Request-Id", rid)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply(self, code, obj):
+                self._reply_raw(code, json.dumps(obj).encode(),
+                                "application/json")
+
+            def _error(self, code, etype, message, retryable,
+                       **extra):
+                body = {"error": {"type": etype, "message": message},
+                        "retryable": retryable}
+                body.update(extra)
+                self._reply(code, body)
+
+            def do_GET(self):
+                self._request_id = (self.headers.get("X-Request-Id")
+                                    or "").strip() or None
+                if self.path in ("/health", "/healthz"):
+                    self._reply(200, {"status": "ok"})
+                elif self.path == "/readyz":
+                    n = len(router.live_replicas())
+                    if n > 0:
+                        self._reply(200, {"status": "ready",
+                                          "replicas": n})
+                    else:
+                        self._error(503, "no_replicas",
+                                    "no live replicas in the routing "
+                                    "table", retryable=True)
+                elif self.path == "/replicas":
+                    self._reply(200, {"replicas": router.table()})
+                elif self.path == "/stats":
+                    from paddle_tpu import profiler as _profiler
+                    snap = _profiler.runtime_metrics.snapshot()
+                    snap["router"] = {
+                        "replicas": router.table(),
+                        "failovers": [list(f) for f in
+                                      router.failover_log],
+                    }
+                    self._reply(200, snap)
+                elif self.path == "/metrics":
+                    from paddle_tpu.obs import prom as _prom
+                    self._reply_raw(
+                        200, _prom.render_prometheus().encode(),
+                        _prom.CONTENT_TYPE)
+                elif self.path == "/trace":
+                    self._reply_raw(200,
+                                    _trace.dump_chrome_trace().encode(),
+                                    "application/json")
+                else:
+                    self._error(404, "not_found", self.path,
+                                retryable=False)
+
+            def do_POST(self):
+                from paddle_tpu.fault.retry import parse_deadline_ms
+                self._request_id = (self.headers.get("X-Request-Id")
+                                    or "").strip() or _trace.new_trace_id()
+                if "Content-Length" not in self.headers:
+                    # no declared length (absent or chunked): the body
+                    # can't be read, so don't burn a routed attempt
+                    # delivering an empty one — reject here
+                    self.close_connection = True
+                    self._error(411, "length_required",
+                                "POST requires Content-Length",
+                                retryable=False)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(n)
+                except ValueError:
+                    self.close_connection = True
+                    self._error(400, "bad_request",
+                                "invalid Content-Length header",
+                                retryable=False)
+                    return
+                if self.path not in ("/predict", "/run"):
+                    self._error(404, "not_found", self.path,
+                                retryable=False)
+                    return
+                try:
+                    budget = parse_deadline_ms(
+                        self.headers.get("X-Deadline-Ms"))
+                except ValueError:
+                    self._error(400, "bad_request",
+                                f"invalid X-Deadline-Ms header: "
+                                f"{self.headers.get('X-Deadline-Ms')!r}",
+                                retryable=False)
+                    return
+                if budget is None:
+                    budget = router._default_deadline
+                code, body, ctype = router.route(
+                    self.path, raw, self._request_id, budget)
+                self._reply_raw(code, body, ctype)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.addr = self._server.server_address
+        self._poll_thread = None
+        if not self._static:
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, daemon=True,
+                name="fleet-router-discovery")
+            self._poll_thread.start()
+
+    # -- routing table -----------------------------------------------------
+    @staticmethod
+    def _fresh_entry(addr, replica_id=None):
+        return {"id": replica_id or addr, "addr": addr, "outstanding": 0,
+                "requests": 0, "failures": 0, "down_until": 0.0}
+
+    def _poll_loop(self):
+        while not self._stop.wait(self._poll_interval):
+            self.refresh()
+
+    def refresh(self):
+        """One discovery pass against the master (no-op in static
+        mode): live leases enter the table, expired ones leave it."""
+        from paddle_tpu import profiler as _profiler
+        if self._static:
+            return
+        try:
+            if self._master is None:
+                from paddle_tpu.parallel.master import MasterClient
+                self._master = MasterClient(self._master_addr)
+            live = self._master.list_replicas()
+        except Exception:
+            return  # master blip: keep routing on the current table
+        with self._lock:
+            seen = set()
+            for rec in live:
+                addr = rec["addr"]
+                seen.add(addr)
+                entry = self._table.get(addr)
+                if entry is None:
+                    self._table[addr] = self._fresh_entry(addr, rec["id"])
+                else:
+                    entry["id"] = rec["id"]
+            for addr in [a for a in self._table if a not in seen]:
+                del self._table[addr]
+            _profiler.runtime_metrics.set_gauge("fleet.replicas_live",
+                                                len(self._table))
+
+    def live_replicas(self):
+        """Addresses currently eligible for new traffic."""
+        now = time.monotonic()
+        with self._lock:
+            return [a for a, e in self._table.items()
+                    if e["down_until"] <= now]
+
+    def table(self):
+        """Per-replica health/load snapshot (the `/replicas` body)."""
+        now = time.monotonic()
+        with self._lock:
+            return {a: {"id": e["id"], "outstanding": e["outstanding"],
+                        "requests": e["requests"],
+                        "failures": e["failures"],
+                        "down": e["down_until"] > now}
+                    for a, e in self._table.items()}
+
+    def _pick(self, tried):
+        """Least-outstanding live replica, preferring one not yet tried
+        by THIS request; falls back to tried replicas only when every
+        live one has failed this chain (single-replica fleets still
+        retry)."""
+        now = time.monotonic()
+        with self._lock:
+            live = [(e["outstanding"], a) for a, e in self._table.items()
+                    if e["down_until"] <= now]
+            if not live:
+                # every replica is cooling down: routing to a maybe-dead
+                # replica beats refusing while the table is non-empty
+                live = [(e["outstanding"], a)
+                        for a, e in self._table.items()]
+        if not live:
+            raise _NoReplicas("no live replicas in the routing table")
+        untried = [(o, a) for o, a in live if a not in tried]
+        pool = untried or live
+        # random tie-break: a deterministic (outstanding, addr) sort
+        # would pin ALL low-concurrency traffic to the smallest address
+        import random
+        random.shuffle(pool)
+        pool.sort(key=lambda e: e[0])
+        return pool[0][1]
+
+    def _mark_down(self, addr):
+        """Short cooldown after a connection-level failure, so the hot
+        path stops picking a dead replica before the lease expires."""
+        with self._lock:
+            e = self._table.get(addr)
+            if e is not None:
+                e["failures"] += 1
+                e["down_until"] = time.monotonic() + self._down_cooldown
+
+    # -- request path ------------------------------------------------------
+    def route(self, path, raw, request_id, budget):
+        """Forward one request; returns ``(status, body, content_type)``.
+        Every terminal failure the router *generates* is a structured
+        retryable error — the client's own policy decides what to do."""
+        from paddle_tpu import profiler as _profiler
+        from paddle_tpu.fault.retry import RetryError
+        deadline_at = time.monotonic() + budget
+        tried = []
+        t0 = time.perf_counter()
+
+        def attempt():
+            remaining = deadline_at - time.monotonic()
+            if remaining <= 0:
+                raise _DeadlineExhausted(
+                    f"deadline ({budget * 1e3:.0f}ms) exhausted after "
+                    f"{len(tried)} attempt(s)")
+            addr = self._pick(tried)
+            tried.append(addr)
+            with _span("fleet.attempt", replica=addr,
+                       attempt=len(tried)):
+                return self._forward(addr, path, raw, request_id,
+                                     remaining)
+
+        def on_retry(attempt_no, exc, delay):
+            _profiler.runtime_metrics.inc("fleet.retries")
+
+        try:
+            with _trace.trace_context(request_id), \
+                    _span("fleet.request", request_id=request_id,
+                          path=path):
+                status, body, ctype = self._retry.call(
+                    attempt, on_retry=on_retry, deadline=budget)
+            if status == 200:
+                _profiler.runtime_metrics.inc("fleet.requests_ok")
+                if len(tried) > 1:
+                    # the request changed replicas and still completed:
+                    # the headline failover event, logged for forensics
+                    _profiler.runtime_metrics.inc("fleet.failovers")
+                    self.failover_log.append(
+                        (request_id, *tried))
+            return status, body, ctype
+        except _DeadlineExhausted as e:
+            _profiler.runtime_metrics.inc("fleet.shed")
+            return self._shed(504, "deadline_exceeded", str(e), tried)
+        except RetryError as e:
+            e.history = list(tried)
+            _profiler.runtime_metrics.inc("fleet.shed")
+            if isinstance(e.last, _NoReplicas):
+                return self._shed(503, "no_replicas", str(e.last), tried)
+            return self._shed(503, "upstream_unavailable",
+                              f"all failover attempts failed: {e.last}",
+                              tried)
+        except _NoReplicas as e:
+            _profiler.runtime_metrics.inc("fleet.shed")
+            return self._shed(503, "no_replicas", str(e), tried)
+        finally:
+            _profiler.runtime_metrics.observe(
+                "fleet.request_seconds", time.perf_counter() - t0)
+
+    @staticmethod
+    def _shed(code, etype, message, tried):
+        body = json.dumps({"error": {"type": etype, "message": message},
+                           "retryable": True,
+                           "replicas_tried": list(tried)}).encode()
+        return code, body, "application/json"
+
+    def _pooled_conn(self, addr, timeout):
+        """(reused, conn): this handler thread's keep-alive connection
+        to ``addr``, or a fresh one.  The per-attempt timeout is applied
+        to the live socket on reuse."""
+        import http.client
+
+        from paddle_tpu.fault.retry import parse_hostport
+        pool = getattr(self._tl, "conns", None)
+        if pool is None:
+            pool = self._tl.conns = {}
+        conn = pool.get(addr)
+        if conn is not None:
+            conn.timeout = timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            return True, conn
+        host, port = parse_hostport(addr)
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        pool[addr] = conn
+        return False, conn
+
+    def _drop_conn(self, addr):
+        pool = getattr(self._tl, "conns", None)
+        conn = pool.pop(addr, None) if pool else None
+        if conn is not None:
+            conn.close()
+
+    def _forward(self, addr, path, raw, request_id, remaining):
+        """One proxied attempt.  Success and PERMANENT upstream errors
+        pass through verbatim; retryable upstream errors and transport
+        failures raise (the policy fails the request over)."""
+        import http.client
+
+        from paddle_tpu.fault import chaos
+        try:
+            chaos.fire("fleet.route.blackhole", replica=addr)
+        except chaos.FaultInjected as e:
+            self._mark_down(addr)
+            raise _Transient(f"route to {addr} blackholed") from e
+        with self._lock:
+            entry = self._table.get(addr)
+            if entry is not None:
+                entry["outstanding"] += 1
+                entry["requests"] += 1
+        timeout = min(remaining, self._attempt_timeout)
+        headers = {
+            "Content-Type": "application/json",
+            "X-Request-Id": request_id,
+            # the REMAINING budget, not the original: replicas bound
+            # their batcher wait by what the caller has left
+            "X-Deadline-Ms": str(int(remaining * 1000)),
+        }
+        try:
+            for retry_fresh in (False, True):
+                reused, conn = self._pooled_conn(addr, timeout)
+                try:
+                    conn.request("POST", path, raw, headers)
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    status = resp.status
+                    if resp.will_close:
+                        self._drop_conn(addr)
+                    break
+                except (OSError, http.client.HTTPException) as e:
+                    self._drop_conn(addr)
+                    if reused and not retry_fresh:
+                        # a stale keep-alive connection (replica idled
+                        # it out) must not read as replica death: one
+                        # fresh-connection retry against the SAME
+                        # replica before declaring it unreachable
+                        continue
+                    self._mark_down(addr)
+                    raise ConnectionError(
+                        f"replica {addr} unreachable: {e}") from e
+        finally:
+            with self._lock:
+                entry = self._table.get(addr)
+                if entry is not None:
+                    entry["outstanding"] = max(
+                        0, entry["outstanding"] - 1)
+        if status == 200:
+            return status, body, "application/json"
+        try:
+            parsed = json.loads(body)
+        except ValueError:
+            parsed = {"retryable": status in (502, 503, 504)}
+        if parsed.get("retryable"):
+            err = parsed.get("error") or {}
+            raise _Transient(
+                f"replica {addr} replied {status} "
+                f"{err.get('type', 'retryable')}: "
+                f"{err.get('message', '')}")
+        # permanent upstream error (400 bad feed, 500 model bug): the
+        # caller must see it unchanged — failing over would just repeat
+        # the same error on a healthy replica
+        return status, body, "application/json"
+
+    # -- lifecycle ---------------------------------------------------------
+    def start_background(self):
+        t = threading.Thread(target=self._server.serve_forever,
+                             daemon=True, name="fleet-router")
+        t.start()
+        return t
+
+    def serve_forever(self):
+        self._server.serve_forever()
+
+    def shutdown(self):
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+        if self._master is not None:
+            self._master.close()

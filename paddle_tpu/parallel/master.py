@@ -28,6 +28,7 @@ __all__ = ["Task", "MasterService", "partition_files",
 
 DEFAULT_TIMEOUT = 60.0
 DEFAULT_FAILURE_MAX = 3
+DEFAULT_REPLICA_TTL = 10.0
 
 
 class Task:
@@ -66,11 +67,17 @@ def partition_files(paths, chunks_per_task=1):
 class MasterService:
     def __init__(self, tasks=None, timeout=DEFAULT_TIMEOUT,
                  failure_max=DEFAULT_FAILURE_MAX, snapshot_path=None,
-                 heartbeat_timeout=None):
+                 heartbeat_timeout=None, replica_ttl=DEFAULT_REPLICA_TTL):
         self._lock = threading.Lock()
         self.timeout = timeout
         self.failure_max = failure_max
         self.heartbeat_timeout = heartbeat_timeout
+        self.replica_ttl = replica_ttl
+        # serving-fleet discovery: replica_id -> lease record.  Leases
+        # are deliberately ephemeral (never snapshotted): a restarted
+        # master knows nothing about replica health, so replicas simply
+        # re-register on their next heartbeat cycle.
+        self._replicas = {}
         self.snapshot_path = snapshot_path
         self.todo = list(tasks or [])
         self.pending = {}            # task_id -> (Task, deadline)
@@ -170,10 +177,88 @@ class MasterService:
 
     def stats(self):
         with self._lock:
+            now = time.time()
             return {"todo": len(self.todo), "pending": len(self.pending),
                     "done": len(self.done),
                     "dropped": len(self.failed_drop),
-                    "trainers": len(self._trainer_seen)}
+                    "trainers": len(self._trainer_seen),
+                    # expired-but-unpruned leases are NOT live replicas
+                    "replicas": sum(1 for r in self._replicas.values()
+                                    if r["expires"] >= now)}
+
+    # -- serving-fleet discovery (lease-based replica health) -------------
+    #
+    # The trainer-side lease machinery above re-aimed at inference: a
+    # serving replica registers its address on startup, renews the lease
+    # on every heartbeat, and is dropped from the routing table the
+    # moment the lease expires (a silent replica IS a dead replica, the
+    # router never has to probe it).
+
+    def register_replica(self, replica_id, addr, ttl=None, meta=None):
+        """Enroll (or re-enroll) a serving replica at ``addr`` with a
+        lease of ``ttl`` seconds.  Returns the lease terms; the replica
+        must :meth:`renew_replica` within ``ttl`` or it is dropped from
+        :meth:`list_replicas`.  Re-registering bumps the lease epoch
+        (late renews from a previous incarnation are then rejected)."""
+        ttl = float(ttl if ttl is not None else self.replica_ttl)
+        if ttl <= 0:
+            raise ValueError(f"replica ttl must be > 0, got {ttl}")
+        with self._lock:
+            prev = self._replicas.get(replica_id)
+            epoch = (prev["epoch"] + 1) if prev else 1
+            self._replicas[replica_id] = {
+                "id": replica_id, "addr": str(addr),
+                "meta": dict(meta or {}), "ttl": ttl,
+                "expires": time.time() + ttl, "epoch": epoch,
+            }
+            return {"epoch": epoch, "ttl": ttl}
+
+    def renew_replica(self, replica_id, epoch=None):
+        """Heartbeat-renew a replica lease.  Returns False when the
+        lease is unknown, already expired, or from a stale epoch — the
+        replica is (or just became) invisible to the router and must
+        re-register before taking traffic again."""
+        from paddle_tpu.fault import chaos
+        try:
+            # armed drill: the master force-expires this lease as if the
+            # TTL ran out — the replica sees lease_lost while perfectly
+            # alive, exactly the split-brain /readyz must surface
+            chaos.fire("master.lease.expire", replica_id=replica_id)
+        except chaos.FaultInjected:
+            with self._lock:
+                self._replicas.pop(replica_id, None)
+            return False
+        with self._lock:
+            rec = self._replicas.get(replica_id)
+            now = time.time()
+            if rec is None or rec["expires"] < now or \
+                    (epoch is not None and epoch != rec["epoch"]):
+                if rec is not None and rec["expires"] < now:
+                    del self._replicas[replica_id]
+                return False
+            rec["expires"] = now + rec["ttl"]
+            return True
+
+    def deregister_replica(self, replica_id):
+        """Release a replica lease explicitly (the drain path of a
+        rolling restart: the router stops routing BEFORE the replica
+        stops accepting).  Returns False when the lease was already
+        gone."""
+        with self._lock:
+            return self._replicas.pop(replica_id, None) is not None
+
+    def list_replicas(self):
+        """Live replicas (expired leases pruned), for router discovery:
+        ``[{id, addr, meta, epoch, expires_in}, ...]``."""
+        with self._lock:
+            now = time.time()
+            for rid in [rid for rid, rec in self._replicas.items()
+                        if rec["expires"] < now]:
+                del self._replicas[rid]
+            return [{"id": rec["id"], "addr": rec["addr"],
+                     "meta": dict(rec["meta"]), "epoch": rec["epoch"],
+                     "expires_in": round(rec["expires"] - now, 3)}
+                    for rec in self._replicas.values()]
 
     # -- internals ---------------------------------------------------------
     def _process_failed(self, task):
@@ -295,6 +380,18 @@ class _MasterRPCHandler(socketserver.StreamRequestHandler):
             return svc.reset_pass()
         if method == "stats":
             return svc.stats()
+        if method == "register_replica":
+            return svc.register_replica(params["replica_id"],
+                                        params["addr"],
+                                        ttl=params.get("ttl"),
+                                        meta=params.get("meta"))
+        if method == "renew_replica":
+            return svc.renew_replica(params["replica_id"],
+                                     epoch=params.get("epoch"))
+        if method == "deregister_replica":
+            return svc.deregister_replica(params["replica_id"])
+        if method == "list_replicas":
+            return svc.list_replicas()
         if method == "ping":
             return "pong"
         raise ValueError(f"unknown method {method!r}")
@@ -459,6 +556,21 @@ class MasterClient:
 
     def stats(self):
         return self._call("stats")
+
+    # -- serving-fleet discovery ------------------------------------------
+    def register_replica(self, replica_id, addr, ttl=None, meta=None):
+        return self._call("register_replica", replica_id=replica_id,
+                          addr=addr, ttl=ttl, meta=meta)
+
+    def renew_replica(self, replica_id, epoch=None):
+        return self._call("renew_replica", replica_id=replica_id,
+                          epoch=epoch)
+
+    def deregister_replica(self, replica_id):
+        return self._call("deregister_replica", replica_id=replica_id)
+
+    def list_replicas(self):
+        return self._call("list_replicas")
 
     def close(self):
         self._closed = True   # an in-flight retry can no longer redial

@@ -101,6 +101,12 @@ class Predictor:
             raise DeadlineExceeded(
                 f"predictor busy for more than {timeout}s")
         try:
+            # fires INSIDE the lock: a delay action models device time
+            # serialized per predictor (the one-device-per-replica cost
+            # model the fleet bench leans on); an error action models a
+            # dispatch failure
+            from paddle_tpu.fault import chaos as _chaos
+            _chaos.fire("serving.predict", feeds=len(feed))
             with self._fluid.scope_guard(self._scope):
                 outs = self._exe.run(self._program, feed=dict(feed),
                                      fetch_list=self._fetch_targets)
@@ -574,6 +580,11 @@ class InferenceServer:
         self._ready = threading.Event()
         self._load_done = threading.Event()  # set on success OR failure
         self._load_error = None
+        # master-backed fleet membership (set by fleet.FleetReplica):
+        # None = not fleet-managed, "held" = lease current, "lost" = the
+        # master expired our lease while this process is alive — /readyz
+        # then reports 503 lease_lost so the LB and the router agree
+        self.lease_state = None
         self._slots = threading.BoundedSemaphore(max_inflight)
         self._request_timeout = request_timeout
         self._batcher = None
@@ -682,6 +693,15 @@ class InferenceServer:
                         self._error(500, "batcher_down",
                                     f"batcher is down: {batcher.failed}",
                                     retryable=False)
+                    elif server.lease_state == "lost":
+                        # alive and loaded, but the master expired our
+                        # lease: the router already dropped us, so stop
+                        # reporting ready (retryable — re-registration
+                        # restores the lease without a process restart)
+                        self._error(503, "lease_lost",
+                                    "fleet lease expired; replica is "
+                                    "out of the routing table",
+                                    retryable=True)
                     elif server._ready.is_set():
                         self._reply(200, {"status": "ready"})
                     else:
@@ -752,6 +772,31 @@ class InferenceServer:
                 predictor = self._gate_ready()
                 if predictor is None:
                     return
+                # end-to-end deadline propagation: the caller's (or the
+                # router's) remaining budget arrives as X-Deadline-Ms and
+                # tightens the server-side timeout, so a retried request
+                # can never spend more than the original caller allowed
+                from paddle_tpu.fault.retry import parse_deadline_ms
+                timeout = server._request_timeout
+                try:
+                    budget = parse_deadline_ms(
+                        self.headers.get("X-Deadline-Ms"))
+                except ValueError:
+                    self._error(400, "bad_request",
+                                f"invalid X-Deadline-Ms header: "
+                                f"{self.headers.get('X-Deadline-Ms')!r}",
+                                retryable=False)
+                    return
+                if budget is not None:
+                    if budget <= 0:
+                        _profiler.runtime_metrics.inc(
+                            "serving.deadline_exceeded")
+                        self._error(504, "deadline_exceeded",
+                                    "caller deadline already expired",
+                                    retryable=True)
+                        return
+                    timeout = budget if timeout is None \
+                        else min(timeout, budget)
                 if not server._slots.acquire(blocking=False):
                     # saturated: shed load instead of queueing unboundedly
                     self._error(503, "overloaded",
@@ -762,7 +807,8 @@ class InferenceServer:
                     with _trace.trace_context(self._request_id), \
                             _span("serving.request",
                                   request_id=self._request_id,
-                                  path=self.path):
+                                  path=self.path,
+                                  port=server.addr[1]):
                         chaos.fire("serving.run", path=self.path)
                         req = json.loads(raw)
                         feed = {k: np.asarray(v, dtype="float32")
@@ -773,12 +819,11 @@ class InferenceServer:
                                 for k, v in req["feeds"].items()}
                         if server._batcher is not None:
                             outs = server._batcher.submit(
-                                feed, timeout=server._request_timeout)
+                                feed, timeout=timeout)
                         else:
                             with _span("serving.dispatch", size=1):
                                 outs = predictor.run(
-                                    feed,
-                                    timeout=server._request_timeout)
+                                    feed, timeout=timeout)
                         _profiler.runtime_metrics.inc(
                             "serving.requests_ok")
                     self._reply(200, {"outputs": [o.tolist() for o in outs],
@@ -854,7 +899,8 @@ class InferenceServer:
 
 
 class ServingClient:
-    """Retrying client for :class:`InferenceServer`.
+    """Retrying client for :class:`InferenceServer` — optionally a
+    client-side load balancer over a replica fleet.
 
     Transport failures AND replies the server marks ``retryable: true``
     (model still loading, load shedding, deadline exceeded) are retried
@@ -862,35 +908,148 @@ class ServingClient:
     errors raise :class:`ServingError` immediately.  This is the
     trainer/edge-side mirror of the master RPC retry path: a briefly
     unready or saturated server no longer kills the caller.
+
+    ``addr`` may be one ``host:port`` or a LIST of them: requests then
+    round-robin across the replicas and every retry prefers a replica
+    that has not failed this request yet (client-side failover).  With
+    ``master=`` the replica list is discovered live from a
+    :class:`paddle_tpu.parallel.master.MasterService` (lease-expired
+    replicas drop out on the next refresh).  Exhausted retries raise
+    :class:`paddle_tpu.fault.RetryError` with ``.history`` holding the
+    per-attempt replica bases — the forensic trail of a failed
+    failover chain.
+
+    Idempotency/traceability: every logical request carries ONE
+    ``X-Request-Id`` (the ambient trace id when set, else freshly
+    minted) across ALL its retry attempts, so replicas and the router
+    can recognize — and operators can trace — the same request as it
+    fails over.  Pre-dispatch connection errors (reset/refused before a
+    reply line) are always retryable: the server has not dispatched
+    anything, so re-sending is safe.
     """
 
-    def __init__(self, addr, retry=None, timeout=30.0):
+    def __init__(self, addr=None, retry=None, timeout=30.0, master=None,
+                 refresh_interval=1.0, deadline=None):
         from paddle_tpu.fault.retry import RetryPolicy, parse_hostport
-        host, port = parse_hostport(addr)
-        self._base = f"http://{host}:{port}"
+        if addr is None and master is None:
+            raise ValueError("ServingClient needs addr(s) or master=")
+        # end-to-end budget (seconds) for one LOGICAL request including
+        # every retry: each attempt ships the remaining budget as
+        # X-Deadline-Ms (the router forwards it, the replica's batcher
+        # bounds its wait by it) and the retry chain is cut when the
+        # budget can't cover the next backoff
+        self._deadline = None if deadline is None else float(deadline)
+        if addr is None:
+            addrs = []
+        elif isinstance(addr, list):
+            addrs = list(addr)
+        elif isinstance(addr, tuple) and len(addr) == 2 and \
+                (isinstance(addr[1], int) or str(addr[1]).isdigit()):
+            addrs = [addr]          # one (host, port) pair
+        elif isinstance(addr, tuple):
+            addrs = list(addr)      # a tuple OF addresses
+        else:
+            addrs = [addr]
+        self._bases = []
+        for a in addrs:
+            host, port = parse_hostport(a)
+            self._bases.append(f"http://{host}:{port}")
         self._timeout = timeout
         self._retry = retry or RetryPolicy(max_attempts=8, base_delay=0.1,
                                            max_delay=2.0, deadline=60.0)
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._master_addr = master
+        self._master = None
+        self._refresh_interval = float(refresh_interval)
+        self._refreshed_at = 0.0
+
+    # kept for back-compat introspection (single-replica callers)
+    @property
+    def _base(self):
+        bases = self._live_bases()
+        return bases[0] if bases else None
+
+    def _live_bases(self):
+        """Current replica bases, refreshing from the master when one is
+        configured and the cached list is stale (or empty)."""
+        if self._master_addr is None:
+            return list(self._bases)
+        now = time.monotonic()
+        with self._lock:
+            stale = now - self._refreshed_at > self._refresh_interval
+            cached = list(self._bases)
+        if not stale and cached:
+            return cached
+        try:
+            with self._lock:
+                if self._master is None:
+                    from paddle_tpu.parallel.master import MasterClient
+                    self._master = MasterClient(self._master_addr)
+                master = self._master
+            live = master.list_replicas()
+            from paddle_tpu.fault.retry import parse_hostport
+            bases = []
+            for rec in live:
+                host, port = parse_hostport(rec["addr"])
+                bases.append(f"http://{host}:{port}")
+            with self._lock:
+                self._bases = bases
+                self._refreshed_at = now
+            return bases
+        except Exception:
+            # master briefly unreachable: serve from the cached list —
+            # and back off (stamp the refresh time) so the request hot
+            # path doesn't re-dial the dead master on every attempt
+            with self._lock:
+                self._refreshed_at = now
+            return cached
+
+    def _pick_base(self, tried):
+        """Round-robin over live bases, preferring one not yet tried by
+        THIS request (failover targets a *different* replica while any
+        remain)."""
+        bases = self._live_bases()
+        if not bases:
+            raise ConnectionError("no live serving replicas")
+        with self._lock:
+            self._rr += 1
+            start = self._rr
+        untried = [b for b in bases if b not in tried]
+        pool = untried or bases
+        return pool[start % len(pool)]
 
     def _request(self, path, payload=None, retry=True):
         import urllib.error
         import urllib.request
+        from paddle_tpu.fault.retry import RetryError
+
+        # ONE id per logical request, reused verbatim by every retry
+        # attempt (idempotency key + the trace the failover chain shares)
+        rid = _trace.current_trace_id() or _trace.new_trace_id()
+        history = []
+        deadline_at = None if self._deadline is None \
+            else time.monotonic() + self._deadline
 
         def attempt():
-            headers = {"Content-Type": "application/json"}
-            rid = _trace.current_trace_id()
-            if rid:
-                # the caller's active trace follows the request across
-                # the wire; the server tags its spans with the same id
-                headers["X-Request-Id"] = rid
+            base = self._pick_base(history)
+            history.append(base)
+            headers = {"Content-Type": "application/json",
+                       "X-Request-Id": rid}
+            timeout = self._timeout
+            if deadline_at is not None:
+                remaining = max(deadline_at - time.monotonic(), 0.001)
+                headers["X-Deadline-Ms"] = str(int(remaining * 1000) or 1)
+                # one hung attempt must not outlive the logical budget
+                timeout = min(timeout, remaining)
             req = urllib.request.Request(
-                self._base + path,
+                base + path,
                 data=None if payload is None
                 else json.dumps(payload).encode(),
                 headers=headers)
             try:
                 with urllib.request.urlopen(
-                        req, timeout=self._timeout) as r:
+                        req, timeout=timeout) as r:
                     return json.loads(r.read())
             except urllib.error.HTTPError as e:
                 try:
@@ -907,9 +1066,19 @@ class ServingClient:
                                    err.get("message", str(e)),
                                    retryable=False) from e
             except urllib.error.URLError as e:
+                # pre-dispatch transport failure (refused/reset before a
+                # reply): nothing reached a batcher, re-sending under the
+                # same X-Request-Id is safe — always retryable
                 raise ConnectionError(str(e)) from e
 
-        return self._retry.call(attempt) if retry else attempt()
+        try:
+            if not retry:
+                return attempt()
+            # deadline=None falls back to the policy's own budget
+            return self._retry.call(attempt, deadline=self._deadline)
+        except RetryError as e:
+            e.history = list(history)
+            raise
 
     def predict(self, feeds):
         """feeds: dict name -> array-like; returns list of ndarrays."""
@@ -936,9 +1105,20 @@ class ServingClient:
         """The server's /metrics body: Prometheus text exposition of
         the runtime metrics registry (plain text, not JSON)."""
         import urllib.request
-        with urllib.request.urlopen(self._base + "/metrics",
+        base = self._base
+        if base is None:
+            raise ConnectionError("no live serving replicas")
+        with urllib.request.urlopen(base + "/metrics",
                                     timeout=self._timeout) as r:
             return r.read().decode()
+
+    def close(self):
+        """Release the master discovery connection (no-op without
+        ``master=``)."""
+        with self._lock:
+            master, self._master = self._master, None
+        if master is not None:
+            master.close()
 
     def healthy(self):
         """Single-shot liveness probe (no retries — probes must be cheap)."""

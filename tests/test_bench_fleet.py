@@ -1,0 +1,73 @@
+"""bench_fleet smoke: aggregate RPS must scale >= 1.7x from 1 to 3
+router-fronted replicas (device time modeled with sleeps per the 2-vCPU
+bench-host constraint), and the kill drill — hard-kill one replica
+mid-load — must lose zero requests.  BENCH_FLEET.json records the full
+acceptance run."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+import bench_fleet  # noqa: E402
+
+
+def _bench_with_retries(attempts, target_scaling, **kw):
+    """Best-of-N against noisy-neighbor CPU: external load can only
+    UNDERSTATE the scaling (the capability is queueing math over
+    sleeps), so one clean run suffices.  The kill drill's zero-lost
+    invariant must hold on EVERY attempt."""
+    last = None
+    for _ in range(attempts):
+        last = bench_fleet.run_bench(**kw)
+        assert last["kill_drill"]["failures"] == 0, last["kill_drill"]
+        if last["scaling"] is not None and \
+                last["scaling"] >= target_scaling:
+            return last
+    return last
+
+
+@pytest.fixture(scope="module")
+def smoke_summary():
+    return _bench_with_retries(3, 1.7, clients=6, duration=1.2,
+                               service_ms=30.0)
+
+
+def test_summary_schema(smoke_summary):
+    assert {"clients", "duration_sec", "service_ms", "fleet",
+            "scaling", "kill_drill"} <= set(smoke_summary)
+    for mode in ("1", "3"):
+        stats = smoke_summary["fleet"][mode]
+        assert {"rps", "requests_ok", "failures",
+                "latency_ms"} <= set(stats)
+        assert stats["requests_ok"] > 0
+
+
+def test_rps_scales_with_replicas(smoke_summary):
+    assert smoke_summary["scaling"] is not None
+    assert smoke_summary["scaling"] >= 1.7, smoke_summary
+
+
+def test_kill_drill_loses_zero_requests(smoke_summary):
+    drill = smoke_summary["kill_drill"]
+    assert drill["failures"] == 0, drill
+    assert len(drill["killed"]) == 1          # the failpoint fired once
+    assert drill["requests_ok"] > 0
+    # the kill was survived BY failover, not by luck: at least one
+    # request completed on a different replica than it first tried
+    assert drill["failovers"] >= 1, drill
+
+
+def test_healthy_modes_never_fail_over(smoke_summary):
+    for mode in ("1", "3"):
+        assert smoke_summary["fleet"][mode]["failures"] == 0
+    assert smoke_summary["fleet"]["1"]["killed"] == []
+
+
+@pytest.mark.slow
+def test_acceptance_full_run():
+    summary = _bench_with_retries(4, 1.7, clients=8, duration=3.0,
+                                  service_ms=30.0)
+    assert summary["scaling"] >= 1.7, summary
+    assert summary["kill_drill"]["failures"] == 0

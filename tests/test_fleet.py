@@ -1,0 +1,580 @@
+"""Serving-fleet tests: master-backed discovery, health-aware routing,
+deadline propagation, and the two acceptance drills — chaos kill
+(3 replicas under load, hard-kill one mid-flight, zero lost requests)
+and rolling restart (drain + warm-cache replacement, never below N-1
+ready replicas)."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+from paddle_tpu.fault import RetryError, RetryPolicy, chaos
+from paddle_tpu.fleet import FleetReplica, FleetRouter
+from paddle_tpu.obs import trace as _trace
+from paddle_tpu.parallel.master import (MasterServer, MasterService)
+from paddle_tpu.serving import InferenceServer, ServingClient
+
+FEED = {"x": np.ones((3, 4), "float32")}
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    """A tiny untrained fc model — fleet tests exercise routing, not
+    numerics, so skipping the training loop keeps the suite fast."""
+    d = str(tmp_path_factory.mktemp("fleet") / "model")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        pred = layers.fc(input=x, size=2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main)
+    return d
+
+
+@pytest.fixture()
+def master():
+    svc = MasterService(replica_ttl=1.0)
+    srv = MasterServer(svc, port=0)
+    srv.start_background()
+    yield svc, f"{srv.addr[0]}:{srv.addr[1]}"
+    srv.shutdown()
+
+
+def _start_replicas(model_dir, master_addr, n, **kw):
+    kw.setdefault("lease_ttl", 1.0)
+    kw.setdefault("heartbeat_interval", 0.15)
+    return [FleetReplica(model_dir, master_addr,
+                         replica_id=f"r{i}", **kw).start()
+            for i in range(n)]
+
+
+def _get(addr, path):
+    host, port = addr
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(addr, path, obj, headers=None):
+    host, port = addr
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}", data=json.dumps(obj).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestReplicaRegistry:
+    """MasterService's lease table re-aimed at serving replicas."""
+
+    def test_register_renew_expire_cycle(self):
+        svc = MasterService(replica_ttl=0.2)
+        lease = svc.register_replica("a", "127.0.0.1:1000")
+        assert lease == {"epoch": 1, "ttl": 0.2}
+        assert [r["id"] for r in svc.list_replicas()] == ["a"]
+        # renewing keeps it alive past the original TTL
+        for _ in range(3):
+            time.sleep(0.1)
+            assert svc.renew_replica("a") is True
+        assert svc.list_replicas()
+        # silence expires it — and a late renew is refused
+        time.sleep(0.3)
+        assert svc.list_replicas() == []
+        assert svc.renew_replica("a") is False
+
+    def test_stale_epoch_renew_rejected(self):
+        svc = MasterService(replica_ttl=5.0)
+        e1 = svc.register_replica("a", "127.0.0.1:1000")["epoch"]
+        e2 = svc.register_replica("a", "127.0.0.1:2000")["epoch"]
+        assert e2 == e1 + 1
+        # the old incarnation's renew must not keep the new lease alive
+        assert svc.renew_replica("a", epoch=e1) is False
+        assert svc.renew_replica("a", epoch=e2) is True
+        # the re-registration's address won
+        assert svc.list_replicas()[0]["addr"] == "127.0.0.1:2000"
+
+    def test_deregister_is_immediate(self):
+        svc = MasterService(replica_ttl=60.0)
+        svc.register_replica("a", "127.0.0.1:1000")
+        assert svc.deregister_replica("a") is True
+        assert svc.list_replicas() == []
+        assert svc.deregister_replica("a") is False
+
+    def test_lease_expire_failpoint_forces_loss(self):
+        svc = MasterService(replica_ttl=60.0)
+        svc.register_replica("a", "127.0.0.1:1000")
+        with chaos.scoped("master.lease.expire", error=True, times=1):
+            assert svc.renew_replica("a") is False
+        assert svc.list_replicas() == []
+        # re-registration recovers (the replica-side rejoin path)
+        svc.register_replica("a", "127.0.0.1:1000")
+        assert svc.renew_replica("a") is True
+
+    def test_replica_leases_not_snapshotted(self, tmp_path):
+        """Leases are ephemeral by design: a restarted master must not
+        resurrect replicas it cannot know are alive."""
+        snap = str(tmp_path / "master.json")
+        svc = MasterService(replica_ttl=60.0, snapshot_path=snap)
+        svc.register_replica("a", "127.0.0.1:1000")
+        svc.get_task()  # force a snapshot write
+        with open(snap) as f:
+            assert "replicas" not in json.load(f)
+        svc2 = MasterService(replica_ttl=60.0, snapshot_path=snap)
+        assert svc2.list_replicas() == []
+
+
+class TestLeaseReadyz:
+    def test_readyz_reports_lease_lost_then_rejoin(self, model_dir,
+                                                   master):
+        """Satellite: a replica whose lease expired while the process is
+        alive must answer 503 lease_lost — the router and the LB agree —
+        and auto-rejoin must restore 200 without a restart."""
+        svc, maddr = master
+        (rep,) = _start_replicas(model_dir, maddr, 1, auto_rejoin=False)
+        try:
+            assert _get(rep.addr, "/readyz")[0] == 200
+            with chaos.scoped("master.lease.expire", error=True, times=1):
+                deadline = time.time() + 5
+                while rep.server.lease_state != "lost" and \
+                        time.time() < deadline:
+                    time.sleep(0.05)
+            code, body = _get(rep.addr, "/readyz")
+            assert code == 503
+            assert body["error"]["type"] == "lease_lost"
+            assert body["retryable"] is True
+            assert svc.list_replicas() == []
+            # flip auto_rejoin back on: the next heartbeat re-registers
+            rep.auto_rejoin = True
+            deadline = time.time() + 5
+            while rep.server.lease_state != "held" and \
+                    time.time() < deadline:
+                time.sleep(0.05)
+            assert _get(rep.addr, "/readyz")[0] == 200
+            assert [r["id"] for r in svc.list_replicas()] == ["r0"]
+        finally:
+            rep.drain()
+
+
+class TestDeadlinePropagation:
+    def test_expired_deadline_is_immediate_504(self, model_dir):
+        server = InferenceServer(model_dir, port=0)
+        server.start_background()
+        try:
+            code, body = _post(server.addr, "/predict",
+                               {"feeds": {"x": FEED["x"].tolist()}},
+                               headers={"X-Deadline-Ms": "0"})
+            assert code == 504 and body["retryable"] is True
+            assert body["error"]["type"] == "deadline_exceeded"
+        finally:
+            server.shutdown()
+
+    def test_malformed_deadline_is_400(self, model_dir):
+        server = InferenceServer(model_dir, port=0)
+        server.start_background()
+        try:
+            code, body = _post(server.addr, "/predict",
+                               {"feeds": {"x": FEED["x"].tolist()}},
+                               headers={"X-Deadline-Ms": "soon"})
+            assert code == 400 and body["retryable"] is False
+        finally:
+            server.shutdown()
+
+    def test_deadline_bounds_batcher_wait(self, model_dir):
+        """X-Deadline-Ms flows into MicroBatcher's per-request timeout:
+        a request whose batch is stuck behind a slow dispatch gives up
+        by the CALLER's budget, not the server's (unset) timeout."""
+        server = InferenceServer(model_dir, port=0, batching=True,
+                                 request_timeout=None)
+        server.start_background()
+        try:
+            assert server.wait_until_ready(120)
+            _post(server.addr, "/predict",
+                  {"feeds": {"x": FEED["x"].tolist()}})  # compile out
+            chaos.inject("serving.predict", delay=1.5, times=1)
+            t0 = time.monotonic()
+            code, body = _post(server.addr, "/predict",
+                               {"feeds": {"x": FEED["x"].tolist()}},
+                               headers={"X-Deadline-Ms": "300"})
+            elapsed = time.monotonic() - t0
+            assert code == 504 and body["retryable"] is True
+            assert elapsed < 1.4, elapsed  # gave up well before 1.5s
+        finally:
+            chaos.clear()
+            server.shutdown()
+
+
+class TestClientBalancer:
+    def test_failover_to_live_replica(self, model_dir):
+        server = InferenceServer(model_dir, port=0)
+        server.start_background()
+        dead = "127.0.0.1:1"  # reserved port: immediate refusal
+        try:
+            client = ServingClient(
+                [dead, f"{server.addr[0]}:{server.addr[1]}"],
+                retry=RetryPolicy(max_attempts=4, base_delay=0.01,
+                                  jitter="full"))
+            for _ in range(4):  # every round-robin phase recovers
+                (out,) = client.predict(FEED)
+                assert out.shape == (3, 2)
+        finally:
+            server.shutdown()
+
+    def test_retry_error_carries_replica_history(self):
+        client = ServingClient(
+            ["127.0.0.1:1", "127.0.0.1:2"],
+            retry=RetryPolicy(max_attempts=3, base_delay=0.01,
+                              jitter="full"))
+        with pytest.raises(RetryError) as ei:
+            client.predict(FEED)
+        history = ei.value.history
+        assert len(history) == 3
+        assert set(history) == {"http://127.0.0.1:1",
+                                "http://127.0.0.1:2"}
+        # failover preferred the UNTRIED replica before repeating one
+        assert history[0] != history[1]
+
+    def test_pre_dispatch_reset_retried_under_one_request_id(
+            self, model_dir):
+        """Regression (satellite): a connection reset before any reply —
+        the request never reached a batcher — must be retried, and every
+        attempt must carry the SAME X-Request-Id so the retry chain is
+        idempotent and traceable."""
+        seen_ids = []
+
+        # a "replica" that reads the request, records X-Request-Id, and
+        # slams the connection with no reply (pre-dispatch reset)
+        resetter = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        resetter.bind(("127.0.0.1", 0))
+        resetter.listen(4)
+        stop = threading.Event()
+
+        def slam():
+            while not stop.is_set():
+                try:
+                    conn, _ = resetter.accept()
+                except OSError:
+                    return
+                try:
+                    data = conn.recv(65536).decode("latin-1")
+                    for line in data.split("\r\n"):
+                        if line.lower().startswith("x-request-id:"):
+                            seen_ids.append(line.split(":", 1)[1].strip())
+                finally:
+                    conn.close()
+
+        t = threading.Thread(target=slam, daemon=True)
+        t.start()
+        server = InferenceServer(model_dir, port=0)
+        server.start_background()
+        try:
+            reset_addr = "127.0.0.1:%d" % resetter.getsockname()[1]
+            _trace.enable()
+            _trace.clear()
+            client = ServingClient(
+                [reset_addr, f"{server.addr[0]}:{server.addr[1]}"],
+                retry=RetryPolicy(max_attempts=6, base_delay=0.01,
+                                  jitter="full"))
+            for _ in range(3):
+                (out,) = client.predict(FEED)
+                assert out.shape == (3, 2)
+            assert seen_ids, "the resetting replica never saw a request"
+            served = [sp["attrs"]["request_id"]
+                      for sp in _trace.snapshot_spans()
+                      if sp["name"] == "serving.request"]
+            # every id the dead replica saw was retried into a real
+            # serving.request on the live one — same id, zero drops
+            assert set(seen_ids) <= set(served)
+        finally:
+            stop.set()
+            resetter.close()
+            server.shutdown()
+            _trace.disable()
+            _trace.clear()
+
+
+class TestRouter:
+    def test_routes_and_passes_permanent_errors_through(self, model_dir,
+                                                        master):
+        svc, maddr = master
+        reps = _start_replicas(model_dir, maddr, 2)
+        router = FleetRouter(master_addr=maddr, poll_interval=0.05)
+        router.start_background()
+        try:
+            deadline = time.time() + 5
+            while len(router.live_replicas()) < 2 and \
+                    time.time() < deadline:
+                time.sleep(0.05)
+            code, body = _post(router.addr, "/predict",
+                               {"feeds": {"x": FEED["x"].tolist()}})
+            assert code == 200
+            assert np.asarray(body["outputs"][0]).shape == (3, 2)
+            # permanent 400 (bad feed name) is NOT failed over: the
+            # caller sees the replica's own structured error verbatim
+            code, body = _post(router.addr, "/predict",
+                               {"feeds": {"nope": [1.0]}})
+            assert code == 400 and body["retryable"] is False
+            code, body = _get(router.addr, "/readyz")
+            assert code == 200 and body["replicas"] == 2
+            code, body = _get(router.addr, "/stats")
+            assert "router" in body and len(body["router"]["replicas"]) == 2
+        finally:
+            for r in reps:
+                r.drain()
+            router.shutdown()
+
+    def test_no_replicas_is_retryable_503(self):
+        router = FleetRouter(replicas=["127.0.0.1:1"])
+        router._table.clear()  # empty static table
+        router.start_background()
+        try:
+            code, body = _post(router.addr, "/predict", {"feeds": {}})
+            assert code == 503 and body["retryable"] is True
+            assert body["error"]["type"] == "no_replicas"
+        finally:
+            router.shutdown()
+
+    def test_dead_fleet_bounded_by_caller_deadline(self):
+        """Satellite: the router's retry chain (full jitter) never
+        exceeds the caller's X-Deadline-Ms — it gives up with a
+        retryable error and the per-attempt replica trail."""
+        router = FleetRouter(replicas=["127.0.0.1:1", "127.0.0.1:2"],
+                             retry=RetryPolicy(max_attempts=50,
+                                               base_delay=0.02,
+                                               max_delay=0.1,
+                                               jitter="full"))
+        router.start_background()
+        try:
+            t0 = time.monotonic()
+            code, body = _post(router.addr, "/predict", {"feeds": {}},
+                               headers={"X-Deadline-Ms": "400"})
+            elapsed = time.monotonic() - t0
+            assert code in (503, 504)
+            assert body["retryable"] is True
+            assert elapsed < 1.5, elapsed  # 400ms budget + slack, not 50 tries
+            assert body["replicas_tried"], body
+            assert set(body["replicas_tried"]) <= {"127.0.0.1:1",
+                                                   "127.0.0.1:2"}
+        finally:
+            router.shutdown()
+
+    def test_blackhole_failpoint_fails_over(self, model_dir, master):
+        svc, maddr = master
+        reps = _start_replicas(model_dir, maddr, 2)
+        router = FleetRouter(master_addr=maddr, poll_interval=0.05)
+        router.start_background()
+        try:
+            deadline = time.time() + 5
+            while len(router.live_replicas()) < 2 and \
+                    time.time() < deadline:
+                time.sleep(0.05)
+            with chaos.scoped("fleet.route.blackhole", error=True,
+                              times=1):
+                code, _ = _post(router.addr, "/predict",
+                                {"feeds": {"x": FEED["x"].tolist()}})
+            assert code == 200  # first route blackholed, sibling served
+            assert len(router.failover_log) >= 1
+        finally:
+            for r in reps:
+                r.drain()
+            router.shutdown()
+
+
+class TestChaosDrillKillReplica:
+    """Acceptance drill: 3 replicas under closed-loop load,
+    fleet.replica.kill hard-kills one mid-flight — zero lost requests,
+    bounded p99, and the failed-over request's X-Request-Id shows up in
+    a surviving replica's /trace."""
+
+    @pytest.mark.chaos
+    def test_kill_one_replica_mid_load_loses_zero_requests(
+            self, model_dir, master):
+        svc, maddr = master
+        _trace.enable(65536)  # room for the whole drill's spans
+        _trace.clear()
+        # AOT-warm the drill's exact request shape so the measured
+        # window contains zero compiles (lease ttl generous: GIL-heavy
+        # in-process load must not flap leases and muddy the drill)
+        reps = _start_replicas(model_dir, maddr, 3, lease_ttl=3.0,
+                               warmup=True, warmup_batch_sizes=(3,))
+        router = FleetRouter(master_addr=maddr, poll_interval=0.05)
+        router.start_background()
+        stats = [{"latencies": [], "failures": []} for _ in range(6)]
+        try:
+            deadline = time.time() + 5
+            while len(router.live_replicas()) < 3 and \
+                    time.time() < deadline:
+                time.sleep(0.05)
+            assert len(router.live_replicas()) == 3
+            warm = ServingClient(router.addr)
+            for _ in range(6):  # touch every replica before the clock
+                warm.predict(FEED)
+
+            def loop(out, stop_at):
+                client = ServingClient(
+                    router.addr, deadline=10.0,
+                    retry=RetryPolicy(max_attempts=8, base_delay=0.05,
+                                      max_delay=0.5, jitter="full"))
+                while time.monotonic() < stop_at:
+                    t0 = time.perf_counter()
+                    try:
+                        client.predict(FEED)
+                        out["latencies"].append(
+                            time.perf_counter() - t0)
+                    except Exception as e:  # a LOST request
+                        out["failures"].append(repr(e))
+
+            stop_at = time.monotonic() + 2.5
+            threads = [threading.Thread(target=loop,
+                                        args=(stats[i], stop_at))
+                       for i in range(len(stats))]
+            for t in threads:
+                t.start()
+            time.sleep(0.8)  # mid-load: hard-kill exactly one replica
+            chaos.inject("fleet.replica.kill", error=True, times=1)
+            for t in threads:
+                t.join()
+            chaos.clear("fleet.replica.kill")
+
+            failures = [f for s in stats for f in s["failures"]]
+            lats = sorted(x for s in stats for x in s["latencies"])
+            assert not failures, failures[:5]       # zero lost requests
+            assert len(lats) > 50
+            killed = [r for r in reps if r.killed]
+            assert len(killed) == 1                  # the drill fired
+            p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+            assert p99 < 5.0, p99                    # p99 stays bounded
+            assert router.failover_log, "no failover was recorded"
+
+            # the failed-over request is traceable ON A SURVIVOR: its
+            # X-Request-Id appears in /trace served by the replica that
+            # completed it
+            survivor_ports = {r.addr[1] for r in reps if not r.killed}
+            survivor = next(r for r in reps if not r.killed)
+            tr = ServingClient(survivor.addr).trace()
+            served = {(ev["args"].get("request_id"),
+                       ev["args"].get("port"))
+                      for ev in tr["traceEvents"]
+                      if ev["name"] == "serving.request"}
+            assert any((rid, port) in served
+                       for rid, *chain in router.failover_log
+                       for port in survivor_ports), (
+                list(router.failover_log)[:3])
+            # eventually the lease expires and discovery prunes the dead
+            deadline = time.time() + 10
+            while len(router.live_replicas()) > 2 and \
+                    time.time() < deadline:
+                time.sleep(0.1)
+            assert len(router.live_replicas()) == 2
+        finally:
+            chaos.clear()
+            for r in reps:
+                if not r.killed:
+                    r.drain()
+            router.shutdown()
+            _trace.disable()
+            _trace.clear()
+
+
+class TestRollingRestartDrill:
+    """Acceptance drill: drain one replica and replace it with the
+    compile cache warm — the replacement flips /readyz without paying a
+    single fresh backend compile, and the fleet never drops below N-1
+    ready replicas."""
+
+    @pytest.mark.chaos
+    def test_drain_and_warm_replace(self, model_dir, master, tmp_path,
+                                    monkeypatch):
+        import jax
+
+        from paddle_tpu import profiler
+        from paddle_tpu.executor import disable_compile_cache
+
+        svc, maddr = master
+        monkeypatch.setenv("PADDLE_TPU_COMPILE_CACHE",
+                           str(tmp_path / "xla-cache"))
+        jax.clear_caches()  # cold start must MISS into the new cache
+        reps = _start_replicas(model_dir, maddr, 3, warmup=True)
+        replacement = None
+        min_ready = []
+        stop = threading.Event()
+        try:
+            assert len(svc.list_replicas()) == 3
+            misses0 = profiler.runtime_metrics.counter(
+                "compile_cache.misses")
+            hits0 = profiler.runtime_metrics.counter("compile_cache.hits")
+            assert misses0 > 0  # the cold fleet populated the cache
+
+            def monitor():
+                while not stop.wait(0.03):
+                    min_ready.append(len(svc.list_replicas()))
+
+            mon = threading.Thread(target=monitor, daemon=True)
+            mon.start()
+            # -- the rolling restart ---------------------------------
+            reps[0].drain()
+            # replacement process analog: every in-memory jit cache
+            # dropped, the persistent on-disk cache is all that's warm
+            jax.clear_caches()
+            replacement = FleetReplica(
+                model_dir, maddr, replica_id="r0b", lease_ttl=1.0,
+                heartbeat_interval=0.15, warmup=True).start()
+            stop.set()
+            mon.join()
+            # ready the moment it registered — and it compiled NOTHING
+            # fresh: every lowering hit the persistent cache
+            assert _get(replacement.addr, "/readyz")[0] == 200
+            assert profiler.runtime_metrics.counter(
+                "compile_cache.misses") == misses0
+            assert profiler.runtime_metrics.counter(
+                "compile_cache.hits") > hits0
+            assert len(svc.list_replicas()) == 3
+            assert min(min_ready) >= 2, min(min_ready)  # never below N-1
+        finally:
+            stop.set()
+            for r in reps[1:]:
+                r.drain()
+            if replacement is not None:
+                replacement.drain()
+            disable_compile_cache()
+
+
+class TestFleetMetrics:
+    def test_router_prometheus_exports_fleet_series(self, model_dir,
+                                                    master):
+        svc, maddr = master
+        reps = _start_replicas(model_dir, maddr, 1)
+        router = FleetRouter(master_addr=maddr, poll_interval=0.05)
+        router.start_background()
+        try:
+            deadline = time.time() + 5
+            while not router.live_replicas() and time.time() < deadline:
+                time.sleep(0.05)
+            code, _ = _post(router.addr, "/predict",
+                            {"feeds": {"x": FEED["x"].tolist()}})
+            assert code == 200
+            host, port = router.addr
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=30) as r:
+                body = r.read().decode()
+            assert "fleet" in body
+        finally:
+            for r in reps:
+                r.drain()
+            router.shutdown()
