@@ -59,6 +59,7 @@ from paddle_tpu import dataset
 from paddle_tpu import fault
 from paddle_tpu import datapipe
 from paddle_tpu import obs
+from paddle_tpu import analysis
 
 __version__ = "0.1.0"
 

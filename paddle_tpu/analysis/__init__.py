@@ -1,0 +1,37 @@
+"""Static analysis over the Program IR (the verify-before-compile half
+of the fault story — ``docs/static_analysis.md``).
+
+The TPU build lowers a whole block to one XLA computation, so a
+malformed program otherwise surfaces as a cryptic trace error deep in
+``Executor.run`` — or runs silently wrong.  This package proves what it
+can BEFORE tracing:
+
+* :mod:`~paddle_tpu.analysis.structural` — def-before-use across
+  nested control-flow blocks, feed/fetch targets, persistable
+  re-definition (PTA001–PTA004);
+* :mod:`~paddle_tpu.analysis.typecheck` — per-op shape/dtype inference
+  rules with a warn-list for uncovered ops (PTA005, PTA006, PTA010);
+* :mod:`~paddle_tpu.analysis.lints` — dead ops, unused feeds,
+  donation/aliasing hazards (PTA007–PTA009).
+
+Entry points: ``lint_program`` (everything; ``paddle_tpu lint``),
+``verify_program`` (structural, raising — the ``PADDLE_TPU_VERIFY=1``
+executor hook), ``verify_transpiled`` (the post-rewrite contract every
+transpiler calls).
+"""
+
+from paddle_tpu.analysis.analyzer import (AnalysisResult, analyze_program,
+                                          check_pipeline_carriers,
+                                          lint_program, verify_program,
+                                          verify_transpiled)
+from paddle_tpu.analysis.diagnostics import (DIAGNOSTIC_CODES, Diagnostic,
+                                             ProgramVerificationError,
+                                             format_diagnostics)
+from paddle_tpu.analysis import typecheck
+
+__all__ = [
+    "AnalysisResult", "analyze_program", "lint_program", "verify_program",
+    "verify_transpiled", "check_pipeline_carriers", "DIAGNOSTIC_CODES",
+    "Diagnostic", "ProgramVerificationError", "format_diagnostics",
+    "typecheck",
+]

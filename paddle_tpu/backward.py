@@ -226,6 +226,13 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             grads_of[p.name] = [canonical]
         grad_var = block.var(grads_of[p.name][0])
         param_and_grads.append((p, grad_var))
+
+    # post-transpile contract (paddle_tpu.analysis): the grad ops this
+    # pass just appended must leave the program structurally well-formed
+    # — a broken grad maker fails HERE with named ops/vars, not as an
+    # XLA trace error at the first Executor.run
+    from paddle_tpu.analysis import verify_transpiled
+    verify_transpiled(program, where="backward.append_backward")
     return param_and_grads
 
 

@@ -32,6 +32,14 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              report whether the numerical
                                              fault reproduces (exit 0 =
                                              reproduced, 1 = clean)
+  lint    MODEL_DIR | --zoo NAME|all         static-analyze a program:
+                                             def-before-use, shape/dtype
+                                             inference, dead ops, donation
+                                             hazards, int64 truncation —
+                                             rustc-style diagnostics with
+                                             stable PTA*** codes
+                                             (docs/static_analysis.md);
+                                             exit 1 on errors
   profile [--model transformer|resnet ...]   per-op device-time table of
                                              one compiled training step
   version
@@ -317,6 +325,92 @@ def _cmd_replay(args):
     return 0 if report["reproduced"] else 1
 
 
+def _cmd_lint(args):
+    """Static analysis over a Program IR (``paddle_tpu.analysis``):
+    lint a saved inference model (its ``__model__`` program, no params
+    or executor needed — the analysis is static) or a model-zoo
+    program built forward+backward.  Prints rustc-style diagnostics
+    with stable ``PTA***`` codes; exit 0 = clean, 1 = findings
+    (errors always; warnings only under --strict), 2 = bad target."""
+    import json as _json
+
+    from paddle_tpu import analysis
+    from paddle_tpu.framework import Program
+
+    targets = []  # (label, program, feed_names, fetch_names)
+    if args.zoo:
+        from paddle_tpu.models import ZOO_MODELS, build_train_program
+        names = ZOO_MODELS if args.zoo == "all" else [args.zoo]
+        for name in names:
+            try:
+                main, startup, feeds, fetches = build_train_program(
+                    name, backward=not args.no_backward)
+            except ValueError as e:
+                print(f"lint: {e}", file=sys.stderr)
+                return 2
+            targets.append((name, main, feeds, fetches))
+            targets.append((f"{name}/startup", startup, None, None))
+    elif args.target:
+        model_path = os.path.join(args.target, "__model__") \
+            if os.path.isdir(args.target) else args.target
+        try:
+            with open(model_path) as f:
+                model = _json.load(f)
+            program = Program.from_dict(model["program"])
+        except (OSError, ValueError, KeyError) as e:
+            print(f"lint: cannot load a program from "
+                  f"{args.target!r}: {e}", file=sys.stderr)
+            return 2
+        targets.append((args.target, program,
+                        model.get("feed_var_names"),
+                        model.get("fetch_var_names")))
+    else:
+        print("lint: need a MODEL_DIR or --zoo NAME|all", file=sys.stderr)
+        return 2
+
+    # --feed/--fetch override the MAIN programs only: the auto-added
+    # */startup companions have neither feeds nor the main's fetch vars
+    if args.feed:
+        feed_override = [s for s in args.feed.split(",") if s]
+        targets = [(lbl, p,
+                    fd if lbl.endswith("/startup") else feed_override, ft)
+                   for lbl, p, fd, ft in targets]
+    if args.fetch:
+        fetch_override = [s for s in args.fetch.split(",") if s]
+        targets = [(lbl, p, fd,
+                    ft if lbl.endswith("/startup") else fetch_override)
+                   for lbl, p, fd, ft in targets]
+
+    n_err = n_warn = 0
+    uncovered = set()
+    reports = []
+    for label, program, feeds, fetches in targets:
+        result = analysis.lint_program(program, feed_names=feeds,
+                                       fetch_names=fetches)
+        n_err += len(result.errors)
+        n_warn += len(result.warnings)
+        uncovered.update(result.uncovered_op_types)
+        if args.json:
+            reports.append({
+                "target": label,
+                "diagnostics": [d.to_dict() for d in result.diagnostics],
+                "uncovered_op_types": result.uncovered_op_types})
+        else:
+            for d in result.diagnostics:
+                print(f"[{label}] {d.format()}")
+    if args.json:
+        print(_json.dumps({"targets": reports, "errors": n_err,
+                           "warnings": n_warn}, indent=2))
+    else:
+        print(f"lint: {len(targets)} program(s): {n_err} error(s), "
+              f"{n_warn} warning(s)")
+        if uncovered and args.verbose:
+            print(f"  warn-list ({len(uncovered)} op type(s) without an "
+                  f"inference rule — shapes/dtypes not propagated "
+                  f"through them): {', '.join(sorted(uncovered))}")
+    return 1 if n_err or (args.strict and n_warn) else 0
+
+
 def _cmd_launch(args):
     """Spawn an N-process jax.distributed cluster on this host (the
     cluster_train launcher analog; each process gets the reference's
@@ -524,6 +618,33 @@ def main(argv=None):
     p.add_argument("--json", action="store_true",
                    help="machine-readable report instead of prose")
     p.set_defaults(fn=_cmd_replay)
+
+    p = sub.add_parser("lint", help="static-analyze a program IR "
+                                    "(PTA*** diagnostics; "
+                                    "docs/static_analysis.md)")
+    p.add_argument("target", nargs="?", default=None,
+                   help="save_inference_model dir (or a __model__ json "
+                        "file) to lint")
+    p.add_argument("--zoo", default=None,
+                   help="lint a built-in model's forward+backward "
+                        "program instead (mnist|resnet|vgg|transformer|"
+                        "seq2seq|stacked_lstm|all)")
+    p.add_argument("--no-backward", action="store_true",
+                   help="with --zoo: lint the forward program only")
+    p.add_argument("--feed", default=None,
+                   help="comma-separated feed names (default: the "
+                        "model's declared feeds)")
+    p.add_argument("--fetch", default=None,
+                   help="comma-separated fetch names (default: the "
+                        "model's declared fetch targets)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on warnings too, not just errors")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable diagnostics")
+    p.add_argument("--verbose", action="store_true",
+                   help="also print the warn-list of op types without "
+                        "an inference rule")
+    p.set_defaults(fn=_cmd_lint)
 
     p = sub.add_parser("profile", help="per-op device-time table of one "
                                        "compiled training step")

@@ -327,6 +327,7 @@ class Executor:
         self._cache_capacity = jit_cache_capacity()
         self._cache_inserts = 0  # lifetime insert count (eviction-proof)
         self._run_counter = 0
+        self._verified = set()  # (id(program), version) PADDLE_TPU_VERIFY memo
         _maybe_enable_compile_cache_from_env()
         from paddle_tpu import profiler as _profiler
         _profiler.install_jax_compile_listeners()
@@ -365,9 +366,31 @@ class Executor:
         fetch_names = [f.name if isinstance(f, framework.Variable) else f
                        for f in fetch_list]
 
+        if _env_flag("PADDLE_TPU_VERIFY"):
+            self._maybe_verify(program, feed, fetch_names)
+
         with _span("executor.run"):
             return self._run_traced(program, block, feed, fetch_names,
                                     scope, return_numpy, sentinel=sentinel)
+
+    # ------------------------------------------------------------------
+    def _maybe_verify(self, program, feed, fetch_names):
+        """PADDLE_TPU_VERIFY=1: run the structural verifier
+        (paddle_tpu.analysis) BEFORE first compile, so an ill-formed
+        program fails with named vars/ops instead of a deep trace
+        error.  Memoized per (program, version): a cached step pays one
+        set lookup (<5% guard in tests/test_analysis.py), and mutating
+        the program (bump_version) re-verifies."""
+        key = (id(program), program._version)
+        if key in self._verified:
+            return
+        from paddle_tpu import analysis
+        analysis.verify_program(program, feed_names=tuple(feed),
+                                fetch_names=tuple(fetch_names),
+                                where="executor.run")
+        if len(self._verified) > 4096:  # id() reuse bound, not a cache
+            self._verified.clear()
+        self._verified.add(key)
 
     def _run_traced(self, program, block, feed, fetch_names, scope,
                     return_numpy, sentinel=None):
@@ -560,6 +583,9 @@ class Executor:
         block = program.global_block()
         fetch_names = [f.name if isinstance(f, framework.Variable) else f
                        for f in fetch_list]
+
+        if _env_flag("PADDLE_TPU_VERIFY"):
+            self._maybe_verify(program, feed, fetch_names)
 
         device = self._feed_device()
         per_step_feed = {}

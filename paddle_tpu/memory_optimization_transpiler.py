@@ -220,6 +220,11 @@ def memory_optimize(input_program=None, print_log=False, level=0):
             plan.donatable_feeds.append(v.name)
 
     program._memory_plan = plan
+    # the plan drives interpret-mode early release (executor drops env
+    # entries per last_use) — guard it with the structural verifier so a
+    # liveness plan is never attached to an ill-formed program
+    from paddle_tpu.analysis import verify_transpiled
+    verify_transpiled(program, where="memory_optimize")
     if print_log:
         print(plan.report())
     return plan

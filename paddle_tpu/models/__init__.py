@@ -7,4 +7,59 @@ from paddle_tpu.models import (resnet, transformer, vgg, mnist,
                                seq2seq, stacked_lstm)
 
 __all__ = ["resnet", "transformer", "vgg", "mnist",
-           "seq2seq", "stacked_lstm"]
+           "seq2seq", "stacked_lstm", "ZOO_MODELS", "build_train_program"]
+
+#: zoo model names accepted by :func:`build_train_program` (and by
+#: ``paddle_tpu lint --zoo``; the lint gate in
+#: tests/test_analysis_zoo.py iterates exactly this list)
+ZOO_MODELS = ("mnist", "resnet", "vgg", "transformer", "seq2seq",
+              "stacked_lstm")
+
+
+def build_train_program(name, backward=True):
+    """Build one zoo model's forward(+backward+optimizer) program with
+    small smoke-test dimensions.
+
+    Returns ``(main_program, startup_program, feed_names, fetch_names)``
+    — ``feed_names`` is None when the model builds its own feed vars
+    (the analyzer then infers them from ``is_data``).  Shared by
+    ``paddle_tpu lint --zoo`` and the model-zoo lint gate so the CLI and
+    CI analyze the same programs.
+    """
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        if name == "mnist":
+            cost, acc, feeds = mnist.mnist_train_program(8)
+            fetches = [cost.name, acc.name]
+        elif name == "resnet":
+            cost, acc, feeds = resnet.resnet_train_program(
+                2, class_dim=10, depth=18, image_shape=(3, 32, 32))
+            fetches = [cost.name, acc.name]
+        elif name == "vgg":
+            cost, acc, feeds = vgg.vgg_train_program(2, class_dim=10)
+            fetches = [cost.name, acc.name]
+        elif name == "transformer":
+            hp = transformer.ModelHyperParams()
+            hp.d_model, hp.d_inner_hid, hp.n_layer, hp.n_head = 32, 64, 1, 2
+            hp.d_key = hp.d_value = 16
+            hp.src_vocab_size = hp.trg_vocab_size = 64
+            hp.max_length = 16
+            cost, _ = transformer.transformer(2, 8, 8, hp)
+            feeds, fetches = None, [cost.name]
+        elif name == "seq2seq":
+            cost, _ = seq2seq.seq_to_seq_net(
+                16, 16, emb_dim=8, encoder_size=8, decoder_size=8)
+            feeds, fetches = None, [cost.name]
+        elif name == "stacked_lstm":
+            cost, acc, _ = stacked_lstm.stacked_lstm_net(
+                dict_size=16, emb_dim=8, hidden_dim=8, n_layers=2)
+            feeds, fetches = None, [cost.name, acc.name]
+        else:
+            raise ValueError(
+                f"unknown zoo model {name!r}; expected one of "
+                f"{ZOO_MODELS}")
+        if backward:
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(cost)
+    return main, startup, feeds, fetches

@@ -9,6 +9,7 @@ the MXU) instead of the reference's im2col+GEMM / cuDNN split.
 
 from __future__ import annotations
 
+import logging
 import os
 
 import jax
@@ -17,6 +18,8 @@ import numpy as np
 
 from paddle_tpu.ops.registry import (
     register_op, infer_shape_unary, ShapeInferenceSkip)
+
+logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -603,6 +606,24 @@ def softmax_lower(ctx):
             ctx.set_output("Out", fused_softmax(
                 x, row_bias, tri_bias, _use_interpret()))
             return
+        # fallback SIGNAL (ADVICE r5): with the kernel opted in, a bias
+        # the kernel cannot decompose — e.g. the decoder's combined
+        # padding+causal [B,1,S,S] — silently takes the XLA path below;
+        # without this line an experiment reading "fused softmax on"
+        # would misread its partial coverage
+        logger.debug(
+            "fused softmax (PADDLE_TPU_FUSED_SOFTMAX=1) fell back to "
+            "the XLA path for scores %s: bias shape %s is neither a "
+            "per-row padding mask [B|1,1,1,Sk] nor a shared causal "
+            "mask [1,1,Sq,Sk] (a combined padding+causal [B,1,Sq,Sk] "
+            "bias is not decomposable by the Pallas kernel)",
+            tuple(x.shape), tuple(bias.shape))
+    elif bias is not None and \
+            os.environ.get("PADDLE_TPU_FUSED_SOFTMAX", "0") == "1":
+        logger.debug(
+            "fused softmax (PADDLE_TPU_FUSED_SOFTMAX=1) fell back to "
+            "the XLA path: scores are rank %d, the Pallas kernel needs "
+            "4-D attention-shaped [B,H,Sq,Sk] scores", x.ndim)
     out_dtype = x.dtype
     if bias is not None:
         # add in X's dtype: under bf16 AMP the materialization candidate

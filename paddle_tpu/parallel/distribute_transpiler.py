@@ -123,6 +123,10 @@ class DistributeTranspiler:
                 self.spec.param_specs[p.name] = P(mesh_axis)
             else:
                 self.spec.param_specs[p.name] = P()
+        # post-transpile contract (paddle_tpu.analysis): the plan is
+        # recorded against a structurally verified program
+        from paddle_tpu.analysis import verify_transpiled
+        verify_transpiled(self._program, where="distribute_transpiler")
         return self
 
     def placement(self):

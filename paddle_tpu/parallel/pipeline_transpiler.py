@@ -248,23 +248,37 @@ class _Layout:
             self.offsets.append(self.lengths.get(lane, 0))
             self.lengths[lane] = self.lengths.get(lane, 0) + size
 
-    def pack(self, values, lanes):
-        """values {name: array} -> {lane: flat vec} over ``lanes``.
+    @staticmethod
+    def _check_i32_range(name, v):
+        """Range-check any CONCRETE int64-typed value before it rides
+        the i32 lane — a >= 2^31 id must fail loudly, not wrap.  Keyed
+        on the value's DTYPE, not ``isinstance(np.ndarray)``: numpy
+        scalars and x64-enabled jax arrays are int64-typed without
+        being ndarrays, and must not bypass the guard (ADVICE r5).
+        Abstract tracers are exempt: they cannot be concretized, and
+        under JAX's default x64-off no tracer is int64 anyway."""
+        dt = getattr(v, "dtype", None)
+        if dt is None or np.dtype(dt) != np.int64 \
+                or isinstance(v, jax.core.Tracer):
+            return
+        a = np.asarray(v)
+        if a.size and (a.max() > np.iinfo(np.int32).max or
+                       a.min() < np.iinfo(np.int32).min):
+            raise ValueError(
+                f"pipeline_transpiler: {name!r} holds int64 values "
+                f"outside int32 range; the i32 carrier lane cannot "
+                f"carry them exactly")
 
-        Host-side (numpy) int64 values are range-checked before riding
-        the i32 lane — a >= 2^31 id must fail loudly, not wrap (traced
-        in-stage values are already i32 under JAX's default x64-off)."""
+    def pack(self, values, lanes):
+        """values {name: array} -> {lane: flat vec} over ``lanes``;
+        int64 values are range-guarded by :meth:`_check_i32_range`
+        (the static half of the same contract is the analyzer's PTA010
+        int64-lane lint, ``analysis.check_pipeline_carriers``)."""
         flats = {lane: [] for lane in lanes}
         for n, lane in zip(self.names, self.lanes):
             v = values[n]
-            if lane == "i32" and isinstance(v, np.ndarray) and \
-                    v.dtype == np.int64 and v.size and \
-                    (v.max() > np.iinfo(np.int32).max or
-                     v.min() < np.iinfo(np.int32).min):
-                raise ValueError(
-                    f"pipeline_transpiler: {n!r} holds int64 values "
-                    f"outside int32 range; the i32 carrier lane cannot "
-                    f"carry them exactly")
+            if lane == "i32":
+                self._check_i32_range(n, v)
             flats[lane].append(
                 jnp.ravel(v).astype(_LANE_DTYPES[lane]))
         return {
@@ -305,9 +319,19 @@ class PipelinedProgram:
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.amp = _amp_enabled(program)
+        # post-transpile contract: the program must be structurally
+        # well-formed BEFORE it is cut into stages (a bad rewrite fails
+        # here, named, instead of inside the shard_map trace), and no
+        # int64 constant provably outside int32 range may cross a stage
+        # boundary on the i32 carrier lane (the static half of
+        # _Layout.pack's runtime range guard)
+        from paddle_tpu.analysis import (check_pipeline_carriers,
+                                         verify_transpiled)
+        verify_transpiled(program, where="pipeline_transpiler")
         (self.block, self.stage_ops, self.stage_param_names,
          self.boundaries) = split_program(program, n_stages, feed_names,
                                           fetch_names)
+        check_pipeline_carriers(self.block, self.boundaries)
 
         def check_rng(op):
             opdef = _registry.lookup(op.type)

@@ -73,14 +73,28 @@ class OpTest:
         return program, feed
 
     # -- forward check -----------------------------------------------------
+    # On-TPU tolerance handling (dual-place discipline; reference
+    # op_test.py passes a larger atol for the CUDA place): TPU
+    # transcendentals (exp/log) differ from the host libm at the ~4e-5
+    # level.  The per-test DECLARED tolerance is scaled by this factor
+    # but the scaling is CAPPED at the old global 1e-4 floor — so a
+    # test declaring 1e-6 precision now fails at 1e-5 on TPU (a chip
+    # regression beyond its own contract, which the old flat floor
+    # silently passed; ADVICE r5), while a test that deliberately
+    # declared a loose >= 1e-4 tolerance keeps exactly its declared
+    # value instead of being loosened 10x further.
+    TPU_TOL_SCALE = 10.0
+    TPU_TOL_CAP = 1e-4
+
+    def _tpu_tol(self, declared):
+        return max(declared,
+                   min(declared * self.TPU_TOL_SCALE, self.TPU_TOL_CAP))
+
     def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
-        # dual-place discipline (reference op_test.py passes a larger
-        # atol for the CUDA place): TPU transcendentals (exp/log) differ
-        # from the host libm at the ~4e-5 level
         from paddle_tpu.place import is_tpu_available
         if is_tpu_available():
-            atol = max(atol, 1e-4)
-            rtol = max(rtol, 1e-4)
+            atol = self._tpu_tol(atol)
+            rtol = self._tpu_tol(rtol)
         program, feed = self._build()
         fetch_names = []
         expected = []

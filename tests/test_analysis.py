@@ -1,0 +1,427 @@
+"""Static analyzer (paddle_tpu.analysis): negative cases for every
+diagnostic code, the zero-false-positive contract on clean programs,
+the PADDLE_TPU_VERIFY executor hook, post-transpile verification, and
+the <5% cached-run overhead guard.
+
+``NEGATIVE_CASES`` is the machine-readable registry the scanner test
+(test_analysis_registry.py) enforces: every ``PTA***`` code in
+``DIAGNOSTIC_CODES`` must appear here with a builder that constructs a
+deliberately broken program triggering it.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis
+from paddle_tpu.framework import Program
+
+
+def _prog():
+    p = Program()
+    return p, p.global_block()
+
+
+# ---------------------------------------------------------------------------
+# negative-case registry: code -> builder returning
+# (program, feed_names, fetch_names) that must emit that code
+# ---------------------------------------------------------------------------
+
+def _case_pta001_undeclared_input():
+    p, b = _prog()
+    b.create_var(name="x", shape=(2, 2), dtype="float32", is_data=True)
+    b.append_op(type="relu", inputs={"X": ["ghost"]},
+                outputs={"Out": ["y"]})
+    return p, None, ["y"]
+
+
+def _case_pta002_read_before_write():
+    p, b = _prog()
+    b.create_var(name="x", shape=(2, 2), dtype="float32", is_data=True)
+    # a transpiler reordering gone wrong: consumer before producer
+    b.append_op(type="relu", inputs={"X": ["t"]}, outputs={"Out": ["y"]})
+    b.append_op(type="tanh", inputs={"X": ["x"]}, outputs={"Out": ["t"]})
+    return p, None, ["y"]
+
+
+def _case_pta003_missing_fetch():
+    p, b = _prog()
+    b.create_var(name="x", shape=(2, 2), dtype="float32", is_data=True)
+    b.append_op(type="relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    return p, None, ["y", "no_such_var"]
+
+
+def _case_pta004_param_redefined():
+    p, b = _prog()
+    b.create_parameter(shape=(2, 2), dtype="float32", name="w")
+    b.create_var(name="x", shape=(2, 2), dtype="float32", is_data=True)
+    b.append_op(type="elementwise_add", inputs={"X": ["x"], "Y": ["w"]},
+                outputs={"Out": ["y"]})
+    # clobbers the parameter it already consumed — not an in-place
+    # state update (relu neither reads w nor declares stateful_outputs)
+    b.append_op(type="relu", inputs={"X": ["x"]}, outputs={"Out": ["w"]})
+    return p, None, ["y"]
+
+
+def _case_pta005_dtype_mismatch():
+    p, b = _prog()
+    b.create_var(name="x", shape=(2, 2), dtype="float32", is_data=True)
+    b.create_var(name="ids", shape=(2, 2), dtype="int64", is_data=True)
+    b.append_op(type="elementwise_add",
+                inputs={"X": ["x"], "Y": ["ids"]}, outputs={"Out": ["y"]})
+    return p, None, ["y"]
+
+
+def _case_pta006_shape_mismatch():
+    p, b = _prog()
+    b.create_var(name="x", shape=(4, 8), dtype="float32", is_data=True)
+    b.create_parameter(shape=(16, 3), dtype="float32", name="w")
+    b.append_op(type="mul", inputs={"X": ["x"], "Y": ["w"]},
+                outputs={"Out": ["y"]})
+    return p, None, ["y"]
+
+
+def _case_pta007_dead_op():
+    p, b = _prog()
+    b.create_var(name="x", shape=(2, 2), dtype="float32", is_data=True)
+    b.append_op(type="relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    b.append_op(type="tanh", inputs={"X": ["x"]}, outputs={"Out": ["z"]})
+    return p, None, ["y"]  # z is never consumed nor fetched
+
+
+def _case_pta008_unused_feed():
+    p, b = _prog()
+    b.create_var(name="x", shape=(2, 2), dtype="float32", is_data=True)
+    b.create_var(name="unused", shape=(2, 2), dtype="float32",
+                 is_data=True)
+    b.append_op(type="relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    return p, None, ["y"]
+
+
+def _case_pta009_donation_hazard():
+    p, b = _prog()
+    b.create_parameter(shape=(2, 2), dtype="float32", name="w")
+    b.create_var(name="g", shape=(2, 2), dtype="float32", is_data=True)
+    b.create_var(name="lr", shape=(1,), dtype="float32", is_data=True)
+    b.append_op(type="sgd",
+                inputs={"Param": ["w"], "Grad": ["g"],
+                        "LearningRate": ["lr"]},
+                outputs={"ParamOut": ["w"]})
+    # reads the donated param buffer AFTER its in-place update — a
+    # sentinel skip-step discard cannot restore what this op consumed
+    b.append_op(type="relu", inputs={"X": ["w"]}, outputs={"Out": ["y"]})
+    return p, None, ["y"]
+
+
+def _case_pta010_int64_truncation():
+    p, b = _prog()
+    b.append_op(type="fill_constant", outputs={"Out": ["big_id"]},
+                attrs={"shape": [1], "dtype": "int64", "value": 2 ** 40})
+    return p, None, ["big_id"]
+
+
+#: enforced complete by tests/test_analysis_registry.py
+NEGATIVE_CASES = {
+    "PTA001": _case_pta001_undeclared_input,
+    "PTA002": _case_pta002_read_before_write,
+    "PTA003": _case_pta003_missing_fetch,
+    "PTA004": _case_pta004_param_redefined,
+    "PTA005": _case_pta005_dtype_mismatch,
+    "PTA006": _case_pta006_shape_mismatch,
+    "PTA007": _case_pta007_dead_op,
+    "PTA008": _case_pta008_unused_feed,
+    "PTA009": _case_pta009_donation_hazard,
+    "PTA010": _case_pta010_int64_truncation,
+}
+
+
+@pytest.mark.parametrize("code", sorted(NEGATIVE_CASES))
+def test_negative_case_triggers_code(code):
+    program, feeds, fetches = NEGATIVE_CASES[code]()
+    result = analysis.lint_program(program, feed_names=feeds,
+                                   fetch_names=fetches)
+    assert code in result.codes(), (
+        f"deliberately broken program did not trigger {code}; got "
+        f"{result.codes()}:\n{result.format()}")
+    hit = next(d for d in result.diagnostics if d.code == code)
+    # actionable: the diagnostic names a concrete var or op
+    assert hit.var or hit.op_type, hit.format()
+
+
+def test_diagnostics_carry_construction_site():
+    program, feeds, fetches = NEGATIVE_CASES["PTA006"]()
+    result = analysis.lint_program(program, feed_names=feeds,
+                                   fetch_names=fetches)
+    hit = next(d for d in result.diagnostics if d.code == "PTA006")
+    assert hit.site is not None and hit.site[0].endswith(
+        "test_analysis.py"), hit.site
+    assert f":{hit.site[1]}" in hit.format()
+
+
+# ---------------------------------------------------------------------------
+# zero false positives on clean programs
+# ---------------------------------------------------------------------------
+
+def _clean_train_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                              append_batch_size=True)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        pred = fluid.layers.fc(input=h, size=2, act="softmax")
+        cost = fluid.layers.cross_entropy(input=pred, label=label)
+        avg = fluid.layers.mean(x=cost)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg)
+    return main, startup, avg
+
+
+def test_clean_program_has_zero_diagnostics():
+    main, startup, avg = _clean_train_program()
+    r = analysis.lint_program(main, fetch_names=[avg.name])
+    assert not r.diagnostics, r.format()
+    rs = analysis.lint_program(startup)
+    assert not rs.diagnostics, rs.format()
+
+
+def test_warn_list_reports_uncovered_op_types_only():
+    main, _, avg = _clean_train_program()
+    r = analysis.lint_program(main, fetch_names=[avg.name])
+    covered = analysis.typecheck.covered_op_types()
+    assert not (set(r.uncovered_op_types) & covered)
+
+
+def test_analysis_mutates_nothing():
+    main, _, avg = _clean_train_program()
+    before = main.to_dict()
+    version = main._version
+    analysis.lint_program(main, fetch_names=[avg.name])
+    assert main.to_dict() == before
+    assert main._version == version
+
+
+# ---------------------------------------------------------------------------
+# PADDLE_TPU_VERIFY executor hook
+# ---------------------------------------------------------------------------
+
+class TestExecutorVerifyHook:
+    def test_broken_program_fails_before_compile(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_VERIFY", "1")
+        program, _, _ = NEGATIVE_CASES["PTA002"]()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(analysis.ProgramVerificationError) as ei:
+            exe.run(program, feed={"x": np.zeros((2, 2), np.float32)},
+                    fetch_list=["y"])
+        assert "PTA002" in str(ei.value)
+        assert ei.value.where == "executor.run"
+
+    def test_parallel_executor_inherits_hook(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_VERIFY", "1")
+        from paddle_tpu.parallel import ParallelExecutor
+        program, _, _ = NEGATIVE_CASES["PTA001"]()
+        pexe = ParallelExecutor(use_cuda=False, main_program=program)
+        with pytest.raises(analysis.ProgramVerificationError):
+            pexe.run(fetch_list=["y"],
+                     feed={"x": np.zeros((2, 2), np.float32)})
+
+    def test_clean_program_runs_and_memoizes(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_VERIFY", "1")
+        main, startup, avg = _clean_train_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        from paddle_tpu.scope import Scope, scope_guard
+        with scope_guard(Scope()):
+            exe.run(startup)
+            feed = {"x": np.random.rand(4, 4).astype(np.float32),
+                    "label": np.zeros((4, 1), np.int64)}
+            exe.run(main, feed=feed, fetch_list=[avg.name])
+            keys = set(exe._verified)
+            assert (id(main), main._version) in keys
+            exe.run(main, feed=feed, fetch_list=[avg.name])
+            assert set(exe._verified) == keys  # memo hit, no re-verify
+
+    def test_mutation_reverifies(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_VERIFY", "1")
+        p, b = _prog()
+        b.create_var(name="x", shape=(2, 2), dtype="float32",
+                     is_data=True)
+        b.append_op(type="relu", inputs={"X": ["x"]},
+                    outputs={"Out": ["y"]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        from paddle_tpu.scope import Scope, scope_guard
+        with scope_guard(Scope()):
+            exe.run(p, feed={"x": np.zeros((2, 2), np.float32)},
+                    fetch_list=["y"])
+            # break the program; bump_version invalidates the memo
+            b.append_op(type="relu", inputs={"X": ["late"]},
+                        outputs={"Out": ["z"]})
+            b.append_op(type="tanh", inputs={"X": ["y"]},
+                        outputs={"Out": ["late"]})
+            with pytest.raises(analysis.ProgramVerificationError):
+                exe.run(p, feed={"x": np.zeros((2, 2), np.float32)},
+                        fetch_list=["z"])
+
+
+# ---------------------------------------------------------------------------
+# post-transpile verification wiring
+# ---------------------------------------------------------------------------
+
+class TestPostTranspileVerification:
+    def test_append_backward_verifies_its_output(self, monkeypatch):
+        # a grad maker emitting an op that reads a var defined only
+        # LATER must fail inside append_backward, naming the pass
+        from paddle_tpu.ops import registry
+
+        def bad_maker(op, block, no_grad_set):
+            return [{"type": "relu",
+                     "inputs": {"X": ["__not_yet_defined__"]},
+                     "outputs": {"Out": ["X@GRAD"]},
+                     "attrs": {}}], {"X": "X@GRAD"}
+
+        opdef = registry.lookup("tanh")
+        monkeypatch.setattr(opdef, "grad_maker", bad_maker)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            x.stop_gradient = False
+            y = fluid.layers.tanh(x)
+            loss = fluid.layers.mean(x=y)
+            with pytest.raises(analysis.ProgramVerificationError) as ei:
+                fluid.append_backward(loss)
+        assert ei.value.where == "backward.append_backward"
+        assert "PTA001" in str(ei.value)
+        # later ops never defined it: undeclared, not read-before-write
+
+    def test_memory_optimize_verifies(self):
+        program, _, _ = NEGATIVE_CASES["PTA002"]()
+        from paddle_tpu.memory_optimization_transpiler import \
+            memory_optimize
+        with pytest.raises(analysis.ProgramVerificationError) as ei:
+            memory_optimize(program)
+        assert ei.value.where == "memory_optimize"
+
+    def test_verify_transpiled_clean_is_quiet(self):
+        main, _, avg = _clean_train_program()
+        analysis.verify_transpiled(main, where="test")  # no raise
+
+
+# ---------------------------------------------------------------------------
+# pipeline i32 carrier lane: static half of the pack() range guard
+# ---------------------------------------------------------------------------
+
+def test_pipeline_carrier_int64_lint():
+    p, b = _prog()
+    b.append_op(type="fill_constant", outputs={"Out": ["big_id"]},
+                attrs={"shape": [2], "dtype": "int64", "value": 2 ** 39})
+    b.append_op(type="relu", inputs={"X": ["big_id"]},
+                outputs={"Out": ["y"]})
+    with pytest.raises(analysis.ProgramVerificationError) as ei:
+        analysis.check_pipeline_carriers(b, [["big_id"]])
+    assert "PTA010" in str(ei.value)
+    # in-range constants cross boundaries freely
+    p2, b2 = _prog()
+    b2.append_op(type="fill_constant", outputs={"Out": ["small_id"]},
+                 attrs={"shape": [2], "dtype": "int64", "value": 7})
+    assert analysis.check_pipeline_carriers(b2, [["small_id"]]) == []
+
+
+# ---------------------------------------------------------------------------
+# overhead guard: PADDLE_TPU_VERIFY on a CACHED Executor.run
+# (sleep-modeled, same idiom as tests/test_obs_overhead.py: the bench
+# host has 2 noisy vCPUs, so the memoized hook's per-step cost is
+# measured directly against a 1 ms modeled dispatch instead of racing
+# two full executors)
+# ---------------------------------------------------------------------------
+
+STEP_SECONDS = 0.001
+MAX_OVERHEAD_FRACTION = 0.05
+
+
+def test_verify_hook_overhead_under_5_percent(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "1")
+    main, _, avg = _clean_train_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    from paddle_tpu.executor import _env_flag
+
+    def hook_once():
+        # exactly what a cached Executor.run adds per step: the env
+        # gate plus the memoized verification lookup
+        if _env_flag("PADDLE_TPU_VERIFY"):
+            exe._maybe_verify(main, ("x", "label"), (avg.name,))
+
+    hook_once()  # first call pays the real verification
+    assert (id(main), main._version) in exe._verified
+
+    def per_step(iters=2000):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            hook_once()
+        return (time.perf_counter() - t0) / iters
+
+    cost = min(per_step() for _ in range(5))  # best-of-5 vs noisy CPU
+    budget = STEP_SECONDS * MAX_OVERHEAD_FRACTION
+    assert cost <= budget, (
+        f"memoized PADDLE_TPU_VERIFY hook costs {cost * 1e6:.1f}us per "
+        f"cached step — over {MAX_OVERHEAD_FRACTION:.0%} of a "
+        f"{STEP_SECONDS * 1e3:.0f}ms step ({budget * 1e6:.0f}us)")
+
+
+# ---------------------------------------------------------------------------
+# CLI: lint a saved model dir (static — no params, no executor)
+# ---------------------------------------------------------------------------
+
+class TestLintCli:
+    def _write_model(self, tmp_path, program, feeds, fetches):
+        import json
+
+        d = tmp_path / "model"
+        d.mkdir()
+        (d / "__model__").write_text(json.dumps({
+            "program": program.to_dict(),
+            "feed_var_names": feeds or [],
+            "fetch_var_names": fetches or []}))
+        return str(d)
+
+    def test_broken_saved_model_exits_1(self, tmp_path, capsys):
+        from paddle_tpu.cli import main
+        program, feeds, fetches = NEGATIVE_CASES["PTA006"]()
+        path = self._write_model(tmp_path, program, feeds, fetches)
+        assert main(["lint", path]) == 1
+        out = capsys.readouterr().out
+        assert "PTA006" in out and "error" in out
+
+    def test_clean_saved_model_exits_0(self, tmp_path, capsys):
+        from paddle_tpu.cli import main
+        main_prog, _, avg = _clean_train_program()
+        inference = main_prog.prune([avg]).inference_optimize()
+        path = self._write_model(tmp_path, inference, ["x", "label"],
+                                 [avg.name])
+        assert main(["lint", path]) == 0
+        assert "0 error(s)" in capsys.readouterr().out
+
+    def test_strict_promotes_warnings(self, tmp_path):
+        from paddle_tpu.cli import main
+        program, _, fetches = NEGATIVE_CASES["PTA008"]()
+        path = self._write_model(tmp_path, program, ["x", "unused"],
+                                 fetches)
+        assert main(["lint", path]) == 0          # warning only
+        assert main(["lint", "--strict", path]) == 1
+
+    def test_json_report(self, tmp_path, capsys):
+        import json
+
+        from paddle_tpu.cli import main
+        program, feeds, fetches = NEGATIVE_CASES["PTA010"]()
+        path = self._write_model(tmp_path, program, feeds, fetches)
+        assert main(["lint", "--json", path]) == 1
+        report = json.loads(capsys.readouterr().out)
+        codes = [d["code"] for t in report["targets"]
+                 for d in t["diagnostics"]]
+        assert "PTA010" in codes
+
+    def test_bad_target_exits_2(self, tmp_path):
+        from paddle_tpu.cli import main
+        assert main(["lint", str(tmp_path / "nope")]) == 2
+        assert main(["lint"]) == 2

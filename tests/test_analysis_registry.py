@@ -1,0 +1,93 @@
+"""Registry cross-check for analyzer diagnostic codes (same idiom as
+the failpoint/metric registries): every ``PTA***`` code must be (1)
+documented in docs/static_analysis.md's diagnostic table, and (2)
+covered by a negative test in tests/test_analysis.py that triggers it
+on a deliberately broken program.  The scanner also walks the analysis
+sources so a pass emitting an undeclared code (or a declared code no
+pass can emit) fails here, not in an incident."""
+
+import os
+import re
+
+import paddle_tpu
+from paddle_tpu.analysis.diagnostics import DIAGNOSTIC_CODES
+
+from tests.test_analysis import NEGATIVE_CASES
+
+SRC_ROOT = os.path.dirname(os.path.abspath(paddle_tpu.__file__))
+ANALYSIS_DIR = os.path.join(SRC_ROOT, "analysis")
+DOC = os.path.join(os.path.dirname(SRC_ROOT), "docs", "static_analysis.md")
+
+_CODE = re.compile(r"\bPTA\d{3}\b")
+
+
+def _emitted_codes():
+    """Codes that appear in the analysis passes' sources (excluding the
+    declaration table itself)."""
+    codes = set()
+    for name in sorted(os.listdir(ANALYSIS_DIR)):
+        if not name.endswith(".py") or name == "diagnostics.py":
+            continue
+        with open(os.path.join(ANALYSIS_DIR, name)) as f:
+            codes.update(_CODE.findall(f.read()))
+    return codes
+
+
+def documented_codes():
+    with open(DOC) as f:
+        doc = f.read()
+    # table rows are "| `PTA001` | severity | ... |"
+    return set(re.findall(r"^\|\s*`(PTA\d{3})`\s*\|", doc, flags=re.M))
+
+
+class TestDiagnosticRegistry:
+    def test_scanner_finds_known_emit_sites(self):
+        """An over-tight scanner regex silently passing the doc check
+        would be worse than a missing doc row."""
+        emitted = _emitted_codes()
+        assert {"PTA001", "PTA005", "PTA007", "PTA010"} <= emitted
+
+    def test_every_emitted_code_is_declared(self):
+        undeclared = sorted(_emitted_codes() - set(DIAGNOSTIC_CODES))
+        assert not undeclared, (
+            f"analysis passes emit codes missing from "
+            f"DIAGNOSTIC_CODES: {undeclared}")
+
+    def test_every_declared_code_is_emitted_somewhere(self):
+        dead = sorted(set(DIAGNOSTIC_CODES) - _emitted_codes())
+        assert not dead, (
+            f"DIAGNOSTIC_CODES declares codes no pass can emit "
+            f"(codes are append-only — a retired check should keep a "
+            f"tombstone row in the docs, not a dead registry entry): "
+            f"{dead}")
+
+    def test_every_code_is_documented(self):
+        documented = documented_codes()
+        assert documented, f"no diagnostic table parsed from {DOC}"
+        missing = sorted(set(DIAGNOSTIC_CODES) - documented)
+        assert not missing, (
+            f"diagnostic codes missing from the docs/static_analysis.md "
+            f"table: {missing}")
+        stale = sorted(documented - set(DIAGNOSTIC_CODES))
+        assert not stale, (
+            f"docs/static_analysis.md documents unknown codes: {stale}")
+
+    def test_every_code_has_a_negative_test(self):
+        missing = sorted(set(DIAGNOSTIC_CODES) - set(NEGATIVE_CASES))
+        assert not missing, (
+            f"codes without a negative case in "
+            f"tests/test_analysis.py::NEGATIVE_CASES (each code needs "
+            f"a deliberately broken program that triggers it): "
+            f"{missing}")
+        stale = sorted(set(NEGATIVE_CASES) - set(DIAGNOSTIC_CODES))
+        assert not stale, f"negative cases for unknown codes: {stale}"
+
+    def test_doc_table_states_severity(self):
+        with open(DOC) as f:
+            doc = f.read()
+        for code, (severity, _) in DIAGNOSTIC_CODES.items():
+            row = re.search(rf"^\|\s*`{code}`\s*\|([^|]*)\|", doc,
+                            flags=re.M)
+            assert row and severity in row.group(1), (
+                f"{code}'s doc row must state its severity "
+                f"({severity!r})")

@@ -1,0 +1,56 @@
+"""Model-zoo lint gate: the analyzer reports ZERO diagnostics across
+every ``paddle_tpu/models/*`` forward+backward program (main AND
+startup).  Zero false positives is part of the analyzer's contract —
+a check that cries wolf on known-good programs gets turned off, and
+then the next transpiler bug ships.  A new model joins the gate by
+joining ``models.ZOO_MODELS`` / ``build_train_program``."""
+
+import pytest
+
+from paddle_tpu import analysis
+from paddle_tpu.models import ZOO_MODELS, build_train_program
+
+
+@pytest.mark.parametrize("name", ZOO_MODELS)
+def test_zoo_model_lints_clean(name):
+    main, startup, feeds, fetches = build_train_program(name)
+    result = analysis.lint_program(main, feed_names=feeds,
+                                   fetch_names=fetches)
+    assert not result.diagnostics, (
+        f"{name} forward+backward program is not lint-clean "
+        f"(analyzer false positive, or a real model bug):\n"
+        f"{result.format()}")
+    startup_result = analysis.lint_program(startup)
+    assert not startup_result.diagnostics, (
+        f"{name} startup program is not lint-clean:\n"
+        f"{startup_result.format()}")
+
+
+@pytest.mark.parametrize("name", ZOO_MODELS)
+def test_zoo_model_forward_only_lints_clean(name):
+    main, _, feeds, fetches = build_train_program(name, backward=False)
+    result = analysis.lint_program(main, feed_names=feeds,
+                                   fetch_names=fetches)
+    assert not result.diagnostics, f"{name} forward:\n{result.format()}"
+
+
+def test_zoo_gate_covers_every_model_module():
+    """A model module added to paddle_tpu/models without joining the
+    gate would silently escape linting."""
+    import os
+
+    import paddle_tpu.models as models
+    mod_dir = os.path.dirname(os.path.abspath(models.__file__))
+    modules = {n[:-3] for n in os.listdir(mod_dir)
+               if n.endswith(".py") and n != "__init__.py"}
+    assert modules == set(ZOO_MODELS), (
+        f"models modules {sorted(modules)} != lint-gated zoo "
+        f"{sorted(ZOO_MODELS)} — add the new model to ZOO_MODELS / "
+        f"build_train_program")
+
+
+def test_zoo_cli_lint_exits_clean():
+    """`paddle_tpu lint --zoo all` — the command CI and humans run —
+    agrees with the API-level gate."""
+    from paddle_tpu.cli import main
+    assert main(["lint", "--zoo", "all"]) == 0

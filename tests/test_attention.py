@@ -268,3 +268,58 @@ class TestComposedPathMaskWiring:
         la = self._run(fa)
         lb = self._run(fb)
         np.testing.assert_allclose(la, lb, rtol=1e-6, atol=1e-7)
+
+
+class TestFusedSoftmaxFallbackSignal:
+    """ADVICE r5: under PADDLE_TPU_FUSED_SOFTMAX=1 a bias the Pallas
+    kernel cannot decompose — the decoder's combined padding+causal
+    [B,1,S,S] — silently takes the XLA path; the lowering must emit a
+    debug-log fallback signal with the reason so an experiment cannot
+    misread partial kernel coverage as full coverage."""
+
+    def _softmax_program(self, bias_shape):
+        main = fluid.Program()
+        block = main.global_block()
+        block.create_var(name="x", shape=(B, H, S, S), dtype="float32",
+                         is_data=True)
+        block.create_var(name="bias", shape=bias_shape, dtype="float32",
+                         is_data=True)
+        block.append_op(type="softmax",
+                        inputs={"X": ["x"], "Bias": ["bias"]},
+                        outputs={"Out": ["out"]})
+        return main
+
+    def _run(self, bias_shape, monkeypatch, caplog):
+        import logging
+
+        monkeypatch.setenv("PADDLE_TPU_FUSED_SOFTMAX", "1")
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(B, H, S, S).astype("float32"),
+                "bias": rng.randn(*bias_shape).astype("float32")}
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            with caplog.at_level(logging.DEBUG,
+                                 logger="paddle_tpu.ops.nn_ops"):
+                out, = exe.run(self._softmax_program(bias_shape),
+                               feed=feed, fetch_list=["out"])
+        want = jax.nn.softmax(feed["x"] + feed["bias"], axis=-1)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
+        return [r for r in caplog.records
+                if "fell back" in r.getMessage()]
+
+    def test_combined_bias_fallback_logs_reason(self, monkeypatch,
+                                                caplog):
+        # combined padding+causal bias [B,1,S,S]: decomposable by
+        # neither the row nor the causal form -> XLA path + signal
+        records = self._run((B, 1, S, S), monkeypatch, caplog)
+        assert records, "fallback emitted no debug-log signal"
+        msg = records[0].getMessage()
+        assert "PADDLE_TPU_FUSED_SOFTMAX" in msg
+        assert str((B, 1, S, S)) in msg  # the reason names the shape
+
+    def test_supported_bias_does_not_log_fallback(self, monkeypatch,
+                                                  caplog):
+        # shared causal [1,1,S,S] IS decomposable: no fallback signal
+        records = self._run((1, 1, S, S), monkeypatch, caplog)
+        assert not records, [r.getMessage() for r in records]

@@ -344,3 +344,51 @@ class TestPipelineHardening:
             np.testing.assert_allclose(got[n], want[n], rtol=2e-3,
                                        atol=2e-5,
                                        err_msg=f"grad mismatch {n}")
+
+
+class TestI32LaneRangeGuard:
+    """The i32 carrier lane's int64 range guard is keyed on the VALUE'S
+    DTYPE, not ``isinstance(np.ndarray)`` (ADVICE r5): numpy scalars
+    and x64-enabled jax arrays are int64-typed without being ndarrays
+    and must not wrap silently.  The static half of the same contract
+    is analysis.check_pipeline_carriers (tests/test_analysis.py)."""
+
+    def _layout(self):
+        from paddle_tpu.parallel.pipeline_transpiler import _Layout
+        return _Layout(["ids"], [(1,)], [np.int64])
+
+    def test_ndarray_out_of_range_rejected(self):
+        lay = self._layout()
+        with pytest.raises(ValueError, match="int32 range"):
+            lay.pack({"ids": np.array([2 ** 31], np.int64)}, ["i32"])
+
+    def test_numpy_scalar_out_of_range_rejected(self):
+        # np.int64(...) is NOT an ndarray — the old isinstance guard
+        # let it through to wrap silently
+        lay = self._layout()
+        with pytest.raises(ValueError, match="int32 range"):
+            lay.pack({"ids": np.int64(2 ** 31)}, ["i32"])
+
+    def test_python_list_of_big_ints_is_not_exempt(self):
+        # no dtype attr -> conversion happens in pack_microbatch's
+        # np.asarray; packing the converted array still trips the guard
+        lay = self._layout()
+        with pytest.raises(ValueError, match="int32 range"):
+            lay.pack({"ids": np.asarray([-(2 ** 40)])}, ["i32"])
+
+    def test_in_range_int64_packs_exactly(self):
+        lay = self._layout()
+        vecs = lay.pack({"ids": np.array([2 ** 31 - 1], np.int64)},
+                        ["i32"])
+        assert int(vecs["i32"][0]) == 2 ** 31 - 1
+
+    def test_traced_values_are_exempt(self):
+        # tracers cannot be concretized; under x64-off they are never
+        # int64 anyway — the guard must not break jit'd stage packing
+        lay = self._layout()
+
+        def f(v):
+            return lay.pack({"ids": v}, ["i32"])["i32"]
+
+        out = jax.jit(f)(jnp.array([5], jnp.int32))
+        assert int(out[0]) == 5
