@@ -1,0 +1,198 @@
+"""Continuous-batching decode benchmark: aggregate tokens/s and
+time-to-first-token under N closed-loop clients with MIXED generation
+lengths, iteration-level admission (``gen_admission=continuous``) vs
+the PR 2 request-level batching semantics (``gen_admission=batch``:
+new requests admitted only between whole batches — each batch runs
+start-to-finish as a unit, exactly how one-shot ``/predict`` generation
+holds its MicroBatcher slot for the full sequence).
+
+Device work is MODELED WITH A SLEEP — the ``gen.decode.stall``
+failpoint (armed ``delay:SECS``) fires once per decode ITERATION inside
+the predictor lock, so the server behaves like one device that advances
+the whole slot batch per fixed-cost step while the GIL stays free.  On
+the 2-vCPU bench host that is the honest cost model: what the bench
+measures is pure scheduling capability — slot occupancy.  Request-level
+batching finishes a mixed-length batch at the pace of its LONGEST
+member (short sequences hold dead slots; arrivals queue behind the
+whole batch), while continuous batching refills slots between steps.
+The tokens/s ratio is that occupancy gap; the TTFT gap is admission
+latency (next-step admission vs wait-for-batch-drain).
+
+    python bench_decode.py --clients 8 --duration 3 --out BENCH_DECODE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import tempfile
+import threading
+import time
+
+# short 4s dominate with a heavy tail: request-level batches then run
+# ~MAX steps while holding mostly-finished slots
+DEFAULT_LENGTHS = (4, 4, 4, 48, 4, 4, 32, 4)
+
+
+def build_bundle(dirname, num_slots=8):
+    """Toy-scale generation bundle: the decode compute is deliberately
+    negligible — the armed ``gen.decode.stall`` delay IS the device
+    time."""
+    from paddle_tpu.models import gen_lm
+    gen_lm.export_gen_model(dirname, gen_lm.GenConfig(),
+                            num_slots=num_slots)
+    return dirname
+
+
+def _percentile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q / 100.0 * len(xs)))]
+
+
+def _stream_generate(host, port, prompt, max_new, timeout=120):
+    """One streamed /generate; returns (ttft_seconds, tokens)."""
+    import http.client
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    t0 = time.perf_counter()
+    conn.request("POST", "/generate",
+                 json.dumps({"prompt": prompt,
+                             "max_new_tokens": max_new}).encode(),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    if resp.status != 200:
+        resp.read()
+        conn.close()
+        raise RuntimeError(f"/generate replied {resp.status}")
+    ttft = None
+    tokens = 0
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        ev = json.loads(line)
+        if "token" in ev:
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            tokens += 1
+        if ev.get("done"):
+            break
+    conn.close()
+    return ttft, tokens
+
+
+def run_mode(bundle_dir, admission, clients, duration, step_ms,
+             lengths=DEFAULT_LENGTHS, prompt_len=4):
+    """One serving run: closed-loop clients against a gen server with
+    the given admission policy; device time = ``step_ms`` per decode
+    iteration.  Returns the stats dict."""
+    from paddle_tpu.fault import chaos
+    from paddle_tpu.serving import InferenceServer
+
+    chaos.clear()
+    chaos.inject("gen.decode.stall", delay=step_ms / 1000.0)
+    server = InferenceServer(bundle_dir, port=0, warmup=True,
+                             request_timeout=120.0,
+                             gen_admission=admission,
+                             gen_queue_size=256)
+    server.start_background()
+    try:
+        assert server.wait_until_ready(300)
+        host, port = server.addr
+        stats = [{"ttfts": [], "tokens": 0, "requests": 0,
+                  "failures": []} for _ in range(clients)]
+
+        def loop(idx, out, stop_at):
+            i = 0
+            while time.monotonic() < stop_at:
+                n = lengths[(idx + i) % len(lengths)]
+                prompt = [1 + ((idx + i + j) % 40)
+                          for j in range(prompt_len)]
+                i += 1
+                try:
+                    ttft, tokens = _stream_generate(host, port, prompt, n)
+                    out["ttfts"].append(ttft)
+                    out["tokens"] += tokens
+                    out["requests"] += 1
+                except Exception as e:     # a LOST request
+                    out["failures"].append(repr(e))
+
+        stop_at = time.monotonic() + duration
+        threads = [threading.Thread(target=loop,
+                                    args=(i, stats[i], stop_at))
+                   for i in range(clients)]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t_start
+        ttfts = [t for s in stats for t in s["ttfts"] if t is not None]
+        tokens = sum(s["tokens"] for s in stats)
+        failures = [f for s in stats for f in s["failures"]]
+        return {
+            "admission": admission,
+            "clients": clients,
+            "requests_ok": sum(s["requests"] for s in stats),
+            "failures": len(failures),
+            "failure_samples": failures[:3],
+            "elapsed_sec": elapsed,
+            "tokens": tokens,
+            "tokens_per_sec": tokens / elapsed if elapsed > 0 else 0.0,
+            "ttft_ms": {
+                "p50": (_percentile(ttfts, 50) or 0) * 1e3,
+                "p99": (_percentile(ttfts, 99) or 0) * 1e3,
+            },
+        }
+    finally:
+        chaos.clear()
+        server.shutdown()
+
+
+def run_bench(clients=8, duration=3.0, step_ms=20.0, bundle_dir=None,
+              lengths=DEFAULT_LENGTHS):
+    """Continuous vs request-level admission over the same bundle and
+    cost model; returns the JSON-ready summary."""
+    if bundle_dir is None:
+        bundle_dir = build_bundle(
+            tempfile.mkdtemp(prefix="ptdecode_") + "/bundle")
+    kw = dict(clients=clients, duration=duration, step_ms=step_ms,
+              lengths=lengths)
+    continuous = run_mode(bundle_dir, "continuous", **kw)
+    batch = run_mode(bundle_dir, "batch", **kw)
+    ratio = continuous["tokens_per_sec"] / batch["tokens_per_sec"] \
+        if batch["tokens_per_sec"] else None
+    return {
+        "clients": clients,
+        "duration_sec": duration,
+        "decode_step_ms": step_ms,
+        "gen_lengths": list(lengths),
+        "modes": {"continuous": continuous, "request_level": batch},
+        "tokens_per_sec_ratio": ratio,
+        "ttft_p99_ms": {
+            "continuous": continuous["ttft_ms"]["p99"],
+            "request_level": batch["ttft_ms"]["p99"],
+        },
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--step-ms", type=float, default=20.0)
+    ap.add_argument("--out", default=None, help="write the JSON summary")
+    args = ap.parse_args(argv)
+    summary = run_bench(clients=args.clients, duration=args.duration,
+                        step_ms=args.step_ms)
+    text = json.dumps(summary, indent=2, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

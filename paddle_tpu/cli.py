@@ -156,7 +156,9 @@ def _cmd_serve(args):
         max_batch_size=args.max_batch_size,
         max_batch_delay=args.max_batch_delay,
         batch_queue_size=args.batch_queue_size, warmup=args.warmup,
-        warmup_batch_sizes=warmup_sizes)
+        warmup_batch_sizes=warmup_sizes,
+        gen_admission=args.gen_admission,
+        gen_queue_size=args.gen_queue_size)
     if args.master:
         from paddle_tpu.fault import GracefulShutdown
         from paddle_tpu.fleet import FleetReplica
@@ -177,6 +179,34 @@ def _cmd_serve(args):
         replica.drain()
         return 0
     serve(args.model, host=args.host, port=args.port, **server_kwargs)
+    return 0
+
+
+def _cmd_generate(args):
+    """Streaming generation client: POST /generate and print tokens as
+    the chunks arrive (directly against a replica, or through a fleet
+    router — both stream incrementally)."""
+    from paddle_tpu.serving import ServingClient
+    prompt = [int(t) for t in args.prompt.replace(",", " ").split()]
+    client = ServingClient(args.addr, timeout=args.timeout,
+                           deadline=args.deadline)
+    tokens = []
+    for ev in client.generate(prompt, max_new_tokens=args.max_new,
+                              eos_id=args.eos_id,
+                              stream=not args.no_stream):
+        if "token" in ev:
+            tokens.append(ev["token"])
+            print(ev["token"], flush=True)
+        elif ev.get("error"):
+            err = ev["error"]
+            print(f"error: {err.get('type')}: {err.get('message')}",
+                  flush=True)
+            return 1
+        elif ev.get("done"):
+            if ev.get("tokens") and not tokens:
+                # stream=false: the buffered reply carries them all
+                print(" ".join(str(t) for t in ev["tokens"]), flush=True)
+            print(f"# done ({ev.get('finish_reason')})", flush=True)
     return 0
 
 
@@ -565,7 +595,34 @@ def main(argv=None):
     p.add_argument("--advertise-host", default=None,
                    help="host other machines should dial (default: the "
                         "bind host)")
+    p.add_argument("--gen-admission", default="continuous",
+                   choices=("continuous", "batch"),
+                   help="generation-bundle scheduler policy: admit into "
+                        "free KV slots between decode steps "
+                        "(continuous) or only between whole batches "
+                        "(batch — the request-level baseline)")
+    p.add_argument("--gen-queue-size", type=int, default=64,
+                   help="bounded /generate admission queue depth before "
+                        "503 load-shedding")
     p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("generate", help="stream tokens from a "
+                                        "generation server's /generate")
+    p.add_argument("--addr", required=True,
+                   help="host:port of a serving replica or fleet router")
+    p.add_argument("--prompt", required=True,
+                   help="prompt token ids (space/comma separated)")
+    p.add_argument("--max-new", type=int, default=16,
+                   help="max tokens to generate")
+    p.add_argument("--eos-id", type=int, default=None,
+                   help="per-request EOS token override")
+    p.add_argument("--timeout", type=float, default=60.0)
+    p.add_argument("--deadline", type=float, default=None,
+                   help="end-to-end budget seconds (sent as "
+                        "X-Deadline-Ms)")
+    p.add_argument("--no-stream", action="store_true",
+                   help="buffered reply instead of chunked streaming")
+    p.set_defaults(fn=_cmd_generate)
 
     p = sub.add_parser("router", help="health-aware fleet router over "
                                       "serving replicas")

@@ -509,7 +509,10 @@ class Executor:
         Warmup EXECUTES the program, so a program that writes persistable
         state (a training step: parameters, optimizer moments) would be
         mutated by zero-filled feeds — that is refused unless
-        ``allow_state_updates=True`` is passed explicitly.
+        ``allow_state_updates`` opts in: ``True`` allows every state
+        write, or an iterable of variable names allows exactly those
+        (the generation decode step declares its KV-cache tensors this
+        way — cache writes are intended, parameter writes still refuse).
 
         Returns the number of signatures that were freshly compiled
         (0 = everything was already warm)."""
@@ -517,10 +520,12 @@ class Executor:
         specs = feed_shapes if isinstance(feed_shapes, (list, tuple)) \
             else [feed_shapes or {}]
         block = program.global_block()
-        if not allow_state_updates:
+        if allow_state_updates is not True:
+            allowed = set(allow_state_updates or ())
             written = [n for op in block.ops if op.type not in _SKIP_OPS
                        for n in op.output_arg_names
-                       if block.has_var(n) and block.var(n).persistable]
+                       if n not in allowed and block.has_var(n) and
+                       block.var(n).persistable]
             if written:
                 raise ValueError(
                     f"warmup would EXECUTE this program, mutating "

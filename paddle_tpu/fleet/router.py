@@ -46,6 +46,11 @@ class _DeadlineExhausted(RuntimeError):
     """The caller's end-to-end budget ran out (non-retryable)."""
 
 
+class _StreamAborted(RuntimeError):
+    """The DOWNSTREAM client vanished mid-relay (non-retryable: there is
+    nobody left to fail over for)."""
+
+
 class FleetRouter:
     """Route `/predict` across replicas with health-aware failover.
 
@@ -179,7 +184,7 @@ class FleetRouter:
                                 "invalid Content-Length header",
                                 retryable=False)
                     return
-                if self.path not in ("/predict", "/run"):
+                if self.path not in ("/predict", "/run", "/generate"):
                     self._error(404, "not_found", self.path,
                                 retryable=False)
                     return
@@ -194,6 +199,13 @@ class FleetRouter:
                     return
                 if budget is None:
                     budget = router._default_deadline
+                if self.path == "/generate":
+                    # streamed generation: chunks are forwarded to the
+                    # caller AS the replica produces them — time-to-
+                    # first-token survives the fleet hop
+                    router.route_stream(self, raw, self._request_id,
+                                        budget)
+                    return
                 code, body, ctype = router.route(
                     self.path, raw, self._request_id, budget)
                 self._reply_raw(code, body, ctype)
@@ -355,6 +367,229 @@ class FleetRouter:
         finally:
             _profiler.runtime_metrics.observe(
                 "fleet.request_seconds", time.perf_counter() - t0)
+
+    # -- streamed generation (/generate) -----------------------------------
+    def route_stream(self, handler, raw, request_id, budget):
+        """Forward one ``/generate`` request, relaying response chunks
+        to ``handler`` AS the replica produces them (no body
+        buffering — the first token reaches the caller while the
+        replica is still decoding).
+
+        Failover semantics: retryable failures BEFORE the first
+        forwarded byte (connection failure, retryable 503/504, upstream
+        dying without producing a chunk) fail over to a sibling replica
+        exactly like :meth:`route`; once a chunk has been forwarded the
+        stream cannot be replayed — an upstream death then terminates
+        the relay with a structured trailing error line instead."""
+        from paddle_tpu import profiler as _profiler
+        from paddle_tpu.fault.retry import RetryError
+        deadline_at = time.monotonic() + budget
+        tried = []
+        t0 = time.perf_counter()
+
+        def attempt():
+            remaining = deadline_at - time.monotonic()
+            if remaining <= 0:
+                raise _DeadlineExhausted(
+                    f"deadline ({budget * 1e3:.0f}ms) exhausted after "
+                    f"{len(tried)} attempt(s)")
+            addr = self._pick(tried)
+            tried.append(addr)
+            with _span("fleet.attempt", replica=addr,
+                       attempt=len(tried)):
+                return self._forward_stream(addr, handler, raw,
+                                            request_id, remaining)
+
+        def on_retry(attempt_no, exc, delay):
+            _profiler.runtime_metrics.inc("fleet.retries")
+
+        try:
+            with _trace.trace_context(request_id), \
+                    _span("fleet.request", request_id=request_id,
+                          path="/generate"):
+                outcome = self._retry.call(attempt, on_retry=on_retry,
+                                           deadline=budget)
+            if outcome == "ok":
+                # only CLEAN completions count: a relay terminated by a
+                # mid-stream upstream death delivered an error tail,
+                # not a successful request
+                _profiler.runtime_metrics.inc("fleet.requests_ok")
+                if len(tried) > 1:
+                    _profiler.runtime_metrics.inc("fleet.failovers")
+                    self.failover_log.append((request_id, *tried))
+            return
+        except _StreamAborted:
+            # downstream client hung up mid-stream: nothing to reply to
+            handler.close_connection = True
+            return
+        except _DeadlineExhausted as e:
+            _profiler.runtime_metrics.inc("fleet.shed")
+            code, body, ctype = self._shed(504, "deadline_exceeded",
+                                           str(e), tried)
+        except RetryError as e:
+            e.history = list(tried)
+            _profiler.runtime_metrics.inc("fleet.shed")
+            if isinstance(e.last, _NoReplicas):
+                code, body, ctype = self._shed(503, "no_replicas",
+                                               str(e.last), tried)
+            else:
+                code, body, ctype = self._shed(
+                    503, "upstream_unavailable",
+                    f"all failover attempts failed: {e.last}", tried)
+        except _NoReplicas as e:
+            _profiler.runtime_metrics.inc("fleet.shed")
+            code, body, ctype = self._shed(503, "no_replicas", str(e),
+                                           tried)
+        finally:
+            _profiler.runtime_metrics.observe(
+                "fleet.request_seconds", time.perf_counter() - t0)
+        handler._reply_raw(code, body, ctype)
+
+    def _forward_stream(self, addr, handler, raw, request_id, remaining):
+        """One streamed attempt; returns ``"ok"`` when the relay ran to
+        clean completion, ``"upstream_died"`` when it was terminated by
+        a structured error tail, ``"passthrough"`` when the upstream
+        reply was passed through verbatim (permanent error).  Raises
+        retryable errors only while NOTHING has been forwarded
+        downstream yet."""
+        import http.client
+
+        from paddle_tpu.fault import chaos
+        try:
+            chaos.fire("fleet.route.blackhole", replica=addr)
+        except chaos.FaultInjected as e:
+            self._mark_down(addr)
+            raise _Transient(f"route to {addr} blackholed") from e
+        with self._lock:
+            entry = self._table.get(addr)
+            if entry is not None:
+                entry["outstanding"] += 1
+                entry["requests"] += 1
+        timeout = min(remaining, self._attempt_timeout)
+        headers = {
+            "Content-Type": "application/json",
+            "X-Request-Id": request_id,
+            "X-Deadline-Ms": str(int(remaining * 1000)),
+        }
+        try:
+            for retry_fresh in (False, True):
+                reused, conn = self._pooled_conn(addr, timeout)
+                try:
+                    conn.request("POST", "/generate", raw, headers)
+                    resp = conn.getresponse()
+                    break
+                except (OSError, http.client.HTTPException) as e:
+                    self._drop_conn(addr)
+                    if reused and not retry_fresh:
+                        continue
+                    self._mark_down(addr)
+                    raise ConnectionError(
+                        f"replica {addr} unreachable: {e}") from e
+            if resp.status != 200:
+                body = resp.read()
+                if resp.will_close:
+                    self._drop_conn(addr)
+                try:
+                    parsed = json.loads(body)
+                except ValueError:
+                    parsed = {"retryable": resp.status in (502, 503, 504)}
+                if parsed.get("retryable"):
+                    err = parsed.get("error") or {}
+                    raise _Transient(
+                        f"replica {addr} replied {resp.status} "
+                        f"{err.get('type', 'retryable')}: "
+                        f"{err.get('message', '')}")
+                handler._reply_raw(resp.status, body, "application/json")
+                return "passthrough"
+            # the replica holds its 200 until the first token exists,
+            # so the first line is imminent; reading it BEFORE sending
+            # downstream headers keeps this attempt fully retryable
+            try:
+                first = resp.readline()
+            except (OSError, http.client.HTTPException) as e:
+                self._drop_conn(addr)
+                self._mark_down(addr)
+                raise ConnectionError(
+                    f"replica {addr} died before streaming: {e}") from e
+            if not first:
+                self._drop_conn(addr)
+                self._mark_down(addr)
+                raise _Transient(
+                    f"replica {addr} closed the stream before the "
+                    f"first chunk")
+            try:
+                handler.send_response(200)
+                handler.send_header(
+                    "Content-Type",
+                    resp.getheader("Content-Type",
+                                   "application/x-ndjson"))
+                handler.send_header("Transfer-Encoding", "chunked")
+                if request_id:
+                    handler.send_header("X-Request-Id", request_id)
+                handler.end_headers()
+                self._relay_chunk(handler, first)
+            except OSError as e:
+                self._drop_conn(addr)
+                raise _StreamAborted(str(e)) from e
+            last = first
+            while True:
+                try:
+                    line = resp.readline()
+                except (OSError, http.client.HTTPException) as e:
+                    # upstream died MID-stream: the request cannot be
+                    # replayed (tokens already delivered) — terminate
+                    # with a structured error line the client can parse
+                    self._drop_conn(addr)
+                    self._mark_down(addr)
+                    self._finish_stream(handler, error=(
+                        f"replica {addr} died mid-stream: {e}"))
+                    return "upstream_died"
+                if not line:
+                    break
+                last = line
+                try:
+                    self._relay_chunk(handler, line)
+                except OSError as e:
+                    self._drop_conn(addr)
+                    raise _StreamAborted(str(e)) from e
+            try:
+                handler.wfile.write(b"0\r\n\r\n")
+                handler.wfile.flush()
+            except OSError as e:
+                raise _StreamAborted(str(e)) from e
+            # a replica-side failure (scheduler crash, stall) ends the
+            # stream CLEANLY with an {"error": ..., "done": true} tail
+            # — one JSON parse of the final line keeps that out of the
+            # success metrics without re-encoding the relayed body
+            if b'"error"' in last:
+                try:
+                    if json.loads(last).get("error"):
+                        return "upstream_error"
+                except ValueError:
+                    pass
+            return "ok"
+        finally:
+            with self._lock:
+                entry = self._table.get(addr)
+                if entry is not None:
+                    entry["outstanding"] = max(
+                        0, entry["outstanding"] - 1)
+
+    @staticmethod
+    def _relay_chunk(handler, line):
+        handler.wfile.write(b"%x\r\n" % len(line) + line + b"\r\n")
+        handler.wfile.flush()
+
+    def _finish_stream(self, handler, error):
+        try:
+            line = (json.dumps(
+                {"error": {"type": "upstream_died", "message": error},
+                 "done": True}) + "\n").encode()
+            self._relay_chunk(handler, line)
+            handler.wfile.write(b"0\r\n\r\n")
+            handler.wfile.flush()
+        except OSError:
+            handler.close_connection = True
 
     @staticmethod
     def _shed(code, etype, message, tried):

@@ -1,0 +1,15 @@
+"""Continuous-batching autoregressive generation runtime.
+
+The serving-side composition of the prefill/decode phase split
+(``models/gen_lm``), a slot-based bucketed KV cache that keeps the
+decode jit signature constant, and an iteration-level scheduler that
+admits/evicts requests BETWEEN decode steps — the vLLM/Orca-class
+counterpart to PR 2's request-level :class:`~paddle_tpu.serving.
+MicroBatcher`.  HTTP streaming lives in ``paddle_tpu/serving.py``
+(``/generate``); incremental fleet forwarding in
+``paddle_tpu/fleet/router.py``."""
+
+from paddle_tpu.gen.predictor import GenPredictor, is_gen_bundle
+from paddle_tpu.gen.scheduler import GenScheduler, GenStream
+
+__all__ = ["GenPredictor", "GenScheduler", "GenStream", "is_gen_bundle"]

@@ -1,0 +1,208 @@
+"""GenPredictor: the two-entry (prefill + decode) inference handle over
+an exported generation bundle (``models/gen_lm.export_gen_model``).
+
+The serving analog of :class:`paddle_tpu.serving.Predictor`, split along
+the vLLM/Orca phase boundary:
+
+* :meth:`prefill` runs one prompt (padded to a ``lod.row_bucket`` edge)
+  through the full causal forward and returns the next-token logits plus
+  the per-layer K/V rows that seed a cache slot.
+* :meth:`decode_step` advances EVERY slot of the cache pool by one
+  token.  The cache tensors are persistable state in the decode scope —
+  they live on device across steps (the executor's donated in-place
+  update path) and the step's feed signature is constant, so admission
+  and eviction never change the jit key.
+* :meth:`write_slot` / :meth:`clear_slot` are the (per-request, not
+  per-token) host-side slot writes that seed and reclaim cache rows.
+
+``warmup`` declares BOTH signature families — every prefill bucket and
+the one decode signature — through ``Executor.warmup``, so a server
+flips ``/readyz`` with the whole generation path compiled.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+
+from paddle_tpu.obs.trace import span as _span
+
+__all__ = ["GenPredictor", "is_gen_bundle"]
+
+META_FILENAME = "gen_meta.json"
+
+
+def is_gen_bundle(model_dir):
+    """True when ``model_dir`` is a generation bundle (prefill + decode
+    programs + ``gen_meta.json``) rather than a one-shot inference
+    model."""
+    return os.path.isfile(os.path.join(model_dir, META_FILENAME))
+
+
+class GenPredictor:
+    """Load-once handle over a generation bundle; thread-compatible (one
+    internal lock serializes executor access, mirroring Predictor)."""
+
+    def __init__(self, model_dir):
+        import paddle_tpu as fluid
+
+        with open(os.path.join(model_dir, META_FILENAME)) as f:
+            self.meta = json.load(f)
+        self.num_slots = int(self.meta["num_slots"])
+        self.max_len = int(self.meta["max_len"])
+        self.vocab_size = int(self.meta["vocab_size"])
+        self.eos_id = int(self.meta.get("eos_id", -1))
+        self.cache_vars = list(self.meta["cache_vars"])
+        self.prompt_buckets = [int(b) for b in self.meta["prompt_buckets"]]
+        self.max_prompt_len = min(self.prompt_buckets[-1], self.max_len)
+
+        self._fluid = fluid
+        self._scope = fluid.Scope()
+        self._lock = threading.Lock()
+        with fluid.scope_guard(self._scope):
+            self._exe = fluid.Executor()
+            (self._pre_prog, self._pre_feeds,
+             self._pre_fetch) = fluid.io.load_inference_model(
+                os.path.join(model_dir, "prefill"), self._exe)
+            (self._dec_prog, self._dec_feeds,
+             self._dec_fetch) = fluid.io.load_inference_model(
+                os.path.join(model_dir, "decode"), self._exe)
+        # per-bucket constant prefill feeds (causal bias template)
+        self._tri = {}
+
+    # -- prefill -----------------------------------------------------------
+    def _bucket(self, prompt_len):
+        from paddle_tpu.lod import row_bucket
+        b = row_bucket(prompt_len, edges=self.prompt_buckets)
+        return min(b, self.max_len)
+
+    def _prefill_feed(self, prompt, bucket):
+        from paddle_tpu.lod import pad_to_bucket
+        p = len(prompt)
+        ids = pad_to_bucket(
+            np.asarray(prompt, np.int32).reshape(1, p), bucket, axis=1)
+        pos = np.arange(bucket, dtype=np.int32).reshape(1, bucket)
+        mask = pad_to_bucket(np.ones((1, p), np.float32), bucket, axis=1)
+        tri = self._tri.get(bucket)
+        if tri is None:
+            tri = np.triu(np.full((bucket, bucket), -1e9, np.float32), 1)
+            self._tri[bucket] = tri
+        bias = tri[None, None] + (mask * 1e9 - 1e9)[:, None, None, :]
+        last = np.zeros((1, bucket), np.float32)
+        last[0, p - 1] = 1.0
+        return {"gen_ids": ids, "gen_pos": pos, "gen_mask": mask,
+                "gen_attn_bias": bias.astype(np.float32), "gen_last": last}
+
+    def prefill(self, prompt):
+        """Run one prompt (list/array of token ids); returns
+        ``(logits [V], kv)`` where ``kv`` is the per-layer masked K/V
+        list ``[k_0, v_0, ...]`` each ``[1, bucket, H*D]`` (zeros on pad
+        rows).  The prompt is padded to a declared bucket, so repeated
+        lengths share one executable."""
+        prompt = list(prompt)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.max_prompt_len:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds the bundle's "
+                f"max prompt length {self.max_prompt_len}")
+        feed = self._prefill_feed(prompt, self._bucket(len(prompt)))
+        with self._lock:
+            with self._fluid.scope_guard(self._scope):
+                with _span("gen.prefill", tokens=len(prompt)):
+                    outs = self._exe.run(self._pre_prog, feed=feed,
+                                         fetch_list=self._pre_fetch)
+        outs = [np.asarray(o) for o in outs]
+        return outs[0][0], outs[1:]
+
+    # -- cache-slot lifecycle (per request, host-side) ---------------------
+    def write_slot(self, slot, kv, prompt_len):
+        """Seed cache slot ``slot`` with a prefill's K/V rows (the rest
+        of the row is zeroed — decode's add-writes land on zeros).
+
+        A device-side slice update (``at[slot].set``): only the one
+        seeded row crosses host->device, and the pool itself never
+        round-trips — per-admission cost stays O(max_len), not
+        O(num_slots * max_len)."""
+        import jax.numpy as jnp
+        with self._lock:
+            for name, arr in zip(self.cache_vars, kv):
+                rows = min(arr.shape[1], self.max_len)
+                row = np.zeros((self.max_len, arr.shape[2]), arr.dtype)
+                row[:rows] = arr[0, :rows]
+                cache = jnp.asarray(self._scope.find_var(name))
+                self._scope.set_var(name, cache.at[slot].set(row))
+
+    def clear_slot(self, slot):
+        """Zero a reclaimed slot's cache rows (device-side slice
+        update).  Not strictly required — admission overwrites the
+        whole row — but keeps a freed slot from pinning stale request
+        data."""
+        import jax.numpy as jnp
+        with self._lock:
+            for name in self.cache_vars:
+                cache = jnp.asarray(self._scope.find_var(name))
+                self._scope.set_var(name, cache.at[slot].set(0.0))
+
+    # -- decode ------------------------------------------------------------
+    def decode_step(self, tokens, positions, pos_onehot, attn_mask):
+        """One decode iteration over the whole slot pool.
+
+        ``tokens``/``positions``: int32 ``[S]`` (zeros for free slots);
+        ``pos_onehot``: f32 ``[S, L]`` write mask (all-zero rows for
+        free slots — their cache is never touched); ``attn_mask``: f32
+        ``[S, L]`` attendable-position mask.  Returns logits ``[S, V]``.
+
+        The ``gen.decode.stall`` failpoint fires INSIDE the lock: a
+        ``delay`` action models per-iteration device time serialized per
+        replica (the decode bench's cost model), an ``error`` a device
+        fault in the decode step."""
+        from paddle_tpu.fault import chaos
+        S = self.num_slots
+        feed = {
+            "gen_token": np.asarray(tokens, np.int32).reshape(S, 1),
+            "gen_pos": np.asarray(positions, np.int32).reshape(S, 1),
+            "gen_pos_onehot": np.asarray(pos_onehot, np.float32),
+            "gen_attn_mask": np.asarray(attn_mask, np.float32),
+        }
+        with self._lock:
+            chaos.fire("gen.decode.stall", slots=S)
+            with self._fluid.scope_guard(self._scope):
+                with _span("gen.decode_step"):
+                    (logits,) = self._exe.run(self._dec_prog, feed=feed,
+                                              fetch_list=self._dec_fetch)
+        return np.asarray(logits)
+
+    # -- warmup ------------------------------------------------------------
+    def warmup(self):
+        """AOT-compile BOTH signature families — one prefill signature
+        per declared prompt bucket plus the (single) decode signature —
+        so the first real ``/generate`` pays zero compile time.  Returns
+        the number of fresh compiles."""
+        sigs = []
+        for b in self.prompt_buckets:
+            if b > self.max_len:
+                continue
+            sigs.append({"gen_ids": (1, b), "gen_pos": (1, b),
+                         "gen_mask": (1, b), "gen_attn_bias": (1, 1, b, b),
+                         "gen_last": (1, b)})
+        S, L = self.num_slots, self.max_len
+        dec_sig = {"gen_token": (S, 1), "gen_pos": (S, 1),
+                   "gen_pos_onehot": (S, L), "gen_attn_mask": (S, L)}
+        with self._lock:
+            with self._fluid.scope_guard(self._scope):
+                compiled = self._exe.warmup(
+                    self._pre_prog, sigs, fetch_list=self._pre_fetch,
+                    scope=self._scope)
+                # the decode step writes its (persistable) cache tensors
+                # in place — declare exactly those as intended state
+                # updates (a zero pos-onehot writes nothing, so warmup
+                # leaves the pool untouched)
+                compiled += self._exe.warmup(
+                    self._dec_prog, [dec_sig], fetch_list=self._dec_fetch,
+                    scope=self._scope,
+                    allow_state_updates=self.cache_vars)
+        return compiled

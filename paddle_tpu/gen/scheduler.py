@@ -1,0 +1,429 @@
+"""Iteration-level (continuous-batching) generation scheduler.
+
+The vLLM/Orca scheduling idea composed from pieces the tree already
+has: between decode steps the scheduler admits queued requests into free
+KV-cache slots (prefill interleaved with decode), evicts finished
+sequences (EOS / length cap / client disconnect), and streams each
+request's tokens out as they are produced.  The
+:class:`~paddle_tpu.serving.MicroBatcher` degradation contract is
+reused at token granularity: a full admission queue raises
+:class:`~paddle_tpu.serving.QueueFull` (503 load shedding), a request
+whose ``X-Deadline-Ms`` budget expires while still queued for admission
+fails with :class:`~paddle_tpu.serving.DeadlineExceeded` (504,
+``gen.expired``) WITHOUT ever taking a slot, and an unexpected
+scheduler-thread crash fails every live stream fast (retryable 503) and
+restarts the thread within a bounded consecutive-crash budget.
+
+``admission="batch"`` degrades the scheduler to PR 2's request-level
+semantics — new requests are admitted only when the pool is EMPTY, so a
+batch runs start-to-finish as a unit while later arrivals queue behind
+it.  That mode exists as the benchmark baseline (``bench_decode.py``):
+the measured gap between the two admission policies IS the
+continuous-batching win.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.obs import trace as _trace
+from paddle_tpu.obs.trace import span as _span
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["GenScheduler", "GenStream"]
+
+
+class GenStream:
+    """One request's token stream, produced by the scheduler thread and
+    consumed by an HTTP handler (or any iterator)."""
+
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline_at,
+                 trace_id=None):
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.deadline_at = deadline_at      # monotonic, None = unbounded
+        self.trace_id = trace_id or _trace.current_trace_id()
+        self.created_t = time.perf_counter()
+        self.cancelled = False              # set by the consumer side
+        self.finish_reason = None
+        self.error = None
+        self.tokens = []
+        self._events = []
+        self._cv = threading.Condition()
+
+    # -- producer side (scheduler thread) ---------------------------------
+    def _push(self, event):
+        with self._cv:
+            self._events.append(event)
+            self._cv.notify_all()
+
+    def emit(self, token):
+        self.tokens.append(int(token))
+        self._push(("token", int(token)))
+
+    def finish(self, reason):
+        self.finish_reason = reason
+        self._push(("done", reason))
+
+    def fail(self, exc):
+        self.error = exc
+        self._push(("error", exc))
+
+    # -- consumer side -----------------------------------------------------
+    def cancel(self):
+        """Mark the consumer gone (client disconnect): the scheduler
+        reclaims the slot and stops decoding for this stream on its next
+        iteration."""
+        self.cancelled = True
+
+    def next_event(self, timeout=None):
+        """Block for the next ``("token", id)`` / ``("done", reason)`` /
+        ``("error", exc)`` event; returns None on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while not self._events:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(remaining if remaining is not None else 0.5)
+            return self._events.pop(0)
+
+    def __iter__(self):
+        """Yield token ids until the stream finishes; raises the
+        stream's error if it failed."""
+        while True:
+            kind, value = self.next_event()
+            if kind == "token":
+                yield value
+            elif kind == "done":
+                return
+            else:
+                raise value
+
+
+class _Slot:
+    __slots__ = ("stream", "pos", "steps", "last_token", "last_emit_t")
+
+    def __init__(self, stream, prompt_len, first_token):
+        self.stream = stream
+        # the NEXT decode step consumes first_token and writes its K/V
+        # at position prompt_len
+        self.pos = prompt_len
+        self.steps = 0
+        self.last_token = first_token
+        self.last_emit_t = time.perf_counter()
+
+
+class GenScheduler:
+    """Continuous-batching decode loop over a :class:`GenPredictor`."""
+
+    def __init__(self, predictor, queue_size=64, admission="continuous",
+                 max_restarts=5):
+        if admission not in ("continuous", "batch"):
+            raise ValueError(
+                f"admission must be 'continuous' or 'batch', "
+                f"got {admission!r}")
+        self.predictor = predictor
+        self.queue_size = max(1, int(queue_size))
+        self.admission = admission
+        self.max_restarts = max(0, int(max_restarts))
+        self._queue = []
+        self._slots = {}          # slot index -> _Slot
+        self._free = list(range(predictor.num_slots))
+        self._cv = threading.Condition()
+        self._closed = False
+        self._restarts = 0
+        self._failed = None
+        self._thread = self._spawn_thread()
+
+    # -- public surface ----------------------------------------------------
+    @property
+    def queue_depth(self):
+        with self._cv:
+            return len(self._queue)
+
+    @property
+    def active_slots(self):
+        with self._cv:
+            return len(self._slots)
+
+    @property
+    def failed(self):
+        """Terminal crash once the consecutive-restart budget is spent
+        (None while alive) — the /readyz pull-the-replica signal."""
+        with self._cv:
+            return self._failed
+
+    def submit(self, prompt, max_new_tokens=16, deadline=None,
+               eos_id=None, timeout=None):
+        """Enqueue one generation request; returns a :class:`GenStream`.
+
+        ``deadline``: seconds of end-to-end admission budget (the
+        ``X-Deadline-Ms`` contract) — expiry while queued fails the
+        stream with DeadlineExceeded without taking a slot.  ``eos_id``
+        overrides the bundle's EOS token for this request."""
+        from paddle_tpu import profiler as _profiler
+        from paddle_tpu.serving import BatcherCrashed, QueueFull
+
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if any(t < 0 or t >= self.predictor.vocab_size for t in prompt):
+            raise ValueError("prompt token out of vocabulary range")
+        if len(prompt) > self.predictor.max_prompt_len:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds the bundle's "
+                f"max prompt length {self.predictor.max_prompt_len}")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        eos = self.predictor.eos_id if eos_id is None else int(eos_id)
+        deadline_at = None
+        if deadline is not None:
+            deadline_at = time.monotonic() + float(deadline)
+        elif timeout is not None:
+            deadline_at = time.monotonic() + float(timeout)
+        stream = GenStream(prompt, max_new_tokens, eos, deadline_at)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("generation scheduler is shut down")
+            if self._failed is not None:
+                raise BatcherCrashed(
+                    f"generation scheduler is down after "
+                    f"{self._restarts} restarts: {self._failed}")
+            if len(self._queue) >= self.queue_size:
+                _profiler.runtime_metrics.inc("gen.queue_rejections")
+                raise QueueFull(
+                    f"generation queue full ({self.queue_size} pending)")
+            self._queue.append(stream)
+            self._cv.notify_all()
+        return stream
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10)
+
+    # -- scheduler thread --------------------------------------------------
+    def _spawn_thread(self):
+        t = threading.Thread(target=self._run, daemon=True,
+                             name="paddle-tpu-gen-scheduler")
+        t.start()
+        return t
+
+    def _run(self):
+        try:
+            self._loop()
+        except BaseException as e:
+            self._crash(e)
+
+    def _crash(self, exc):
+        from paddle_tpu import profiler as _profiler
+        from paddle_tpu.serving import BatcherCrashed
+        logger.exception("generation scheduler thread crashed")
+        with self._cv:
+            queued, self._queue = self._queue, []
+            active, self._slots = list(self._slots.values()), {}
+            self._free = list(range(self.predictor.num_slots))
+            restart = not self._closed and \
+                self._restarts < self.max_restarts
+            if restart:
+                self._restarts += 1
+            elif not self._closed:
+                self._failed = exc
+        if restart:
+            _profiler.runtime_metrics.inc("gen.scheduler_restarts")
+            self._thread = self._spawn_thread()
+        err = BatcherCrashed(
+            f"generation scheduler crashed ({type(exc).__name__}: {exc});"
+            f" request aborted — retry")
+        err.__cause__ = exc
+        for slot in active:
+            slot.stream.fail(err)
+        for stream in queued:
+            stream.fail(err)
+
+    def _loop(self):
+        from paddle_tpu import profiler as _profiler
+        while True:
+            with self._cv:
+                while not self._queue and not self._slots and \
+                        not self._closed:
+                    self._cv.wait(0.05)
+                if self._closed:
+                    queued, self._queue = self._queue, []
+                    active, self._slots = list(self._slots.items()), {}
+                    break
+            self._sweep_queue()
+            self._admit()
+            if self._slots:
+                self._decode_iteration()
+                # a completed iteration is forward progress: the restart
+                # budget bounds CONSECUTIVE crashes, not lifetime ones
+                with self._cv:
+                    self._restarts = 0
+            _profiler.runtime_metrics.set_gauge("gen.slots_active",
+                                                len(self._slots))
+        err = RuntimeError("generation scheduler shut down")
+        for _, slot in active:
+            slot.stream.fail(err)
+        for stream in queued:
+            stream.fail(err)
+
+    def _sweep_queue(self):
+        """Fail expired/abandoned QUEUED requests immediately — an
+        expired deadline gets its 504 now, not when a slot frees up."""
+        from paddle_tpu import profiler as _profiler
+        from paddle_tpu.serving import DeadlineExceeded
+        now = time.monotonic()
+        with self._cv:
+            keep = []
+            for stream in self._queue:
+                if stream.cancelled:
+                    stream.finish("disconnect")
+                    continue
+                if stream.deadline_at is not None and \
+                        now > stream.deadline_at:
+                    _profiler.runtime_metrics.inc("gen.expired")
+                    stream.fail(DeadlineExceeded(
+                        "deadline expired while queued for admission"))
+                    continue
+                keep.append(stream)
+            self._queue = keep
+
+    def _admit(self):
+        """Move queued requests into free slots (continuous mode), or —
+        batch mode — refill the pool only once it is completely empty,
+        and then fill it WHOLE (the refill decision is made once per
+        call, so one batch admission loads every free slot rather than
+        degrading to serial batch-of-1)."""
+        refill = None
+        while True:
+            with self._cv:
+                if not self._queue or not self._free:
+                    return
+                if self.admission == "batch":
+                    if refill is None:
+                        refill = not self._slots
+                    if not refill:
+                        return
+                stream = self._queue.pop(0)
+                slot_idx = self._free.pop(0)
+            admitted = False
+            try:
+                admitted = self._prefill_into(slot_idx, stream)
+            finally:
+                if not admitted:
+                    with self._cv:
+                        self._free.append(slot_idx)
+
+    def _prefill_into(self, slot_idx, stream):
+        """Prefill one request and seed its slot; returns True when the
+        slot stays occupied (request still generating)."""
+        from paddle_tpu import profiler as _profiler
+        t0 = time.perf_counter()
+        with _trace.trace_context(stream.trace_id):
+            try:
+                logits, kv = self.predictor.prefill(stream.prompt)
+            except BaseException as e:
+                stream.fail(e)
+                return False
+        # counted only when prefill actually ran for an admitted
+        # request — a failed prefill above never takes the slot
+        _profiler.runtime_metrics.inc("gen.admissions")
+        _profiler.runtime_metrics.observe("gen.prefill_seconds",
+                                          time.perf_counter() - t0)
+        first = int(np.argmax(logits))
+        now = time.perf_counter()
+        _profiler.runtime_metrics.observe("gen.ttft_seconds",
+                                          now - stream.created_t)
+        _profiler.runtime_metrics.inc("gen.tokens")
+        stream.emit(first)
+        prompt_len = len(stream.prompt)
+        if first == stream.eos_id:
+            return self._finish(stream, "eos")
+        if stream.max_new_tokens <= 1 or prompt_len >= self.predictor.max_len:
+            return self._finish(stream, "length")
+        self.predictor.write_slot(slot_idx, kv, prompt_len)
+        with self._cv:
+            self._slots[slot_idx] = _Slot(stream, prompt_len, first)
+        return True
+
+    def _finish(self, stream, reason):
+        from paddle_tpu import profiler as _profiler
+        stream.finish(reason)
+        _profiler.runtime_metrics.inc("gen.requests_ok")
+        return False
+
+    def _evict(self, slot_idx, reason=None):
+        from paddle_tpu import profiler as _profiler
+        with self._cv:
+            slot = self._slots.pop(slot_idx, None)
+            if slot is None:
+                return
+            self._free.append(slot_idx)
+        _profiler.runtime_metrics.inc("gen.evictions")
+        if reason == "disconnect":
+            _profiler.runtime_metrics.inc("gen.disconnects")
+            self.predictor.clear_slot(slot_idx)
+            # terminal event even though the usual consumer is gone: a
+            # LOCAL consumer that cancelled must not block forever on a
+            # stream nobody will ever finish
+            slot.stream.finish("disconnect")
+
+    def _decode_iteration(self):
+        """One token for every live slot: sweep disconnects, build the
+        (constant-signature) step feeds, dispatch, scatter tokens."""
+        from paddle_tpu import profiler as _profiler
+        # reclaim disconnected streams BEFORE paying a step for them
+        with self._cv:
+            live = list(self._slots.items())
+        for idx, slot in live:
+            if slot.stream.cancelled:
+                self._evict(idx, reason="disconnect")
+        with self._cv:
+            live = sorted(self._slots.items())
+        if not live:
+            return
+        S, L = self.predictor.num_slots, self.predictor.max_len
+        tokens = np.zeros(S, np.int32)
+        positions = np.zeros(S, np.int32)
+        pos_onehot = np.zeros((S, L), np.float32)
+        attn_mask = np.zeros((S, L), np.float32)
+        for idx, slot in live:
+            tokens[idx] = slot.last_token
+            positions[idx] = slot.pos
+            pos_onehot[idx, slot.pos] = 1.0
+            attn_mask[idx, :slot.pos + 1] = 1.0
+        _profiler.runtime_metrics.bucket("gen.slot_occupancy", len(live))
+        t0 = time.perf_counter()
+        logits = self.predictor.decode_step(tokens, positions, pos_onehot,
+                                            attn_mask)
+        now = time.perf_counter()
+        _profiler.runtime_metrics.observe("gen.decode_step_seconds",
+                                          now - t0)
+        for idx, slot in live:
+            stream = slot.stream
+            token = int(np.argmax(logits[idx]))
+            slot.steps += 1
+            slot.pos += 1
+            slot.last_token = token
+            _profiler.runtime_metrics.inc("gen.tokens")
+            _profiler.runtime_metrics.observe("gen.intertoken_seconds",
+                                              now - slot.last_emit_t)
+            slot.last_emit_t = now
+            stream.emit(token)
+            done = 1 + slot.steps
+            if token == stream.eos_id:
+                self._finish(stream, "eos")
+                self._evict(idx)
+            elif done >= stream.max_new_tokens or slot.pos >= L:
+                self._finish(stream, "length")
+                self._evict(idx)

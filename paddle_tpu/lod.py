@@ -25,7 +25,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["DynLoD", "next_bucket", "row_bucket", "bucket_edges",
-           "bucket_ragged_feed", "SPLITS_SUFFIX"]
+           "bucket_ragged_feed", "pad_to_bucket", "SPLITS_SUFFIX"]
 
 SPLITS_SUFFIX = "@lod0"
 
@@ -63,6 +63,24 @@ def bucket_edges(lo, hi, edges=None):
         if not out or b != out[-1]:
             out.append(b)
     return out
+
+
+def pad_to_bucket(value, bucket, axis=0):
+    """Zero-pad ``value`` along ``axis`` up to ``bucket`` entries (a
+    no-op when already that size).  The shared padding idiom behind
+    every bucketed feed: serving's row-bucketed micro-batches and the
+    generation runtime's bucketed prompt prefill."""
+    value = np.asarray(value)
+    n = value.shape[axis]
+    if n > bucket:
+        raise ValueError(
+            f"cannot pad {n} entries down into a bucket of {bucket}")
+    if n == bucket:
+        return value
+    shape = list(value.shape)
+    shape[axis] = bucket - n
+    return np.concatenate(
+        [value, np.zeros(shape, value.dtype)], axis=axis)
 
 
 class DynLoD:

@@ -4,16 +4,17 @@ machine_translation/transformer, stacked_dynamic_lstm) — re-built on the
 TPU-native layers API."""
 
 from paddle_tpu.models import (resnet, transformer, vgg, mnist,
-                               seq2seq, stacked_lstm)
+                               seq2seq, stacked_lstm, gen_lm)
 
 __all__ = ["resnet", "transformer", "vgg", "mnist",
-           "seq2seq", "stacked_lstm", "ZOO_MODELS", "build_train_program"]
+           "seq2seq", "stacked_lstm", "gen_lm", "ZOO_MODELS",
+           "build_train_program"]
 
 #: zoo model names accepted by :func:`build_train_program` (and by
 #: ``paddle_tpu lint --zoo``; the lint gate in
 #: tests/test_analysis_zoo.py iterates exactly this list)
 ZOO_MODELS = ("mnist", "resnet", "vgg", "transformer", "seq2seq",
-              "stacked_lstm")
+              "stacked_lstm", "gen_lm")
 
 
 def build_train_program(name, backward=True):
@@ -56,6 +57,13 @@ def build_train_program(name, backward=True):
             cost, acc, _ = stacked_lstm.stacked_lstm_net(
                 dict_size=16, emb_dim=8, hidden_dim=8, n_layers=2)
             feeds, fetches = None, [cost.name, acc.name]
+        elif name == "gen_lm":
+            hp = gen_lm.GenConfig()
+            hp.vocab_size, hp.d_model, hp.d_ffn = 32, 16, 32
+            hp.n_head = hp.n_layer = 2
+            hp.d_head, hp.max_len = 8, 16
+            cost, feeds = gen_lm.gen_lm_train_program(2, 8, hp)
+            fetches = [cost.name]
         else:
             raise ValueError(
                 f"unknown zoo model {name!r}; expected one of "

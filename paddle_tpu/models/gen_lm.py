@@ -1,0 +1,362 @@
+"""Generative causal LM with a prefill/decode phase split — the model
+side of the continuous-batching serving runtime (``paddle_tpu/gen/``).
+
+One set of parameters (shared names) is exported as TWO inference
+programs, the vLLM/Orca-style entry pair:
+
+* **prefill** — batch of ONE prompt, dynamic (bucketed) length: runs the
+  full causal forward over the prompt, fetches the next-token logits at
+  the last real position plus the per-layer K/V projections (masked to
+  zero on pad rows) that seed the request's KV-cache slot.  The length
+  axis is dynamic; callers pad to a ``lod.row_bucket`` edge so the jit
+  key is the bucket, not the exact prompt length.
+* **decode** — ONE token for every slot of a fixed cache pool
+  ``[num_slots, max_len]``: reads the persistable cache tensors, writes
+  the new token's K/V at its position via a position-one-hot outer
+  product (an in-place persistable update, so the cache never leaves
+  the device), and attends over the full cache under a runtime length
+  mask.  Every decode step has the SAME signature — admission and
+  eviction never recompile.
+
+The third entry, :func:`gen_lm_train_program`, is the teacher-forced
+training graph over the same parameter names (and the model-zoo lint
+gate's view of this model).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu.layers as layers
+from paddle_tpu.initializer import NumpyArrayInitializer
+from paddle_tpu.param_attr import ParamAttr
+
+__all__ = ["GenConfig", "build_prefill_program", "build_decode_program",
+           "gen_lm_train_program", "export_gen_model", "META_FILENAME"]
+
+META_FILENAME = "gen_meta.json"
+
+
+class GenConfig:
+    """Toy-scale causal LM hyperparameters (decode mechanics, not model
+    quality, are what the gen runtime exercises)."""
+    vocab_size = 64
+    d_model = 32
+    n_head = 2
+    d_head = 16          # n_head * d_head == d_model
+    n_layer = 2
+    d_ffn = 64
+    max_len = 64         # cache length L (bucketed max sequence length)
+    eos_id = -1          # <0: no EOS in the base model (requests may
+                         # override per call)
+
+
+def _pa(name, **kw):
+    return ParamAttr(name=name, **kw)
+
+
+def _pos_table(hp):
+    from paddle_tpu.models.transformer import position_encoding_init
+    return position_encoding_init(hp.max_len, hp.d_model)
+
+
+def _embed(ids, pos_ids, hp):
+    """Shared token + position embedding (works for [B, T] prefill ids
+    and [S, 1] decode ids — lookup_table squeezes a trailing 1)."""
+    word = layers.embedding(ids, size=[hp.vocab_size, hp.d_model],
+                            param_attr=_pa("genlm_word_emb"))
+    word = layers.scale(word, scale=float(hp.d_model) ** 0.5)
+    pos = layers.embedding(
+        pos_ids, size=[hp.max_len, hp.d_model],
+        param_attr=_pa("genlm_pos_emb", trainable=False,
+                       initializer=NumpyArrayInitializer(_pos_table(hp))))
+    return word + pos
+
+
+def _ln(x, idx, tag):
+    return layers.layer_norm(
+        x, begin_norm_axis=len(x.shape) - 1,
+        param_attr=_pa(f"genlm{idx}_{tag}.scale"),
+        bias_attr=_pa(f"genlm{idx}_{tag}.bias"))
+
+
+def _ffn(x, hp, idx):
+    h = layers.fc(x, hp.d_ffn, num_flatten_dims=2, act="relu",
+                  param_attr=_pa(f"genlm{idx}_ffn1.w"),
+                  bias_attr=_pa(f"genlm{idx}_ffn1.b"))
+    return layers.fc(h, hp.d_model, num_flatten_dims=2,
+                     param_attr=_pa(f"genlm{idx}_ffn2.w"),
+                     bias_attr=_pa(f"genlm{idx}_ffn2.b"))
+
+
+def _qkv(x, hp, idx):
+    """Q/K/V projections over [B, T, d] (or [S, 1, d])."""
+    def proj(role):
+        return layers.fc(x, hp.n_head * hp.d_head, num_flatten_dims=2,
+                         bias_attr=False,
+                         param_attr=_pa(f"genlm{idx}_{role}.w"))
+    return proj("q"), proj("k"), proj("v")
+
+
+def _heads(x, hp, length):
+    """[B, T, H*D] -> [B, H, T, D]; ``length`` may be -1 (dynamic)."""
+    x = layers.reshape(x, shape=[x.shape[0], length, hp.n_head, hp.d_head])
+    return layers.transpose(x, perm=[0, 2, 1, 3])
+
+
+def _merge_heads(ctx, hp, length):
+    """[B, H, T, D] -> [B, T, H*D]."""
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    return layers.reshape(
+        ctx, shape=[ctx.shape[0], length, hp.n_head * hp.d_head])
+
+
+def _attend(q, k, v, bias, hp, idx, q_len, k_len):
+    """Scaled-dot-product attention with an additive ``bias`` mask
+    (broadcastable against [B, H, Sq, Sk] scores)."""
+    scale = float(hp.d_head) ** -0.5
+    qh = _heads(q, hp, q_len)
+    kh = _heads(k, hp, k_len)
+    vh = _heads(v, hp, k_len)
+    scores = layers.matmul(qh, kh, transpose_y=True, alpha=scale)
+    weights = layers.softmax(scores, bias=bias)
+    ctx = layers.matmul(weights, vh)
+    ctx = _merge_heads(ctx, hp, q_len)
+    return layers.fc(ctx, hp.d_model, num_flatten_dims=2, bias_attr=False,
+                     param_attr=_pa(f"genlm{idx}_attnout.w"))
+
+
+def _block_tail(x, attn, hp, idx):
+    x = _ln(x + attn, idx, "ln1")
+    return _ln(x + _ffn(x, hp, idx), idx, "ln2")
+
+
+def cache_var_names(hp):
+    """The decode program's persistable KV-cache tensor names, in the
+    (k, v) per-layer order the prefill fetch list follows."""
+    names = []
+    for i in range(hp.n_layer):
+        names.append(f"genlm_cache_k_{i}")
+        names.append(f"genlm_cache_v_{i}")
+    return names
+
+
+# ---------------------------------------------------------------------------
+# prefill: one prompt, dynamic (bucketed) length
+# ---------------------------------------------------------------------------
+
+def build_prefill_program(hp):
+    """Build the prefill forward in the CURRENT program guard.
+
+    Feeds (all length-dynamic; callers pad to a bucket):
+      ``gen_ids`` [1, T] int32, ``gen_pos`` [1, T] int32,
+      ``gen_mask`` [1, T] f32 (1 = real token),
+      ``gen_attn_bias`` [1, 1, T, T] f32 (combined causal+padding
+      additive bias), ``gen_last`` [1, T] f32 (one-hot of the last real
+      position).
+    Fetches: ``[logits [1, V], k_0, v_0, k_1, v_1, ...]`` with each
+    K/V [1, T, H*D] zeroed on pad rows (cache hygiene: decode add-writes
+    land on zeros).
+    """
+    def data(name, shape, dtype="float32"):
+        return layers.data(name=name, shape=shape, dtype=dtype,
+                           append_batch_size=False)
+
+    ids = data("gen_ids", [1, -1], "int32")
+    pos = data("gen_pos", [1, -1], "int32")
+    mask = data("gen_mask", [1, -1])
+    bias = data("gen_attn_bias", [1, 1, -1, -1])
+    last = data("gen_last", [1, -1])
+
+    x = _embed(ids, pos, hp)
+    kv = []
+    for i in range(hp.n_layer):
+        q, k, v = _qkv(x, hp, i)
+        k_m = layers.elementwise_mul(k, mask, axis=0)
+        v_m = layers.elementwise_mul(v, mask, axis=0)
+        kv += [k_m, v_m]
+        attn = _attend(q, k_m, v_m, bias, hp, i, q_len=-1, k_len=-1)
+        x = _block_tail(x, attn, hp, i)
+    last3 = layers.reshape(last, shape=[1, 1, -1])
+    lasth = layers.matmul(last3, x)                    # [1, 1, d]
+    lasth = layers.reshape(lasth, shape=[-1, hp.d_model])
+    logits = layers.fc(lasth, hp.vocab_size, bias_attr=False,
+                       param_attr=_pa("genlm_logits.w"))
+    feeds = ["gen_ids", "gen_pos", "gen_mask", "gen_attn_bias", "gen_last"]
+    return feeds, [logits] + kv
+
+
+# ---------------------------------------------------------------------------
+# decode: one token for every cache slot, constant signature
+# ---------------------------------------------------------------------------
+
+def build_decode_program(hp, num_slots):
+    """Build the single-token decode step in the CURRENT program guard.
+
+    Feeds (ALL with static shapes — one jit signature forever):
+      ``gen_token`` [S, 1] int32 (last emitted token per slot),
+      ``gen_pos`` [S, 1] int32 (its position),
+      ``gen_pos_onehot`` [S, L] f32 (1 at the write position for live
+      slots, all-zero rows for free slots — the no-write mask),
+      ``gen_attn_mask`` [S, L] f32 (1 = attendable cache position,
+      INCLUDING the current token's own).
+    Persistable state: per-layer ``genlm_cache_k_i`` / ``genlm_cache_v_i``
+    [S, L, H*D], updated in place (the executor's donated inout path).
+    Fetches: ``logits`` [S, V].
+    """
+    import paddle_tpu as fluid
+
+    S, L = int(num_slots), int(hp.max_len)
+    hd = hp.n_head * hp.d_head
+
+    def data(name, shape, dtype="float32"):
+        return layers.data(name=name, shape=shape, dtype=dtype,
+                           append_batch_size=False)
+
+    token = data("gen_token", [S, 1], "int32")
+    pos = data("gen_pos", [S, 1], "int32")
+    pos_onehot = data("gen_pos_onehot", [S, L])
+    attn_mask = data("gen_attn_mask", [S, L])
+
+    block = fluid.default_main_program().global_block()
+    caches = {}
+    for name in cache_var_names(hp):
+        c = block.create_var(name=name, shape=[S, L, hd], dtype="float32")
+        c.persistable = True
+        c.stop_gradient = True
+        caches[name] = c
+
+    x = _embed(token, pos, hp)                         # [S, d]
+    x = layers.reshape(x, shape=[S, 1, hp.d_model])
+    po3 = layers.reshape(pos_onehot, shape=[S, L, 1])
+    bias = layers.reshape(layers.scale(attn_mask, scale=1e9, bias=-1e9),
+                          shape=[S, 1, 1, L])
+    for i in range(hp.n_layer):
+        q, k, v = _qkv(x, hp, i)                       # [S, 1, H*D]
+        ck, cv = caches[f"genlm_cache_k_{i}"], caches[f"genlm_cache_v_{i}"]
+        # scatter the new token's K/V into its cache position: an outer
+        # product against the position one-hot, added IN PLACE (free
+        # slots feed an all-zero one-hot row, so nothing is written)
+        for cache, new in ((ck, k), (cv, v)):
+            delta = layers.matmul(po3, new)            # [S, L, H*D]
+            block.append_op(type="elementwise_add",
+                            inputs={"X": [cache.name], "Y": [delta.name]},
+                            outputs={"Out": [cache.name]},
+                            attrs={"axis": -1})
+        # attention over the UPDATED cache (reads after the in-place
+        # write observe the current token's own K/V)
+        attn = _attend(q, ck, cv, bias, hp, i, q_len=1, k_len=L)
+        x = _block_tail(x, attn, hp, i)
+    x2 = layers.reshape(x, shape=[S, hp.d_model])
+    logits = layers.fc(x2, hp.vocab_size, bias_attr=False,
+                       param_attr=_pa("genlm_logits.w"))
+    feeds = ["gen_token", "gen_pos", "gen_pos_onehot", "gen_attn_mask"]
+    return feeds, [logits]
+
+
+# ---------------------------------------------------------------------------
+# training graph (teacher-forced) — also the model-zoo lint gate's view
+# ---------------------------------------------------------------------------
+
+def gen_lm_train_program(batch_size, seq_len, hp: GenConfig = None):
+    """Causal-LM training forward in the current program guard; returns
+    ``(avg_cost, feed_names)``.  Feeds: ``gen_ids`` / ``gen_labels``
+    [B, T] int32."""
+    hp = hp or GenConfig()
+    B, T = int(batch_size), int(seq_len)
+
+    ids = layers.data(name="gen_ids", shape=[B, T], dtype="int32",
+                      append_batch_size=False)
+    labels = layers.data(name="gen_labels", shape=[B, T], dtype="int32",
+                         append_batch_size=False)
+    pos_np = np.tile(np.arange(T, dtype="int32"), (B, 1))
+    pos = layers.assign(pos_np)
+    tri = np.triu(np.full((T, T), -1e9, dtype="float32"), 1)
+    bias = layers.assign(tri.reshape(1, 1, T, T))
+
+    x = _embed(ids, pos, hp)
+    for i in range(hp.n_layer):
+        q, k, v = _qkv(x, hp, i)
+        attn = _attend(q, k, v, bias, hp, i, q_len=T, k_len=T)
+        x = _block_tail(x, attn, hp, i)
+    logits = layers.fc(x, hp.vocab_size, num_flatten_dims=2,
+                       bias_attr=False, param_attr=_pa("genlm_logits.w"))
+    logits2d = layers.reshape(logits, shape=[B * T, hp.vocab_size])
+    labels2d = layers.reshape(labels, shape=[B * T, 1])
+    cost = layers.softmax_with_cross_entropy(logits2d, labels2d)
+    avg_cost = layers.mean(x=cost)
+    return avg_cost, ["gen_ids", "gen_labels"]
+
+
+# ---------------------------------------------------------------------------
+# export: one parameter set -> prefill/ + decode/ + gen_meta.json
+# ---------------------------------------------------------------------------
+
+def _write_model(dirname, program, feed_names, fetch_vars, executor):
+    """The ``__model__`` + ``__params__`` pair ``io.load_inference_model``
+    reads — written WITHOUT pruning (the decode program's in-place cache
+    writes are load-bearing side effects a fetch-target prune would
+    drop)."""
+    from paddle_tpu import io as _io
+    os.makedirs(dirname, exist_ok=True)
+    model = {
+        "program": program.to_dict(),
+        "feed_var_names": list(feed_names),
+        "fetch_var_names": [v.name for v in fetch_vars],
+    }
+    with open(os.path.join(dirname, "__model__"), "w") as f:
+        json.dump(model, f)
+    _io.save_persistables(executor, dirname, program, "__params__")
+
+
+def export_gen_model(dirname, hp: GenConfig = None, num_slots=8,
+                     prompt_buckets=None):
+    """Export a generation bundle: ``<dirname>/prefill/``,
+    ``<dirname>/decode/`` (each a loadable inference model over ONE
+    shared parameter set) and ``<dirname>/gen_meta.json`` describing the
+    cache pool geometry.  Returns ``dirname``."""
+    import paddle_tpu as fluid
+    from paddle_tpu.lod import bucket_edges
+
+    hp = hp or GenConfig()
+    num_slots = int(num_slots)
+    if prompt_buckets is None:
+        prompt_buckets = bucket_edges(1, hp.max_len)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        pre_main, pre_startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(pre_main, pre_startup):
+            pre_feeds, pre_fetches = build_prefill_program(hp)
+        exe.run(pre_startup)
+        _write_model(os.path.join(dirname, "prefill"), pre_main,
+                     pre_feeds, pre_fetches, exe)
+
+        dec_main, dec_startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(dec_main, dec_startup):
+            dec_feeds, dec_fetches = build_decode_program(hp, num_slots)
+        # decode shares the ALREADY-initialized parameters (its startup
+        # is never run); the cache pool starts as zeros
+        hd = hp.n_head * hp.d_head
+        for name in cache_var_names(hp):
+            scope.set_var(name, np.zeros((num_slots, hp.max_len, hd),
+                                         dtype="float32"))
+        _write_model(os.path.join(dirname, "decode"), dec_main,
+                     dec_feeds, dec_fetches, exe)
+
+    meta = {
+        "format": "paddle_tpu.gen/1",
+        "num_slots": num_slots,
+        "max_len": int(hp.max_len),
+        "vocab_size": int(hp.vocab_size),
+        "n_layer": int(hp.n_layer),
+        "eos_id": int(hp.eos_id),
+        "cache_vars": cache_var_names(hp),
+        "prompt_buckets": [int(b) for b in prompt_buckets],
+    }
+    with open(os.path.join(dirname, META_FILENAME), "w") as f:
+        json.dump(meta, f, indent=2)
+    return dirname

@@ -833,7 +833,12 @@ def _pallas_softmax_fwd(x, row_bias, tri_bias, interpret):
 
 def _pallas_softmax_bwd(y, dy, interpret):
     """Returns None when the shape fails the SAME gate as the forward
-    (a fwd that fell back must not meet a bwd that launches)."""
+    (a fwd that fell back must not meet a bwd that launches).
+
+    ``dy`` keeps the INCOMING cotangent dtype (f32 under AMP): block
+    specs carry no dtype, so the kernel reads g at full precision from
+    the operand itself, like the XLA fallback does; only dx is cast
+    back to ``y.dtype`` on the way out (ADVICE r5)."""
     B, H, Sq, Sk = y.shape
     bs = _fsm_ok(Sq, Sk, interpret)
     if bs is None:
@@ -876,9 +881,14 @@ def _fused_softmax_fwd(x, row_bias, tri_bias, interpret):
 
 
 def _fused_softmax_bwd(interpret, y, g):
+    # g stays at the cotangent's own dtype (f32): casting it to bf16
+    # before the kernel would hand the Pallas backward LOWER gradient
+    # precision than its own XLA fallback below (ADVICE r5) — the
+    # constant component of g cancels in (g - sum(g*y))*y, so exactly
+    # the small differences a bf16 cast destroys are what dx is made of
     dx = None
     if _HAS_PALLAS:
-        dx = _pallas_softmax_bwd(y, g.astype(y.dtype), interpret)
+        dx = _pallas_softmax_bwd(y, g, interpret)
     if dx is None:
         yf = y.astype(jnp.float32)
         gf = g.astype(jnp.float32)

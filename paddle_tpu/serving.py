@@ -563,13 +563,23 @@ class InferenceServer:
     load, BEFORE ``/readyz`` flips — the first real request never pays a
     compile.  ``/stats`` serves the runtime metrics snapshot
     (``profiler.runtime_metrics``) plus server/batcher state.
+
+    A GENERATION bundle (``gen_meta.json`` + prefill/decode programs,
+    see ``paddle_tpu/gen/``) is served through ``/generate`` instead of
+    ``/predict``: continuous-batching autoregressive decode with
+    streamed (chunked) token responses over the same keep-alive
+    connection.  ``warmup=True`` then AOT-compiles BOTH signature
+    families (every prefill bucket + the decode step) before
+    ``/readyz`` flips.  ``gen_admission``/``gen_queue_size`` configure
+    the :class:`paddle_tpu.gen.GenScheduler`.
     """
 
     def __init__(self, model_dir, host="127.0.0.1", port=0,
                  async_load=False, max_inflight=32, request_timeout=None,
                  batching=False, max_batch_size=8, max_batch_delay=0.005,
                  batch_queue_size=128, warmup=False,
-                 warmup_batch_sizes=None):
+                 warmup_batch_sizes=None, gen_admission="continuous",
+                 gen_queue_size=64):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         from paddle_tpu.fault import chaos
@@ -577,6 +587,10 @@ class InferenceServer:
         from paddle_tpu.lod import bucket_edges
 
         self.predictor = None
+        self._gen = None          # GenScheduler for generation bundles
+        self.gen_predictor = None
+        self._gen_conf = {"admission": str(gen_admission),
+                          "queue_size": int(gen_queue_size)}
         self._ready = threading.Event()
         self._load_done = threading.Event()  # set on success OR failure
         self._load_error = None
@@ -604,6 +618,23 @@ class InferenceServer:
         def _load():
             try:
                 chaos.fire("serving.load", model_dir=model_dir)
+                from paddle_tpu.gen import is_gen_bundle
+                if is_gen_bundle(model_dir):
+                    from paddle_tpu.gen import GenPredictor, GenScheduler
+                    gen_predictor = GenPredictor(model_dir)
+                    if server._do_warmup:
+                        chaos.fire("serving.warmup", model_dir=model_dir)
+                        # both signature families — every prefill
+                        # bucket AND the decode step — compile before
+                        # /readyz flips
+                        gen_predictor.warmup()
+                    server.gen_predictor = gen_predictor
+                    server._gen = GenScheduler(
+                        gen_predictor,
+                        queue_size=server._gen_conf["queue_size"],
+                        admission=server._gen_conf["admission"])
+                    server._ready.set()
+                    return
                 predictor = Predictor(model_dir)
                 if server._do_warmup:
                     chaos.fire("serving.warmup", model_dir=model_dir)
@@ -681,10 +712,17 @@ class InferenceServer:
                     self._reply(200, {"status": "ok"})
                 elif self.path == "/readyz":
                     batcher = server._batcher
+                    gen = server._gen
                     if server._load_error is not None:
                         self._error(500, "model_load_failed",
                                     str(server._load_error),
                                     retryable=False)
+                    elif gen is not None and gen.failed is not None:
+                        # terminal scheduler death: every /generate
+                        # would 503 forever — pull this replica
+                        self._error(500, "scheduler_down",
+                                    f"generation scheduler is down: "
+                                    f"{gen.failed}", retryable=False)
                     elif batcher is not None and \
                             batcher.failed is not None:
                         # terminal batcher death: every /predict would
@@ -709,6 +747,10 @@ class InferenceServer:
                                     "model is still loading",
                                     retryable=True)
                 elif self.path == "/meta":
+                    if server._gen is not None:
+                        self._reply(200, {"generate": True,
+                                          **server.gen_predictor.meta})
+                        return
                     predictor = self._gate_ready()
                     if predictor is not None:
                         self._reply(200,
@@ -724,6 +766,16 @@ class InferenceServer:
                         queue_depth=batcher.queue_depth if batcher else 0,
                         warmup_batch_sizes=list(
                             server._warmup_batch_sizes))
+                    gen = server._gen
+                    if gen is not None:
+                        snap["server"]["gen"] = {
+                            "admission": gen.admission,
+                            "queue_size": gen.queue_size,
+                            "queue_depth": gen.queue_depth,
+                            "active_slots": gen.active_slots,
+                            "num_slots": gen.predictor.num_slots,
+                            "max_len": gen.predictor.max_len,
+                        }
                     self._reply(200, snap)
                 elif self.path == "/metrics":
                     from paddle_tpu.obs import prom as _prom
@@ -765,9 +817,17 @@ class InferenceServer:
                                 "invalid Content-Length header",
                                 retryable=False)
                     return
+                if self.path == "/generate":
+                    self._handle_generate(raw)
+                    return
                 if self.path not in ("/predict", "/run"):
                     self._error(404, "not_found", self.path,
                                 retryable=False)
+                    return
+                if server._gen is not None:
+                    self._error(404, "not_found",
+                                "generation bundle: POST /generate "
+                                "instead of /predict", retryable=False)
                     return
                 predictor = self._gate_ready()
                 if predictor is None:
@@ -851,6 +911,204 @@ class InferenceServer:
                         "serving.request_seconds",
                         time.perf_counter() - t0)
 
+            # -- continuous-batching generation (/generate) ------------
+            def _write_chunk(self, obj):
+                """One chunked-transfer ndjson line.  The
+                ``gen.client.disconnect`` failpoint fires per chunk —
+                an armed ``error`` simulates the client dropping
+                mid-stream exactly at a write boundary (the slot-
+                reclamation drill)."""
+                chaos.fire("gen.client.disconnect")
+                data = (json.dumps(obj) + "\n").encode()
+                self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                self.wfile.flush()
+
+            def _handle_generate(self, raw):
+                from paddle_tpu.fault.retry import parse_deadline_ms
+                # load gates FIRST: while the loader runs we cannot yet
+                # know whether this model even has a /generate, and a
+                # retryable 503 keeps the router failing over instead
+                # of a permanent 404 for a replica that is milliseconds
+                # from ready
+                if server._load_error is not None:
+                    self._error(500, "model_load_failed",
+                                str(server._load_error), retryable=False)
+                    return
+                if not server._ready.is_set():
+                    self._error(503, "model_loading",
+                                "model is still loading; retry later",
+                                retryable=True)
+                    return
+                gen = server._gen
+                if gen is None:
+                    self._error(404, "not_found",
+                                "this model has no /generate (one-shot "
+                                "inference model: POST /predict)",
+                                retryable=False)
+                    return
+                try:
+                    budget = parse_deadline_ms(
+                        self.headers.get("X-Deadline-Ms"))
+                except ValueError:
+                    self._error(400, "bad_request",
+                                f"invalid X-Deadline-Ms header: "
+                                f"{self.headers.get('X-Deadline-Ms')!r}",
+                                retryable=False)
+                    return
+                timeout = server._request_timeout
+                if budget is not None:
+                    if budget <= 0:
+                        # already expired on arrival: the immediate-504
+                        # MicroBatcher contract at the generation edge
+                        _profiler.runtime_metrics.inc("gen.expired")
+                        self._error(504, "deadline_exceeded",
+                                    "caller deadline already expired",
+                                    retryable=True)
+                        return
+                    timeout = budget if timeout is None \
+                        else min(timeout, budget)
+                try:
+                    req = json.loads(raw)
+                    prompt = req["prompt"]
+                    max_new = int(req.get("max_new_tokens", 16))
+                    eos_id = req.get("eos_id")
+                    do_stream = bool(req.get("stream", True))
+                except (ValueError, KeyError, TypeError) as e:
+                    self._error(400, "bad_request", str(e),
+                                retryable=False)
+                    return
+                with _trace.trace_context(self._request_id), \
+                        _span("gen.request",
+                              request_id=self._request_id,
+                              path=self.path, port=server.addr[1]):
+                    try:
+                        stream = gen.submit(prompt, max_new_tokens=max_new,
+                                            deadline=budget, eos_id=eos_id,
+                                            timeout=timeout)
+                    except QueueFull as e:
+                        self._error(503, "overloaded", str(e),
+                                    retryable=True)
+                        return
+                    except BatcherCrashed as e:
+                        self._error(503, "scheduler_restarted", str(e),
+                                    retryable=True)
+                        return
+                    except (ValueError, KeyError, TypeError) as e:
+                        self._error(400, "bad_request", str(e),
+                                    retryable=False)
+                        return
+                    # the reply STATUS is decided by the first event
+                    # (admitted and producing vs shed), so headers wait
+                    # for the first token — that instant IS the TTFT.
+                    # With an explicit deadline, wait slightly PAST it:
+                    # the scheduler's own expiry sweep (504 +
+                    # gen.expired) is the authoritative verdict, the
+                    # handler timeout only a backstop
+                    first_wait = timeout
+                    if budget is not None and first_wait is not None:
+                        first_wait = timeout + 0.5
+                    first = stream.next_event(timeout=first_wait)
+                    if first is None:
+                        stream.cancel()
+                        _profiler.runtime_metrics.inc(
+                            "serving.deadline_exceeded")
+                        self._error(504, "deadline_exceeded",
+                                    f"no first token within {timeout}s",
+                                    retryable=True)
+                        return
+                    if first[0] == "error":
+                        self._gen_error(first[1])
+                        return
+                    if not do_stream:
+                        self._generate_buffered(stream, first)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    if self._request_id:
+                        self.send_header("X-Request-Id", self._request_id)
+                    self.end_headers()
+                    try:
+                        self._write_chunk({"token": first[1], "index": 0})
+                        index = 1
+                        while True:
+                            ev = stream.next_event(timeout=300)
+                            if ev is None:
+                                # nobody will consume further tokens:
+                                # release the KV slot too
+                                stream.cancel()
+                                self._write_chunk(
+                                    {"error": {"type": "stalled",
+                                               "message": "generation "
+                                               "stalled"}, "done": True})
+                                break
+                            kind, value = ev
+                            if kind == "token":
+                                self._write_chunk({"token": value,
+                                                   "index": index})
+                                index += 1
+                            elif kind == "done":
+                                self._write_chunk(
+                                    {"done": True,
+                                     "finish_reason": value,
+                                     "tokens": len(stream.tokens)})
+                                break
+                            else:
+                                self._write_chunk(
+                                    {"error": {
+                                        "type": type(value).__name__,
+                                        "message": str(value)},
+                                     "done": True})
+                                break
+                        self.wfile.write(b"0\r\n\r\n")
+                    except (OSError, chaos.FaultInjected):
+                        # the client went away mid-stream (or the
+                        # disconnect drill fired): reclaim the slot and
+                        # drop the connection — the decode loop must
+                        # never crash on a closed socket
+                        stream.cancel()
+                        self.close_connection = True
+
+            def _generate_buffered(self, stream, first):
+                """stream=false: collect the full generation and reply
+                with a normal Content-Length body."""
+                tokens = [first[1]]
+                while True:
+                    ev = stream.next_event(timeout=300)
+                    if ev is None:
+                        stream.cancel()   # free the slot: nobody reads
+                        ev = ("error",
+                              DeadlineExceeded("generation stalled"))
+                    kind, value = ev
+                    if kind == "token":
+                        tokens.append(value)
+                    elif kind == "done":
+                        self._reply(200, {"tokens": tokens,
+                                          "finish_reason": value,
+                                          "done": True})
+                        return
+                    else:
+                        self._gen_error(value)
+                        return
+
+            def _gen_error(self, exc):
+                if isinstance(exc, DeadlineExceeded):
+                    self._error(504, "deadline_exceeded", str(exc),
+                                retryable=True)
+                elif isinstance(exc, QueueFull):
+                    self._error(503, "overloaded", str(exc),
+                                retryable=True)
+                elif isinstance(exc, BatcherCrashed):
+                    self._error(503, "scheduler_restarted", str(exc),
+                                retryable=True)
+                elif isinstance(exc, (ValueError, KeyError, TypeError)):
+                    self._error(400, "bad_request", str(exc),
+                                retryable=False)
+                else:
+                    self._error(500, "internal", str(exc),
+                                retryable=False)
+
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.addr = self._server.server_address
         if async_load:
@@ -895,6 +1153,8 @@ class InferenceServer:
         self._server.shutdown()
         if self._batcher is not None:
             self._batcher.close()
+        if self._gen is not None:
+            self._gen.close()
         self._server.server_close()
 
 
@@ -1088,6 +1348,104 @@ class ServingClient:
         return [np.asarray(o) if dt is None else np.asarray(o, dtype=dt)
                 for o, dt in zip(resp["outputs"], dtypes)]
 
+    def generate(self, prompt, max_new_tokens=16, eos_id=None,
+                 stream=True, retry=True):
+        """Stream a generation from ``/generate``: returns an iterator
+        of parsed ndjson events — ``{"token": id, "index": i}`` per
+        produced token, then ``{"done": true, "finish_reason": ...}``
+        (or ``{"error": ..., "done": true}`` if the stream failed
+        mid-flight).  Chunks are yielded AS THEY ARRIVE, so the first
+        token is available while the server is still decoding.
+
+        Pre-stream failures (connection errors, retryable 503/504
+        replies) retry/fail over under the client's policy like
+        ``predict``; once streaming has begun the request is NOT
+        replayed — a mid-stream failure surfaces as an error event."""
+        import http.client
+        from paddle_tpu.fault.retry import RetryError, parse_hostport
+
+        rid = _trace.current_trace_id() or _trace.new_trace_id()
+        payload = {"prompt": [int(t) for t in prompt],
+                   "max_new_tokens": int(max_new_tokens),
+                   "stream": bool(stream)}
+        if eos_id is not None:
+            payload["eos_id"] = int(eos_id)
+        body = json.dumps(payload).encode()
+        history = []
+        deadline_at = None if self._deadline is None \
+            else time.monotonic() + self._deadline
+
+        def attempt():
+            base = self._pick_base(history)
+            history.append(base)
+            host, port = parse_hostport(base[len("http://"):])
+            headers = {"Content-Type": "application/json",
+                       "X-Request-Id": rid}
+            timeout = self._timeout
+            if deadline_at is not None:
+                remaining = max(deadline_at - time.monotonic(), 0.001)
+                headers["X-Deadline-Ms"] = str(int(remaining * 1000) or 1)
+                timeout = min(timeout, remaining)
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
+            try:
+                conn.request("POST", "/generate", body, headers)
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                raise ConnectionError(str(e)) from e
+            if resp.status != 200:
+                data = resp.read()
+                conn.close()
+                try:
+                    parsed = json.loads(data)
+                except ValueError:
+                    parsed = {"retryable": resp.status in (502, 503, 504)}
+                err = parsed.get("error") or {}
+                if parsed.get("retryable"):
+                    raise _TransientServingError(
+                        f"{err.get('type', 'http')}: "
+                        f"{err.get('message', resp.status)}")
+                raise ServingError(err.get("type", "http"),
+                                   err.get("message", str(resp.status)),
+                                   retryable=False)
+            return conn, resp
+
+        try:
+            if retry:
+                conn, resp = self._retry.call(attempt,
+                                              deadline=self._deadline)
+            else:
+                conn, resp = attempt()
+        except RetryError as e:
+            e.history = list(history)
+            raise
+
+        def events():
+            import http.client
+            try:
+                while True:
+                    try:
+                        line = resp.readline()
+                        if not line:
+                            return
+                        obj = json.loads(line)
+                    except (OSError, http.client.HTTPException,
+                            ValueError) as e:
+                        # the documented mid-stream contract: failures
+                        # surface as a terminal error EVENT, never as a
+                        # raw exception out of the iterator
+                        yield {"error": {"type": type(e).__name__,
+                                         "message": str(e)},
+                               "done": True}
+                        return
+                    yield obj
+                    if obj.get("done"):
+                        return
+            finally:
+                conn.close()
+
+        return events()
+
     def meta(self):
         return self._request("/meta")
 
@@ -1140,7 +1498,8 @@ class ServingClient:
 def serve(model_dir, host="127.0.0.1", port=8866, async_load=False,
           max_inflight=32, request_timeout=None, batching=False,
           max_batch_size=8, max_batch_delay=0.005, batch_queue_size=128,
-          warmup=False, warmup_batch_sizes=None):
+          warmup=False, warmup_batch_sizes=None,
+          gen_admission="continuous", gen_queue_size=64):
     server = InferenceServer(model_dir, host, port, async_load=async_load,
                              max_inflight=max_inflight,
                              request_timeout=request_timeout,
@@ -1149,7 +1508,9 @@ def serve(model_dir, host="127.0.0.1", port=8866, async_load=False,
                              max_batch_delay=max_batch_delay,
                              batch_queue_size=batch_queue_size,
                              warmup=warmup,
-                             warmup_batch_sizes=warmup_batch_sizes)
+                             warmup_batch_sizes=warmup_batch_sizes,
+                             gen_admission=gen_admission,
+                             gen_queue_size=gen_queue_size)
     print(f"serving {model_dir} on {server.addr[0]}:{server.addr[1]}",
           flush=True)
     server.serve_forever()

