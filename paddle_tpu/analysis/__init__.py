@@ -12,12 +12,18 @@ can BEFORE tracing:
 * :mod:`~paddle_tpu.analysis.typecheck` — per-op shape/dtype inference
   rules with a warn-list for uncovered ops (PTA005, PTA006, PTA010);
 * :mod:`~paddle_tpu.analysis.lints` — dead ops, unused feeds,
-  donation/aliasing hazards (PTA007–PTA009).
+  donation/aliasing hazards (PTA007–PTA009);
+* :mod:`~paddle_tpu.analysis.distributed` — cross-program verifier for
+  the families a transpile produces: collective matching, Send/Recv
+  pairing, split reassembly, stage boundary agreement, sharding-spec
+  propagation, recompile hazards (PTA011–PTA019).
 
 Entry points: ``lint_program`` (everything; ``paddle_tpu lint``),
 ``verify_program`` (structural, raising — the ``PADDLE_TPU_VERIFY=1``
 executor hook), ``verify_transpiled`` (the post-rewrite contract every
-transpiler calls).
+transpiler calls), and the multi-program units ``lint_gen_bundle`` /
+``lint_pipeline`` / ``lint_pair`` (``paddle_tpu lint``'s gen-bundle,
+``--pipeline``, and ``--pair`` modes).
 """
 
 from paddle_tpu.analysis.analyzer import (AnalysisResult, analyze_program,
@@ -28,10 +34,20 @@ from paddle_tpu.analysis.diagnostics import (DIAGNOSTIC_CODES, Diagnostic,
                                              ProgramVerificationError,
                                              format_diagnostics)
 from paddle_tpu.analysis import typecheck
+from paddle_tpu.analysis import distributed
+from paddle_tpu.analysis.distributed import (check_distributed_spec,
+                                             check_gen_bundle,
+                                             check_stage_set,
+                                             check_transpiled_pair,
+                                             lint_gen_bundle, lint_pair,
+                                             lint_pipeline,
+                                             verify_gen_bundle)
 
 __all__ = [
     "AnalysisResult", "analyze_program", "lint_program", "verify_program",
     "verify_transpiled", "check_pipeline_carriers", "DIAGNOSTIC_CODES",
     "Diagnostic", "ProgramVerificationError", "format_diagnostics",
-    "typecheck",
+    "typecheck", "distributed", "check_distributed_spec",
+    "check_gen_bundle", "check_stage_set", "check_transpiled_pair",
+    "lint_gen_bundle", "lint_pair", "lint_pipeline", "verify_gen_bundle",
 ]

@@ -37,6 +37,31 @@ DIAGNOSTIC_CODES = {
     "PTA008": ("warning", "unused feed"),
     "PTA009": ("warning", "donated buffer read after its donating op"),
     "PTA010": ("error", "int64 value will silently truncate to int32"),
+    # distributed verifier (analysis/distributed.py): cross-program
+    # checks over the families a transpile produces — SPMD replicas,
+    # pipeline stage sets, trainer/pserver pairs, gen bundles
+    "PTA011": ("error",
+               "collectives desynced across distributed programs "
+               "(static deadlock)"),
+    "PTA012": ("error",
+               "matched collectives disagree on axis/participants/"
+               "shape/dtype"),
+    "PTA013": ("error", "Send without matching Recv (or vice versa) "
+                        "in a transpiled pair"),
+    "PTA014": ("error",
+               "parameter/gradient split blocks do not reassemble to "
+               "the original shape"),
+    "PTA015": ("error",
+               "pipeline stage boundary carrier mismatch between "
+               "producer and consumer"),
+    "PTA016": ("error", "invalid or conflicting sharding spec"),
+    "PTA017": ("warning",
+               "implicit full reshard (operands sharded differently)"),
+    "PTA018": ("warning",
+               "recompile hazard: feed can escape its declared "
+               "row-bucket edges"),
+    "PTA019": ("error",
+               "gen bundle prefill/decode signature drift"),
 }
 
 
@@ -44,10 +69,10 @@ class Diagnostic:
     """One analyzer finding, formatted rustc-style by :meth:`format`."""
 
     __slots__ = ("code", "severity", "message", "block_idx", "op_index",
-                 "op_type", "var", "site")
+                 "op_type", "var", "site", "program")
 
     def __init__(self, code, message, block_idx=None, op_index=None,
-                 op_type=None, var=None, site=None):
+                 op_type=None, var=None, site=None, program=None):
         if code not in DIAGNOSTIC_CODES:
             raise ValueError(f"unknown diagnostic code {code!r}")
         self.code = code
@@ -58,6 +83,7 @@ class Diagnostic:
         self.op_type = op_type
         self.var = var
         self.site = site  # (filename, lineno) construction site or None
+        self.program = program  # member label in a multi-program lint
 
     @property
     def title(self):
@@ -65,6 +91,8 @@ class Diagnostic:
 
     def location(self):
         parts = []
+        if self.program is not None:
+            parts.append(f"program `{self.program}`")
         if self.block_idx is not None:
             parts.append(f"block {self.block_idx}")
         if self.op_index is not None:
@@ -89,7 +117,7 @@ class Diagnostic:
         return {"code": self.code, "severity": self.severity,
                 "message": self.message, "block": self.block_idx,
                 "op_index": self.op_index, "op_type": self.op_type,
-                "var": self.var,
+                "var": self.var, "program": self.program,
                 "site": list(self.site) if self.site else None}
 
     def __repr__(self):

@@ -1,0 +1,268 @@
+"""``paddle_tpu selfcheck`` — every static gate in one exit-coded pass.
+
+CI and humans need ONE command that answers "is the static story
+green?": the model zoo lints clean (single-program AND as the
+transpiled families the distributed verifier covers), and every
+scanner-enforced registry — diagnostic codes, metric names, chaos
+failpoints — agrees with its documentation table.  The pytest suite
+enforces the same invariants test-by-test; this module re-runs them as
+a deployable command (no pytest, no tests/ checkout needed) so drift
+fails a release gate, not a 3am dashboard hunt.
+
+Each section returns ``{"name", "ok", "detail", "failures": [...]}``;
+the report is ``{"ok": all-green, "sections": [...]}``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import tempfile
+
+import paddle_tpu
+
+__all__ = ["run_selfcheck"]
+
+SRC_ROOT = os.path.dirname(os.path.abspath(paddle_tpu.__file__))
+DOCS_DIR = os.path.join(os.path.dirname(SRC_ROOT), "docs")
+
+# the same scanner regexes the registry tests use (kept in lockstep by
+# tests/test_selfcheck.py's agreement checks)
+_CODE = re.compile(r"\bPTA\d{3}\b")
+_DOC_CODE = re.compile(r"^\|\s*`(PTA\d{3})`\s*\|", re.M)
+_METRIC_LITERAL = re.compile(
+    r"\.(?:inc|observe|bucket|set_gauge)\(\s*[\"']([a-zA-Z0-9_.]+)[\"']")
+_METRIC_LATENCY = re.compile(r"record_latency\(\s*[\"']([a-zA-Z0-9_.]+)[\"']")
+_METRIC_STAGE = re.compile(
+    r"\.(?:inc|observe|bucket|set_gauge)\(\s*\n?\s*self\._metrics\s*\+"
+    r"\s*[\"']\.([a-zA-Z0-9_]+)[\"']")
+_METRIC_MIRROR = re.compile(
+    r"[\"']((?:compile|compile_cache)\.[a-zA-Z0-9_.]+)[\"']")
+_DOC_METRIC = re.compile(r"^\|\s*`([a-zA-Z0-9_.<>]+)`\s*\|", re.M)
+_FIRE = re.compile(
+    r"\b_?chaos\.fire\(\s*\n?\s*[\"']"
+    r"([a-z0-9_]+(?:\.[a-z0-9_]+)+)[\"']")
+_DOC_FAILPOINT = re.compile(r"^\|\s*`([a-z0-9_.]+)`\s*\|", re.M)
+
+
+def _iter_sources():
+    for dirpath, _, names in os.walk(SRC_ROOT):
+        for n in sorted(names):
+            if n.endswith(".py"):
+                with open(os.path.join(dirpath, n)) as f:
+                    yield os.path.join(dirpath, n), f.read()
+
+
+def _read_doc(name):
+    with open(os.path.join(DOCS_DIR, name)) as f:
+        return f.read()
+
+
+def _section(name, detail, failures):
+    return {"name": name, "ok": not failures, "detail": detail,
+            "failures": list(failures)}
+
+
+# ---------------------------------------------------------------------------
+# zoo gates
+# ---------------------------------------------------------------------------
+
+def _check_zoo_lint():
+    """Strict single-program lint: zero errors AND zero warnings across
+    every zoo model's forward+backward and startup programs."""
+    from paddle_tpu import analysis
+    from paddle_tpu.models import ZOO_MODELS, build_train_program
+
+    failures = []
+    for name in ZOO_MODELS:
+        main, startup, feeds, fetches = build_train_program(name)
+        for label, prog, fd, ft in ((name, main, feeds, fetches),
+                                    (f"{name}/startup", startup, None,
+                                     None)):
+            r = analysis.lint_program(prog, feed_names=fd, fetch_names=ft)
+            for d in r.diagnostics:
+                failures.append(f"[{label}] {d.severity}[{d.code}]: "
+                                f"{d.message}")
+    return _section("zoo-lint",
+                    f"{len(ZOO_MODELS)} models, strict (warnings fail)",
+                    failures)
+
+
+def _check_zoo_distribute():
+    """Every zoo model's DistributeTranspiler plan (sharded params over
+    2 shards) verifies clean."""
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import ProgramVerificationError
+    from paddle_tpu.models import ZOO_MODELS, build_train_program
+    from paddle_tpu.parallel.distribute_transpiler import \
+        DistributeTranspiler
+
+    failures = []
+    for name in ZOO_MODELS:
+        main, startup, _feeds, _fetches = build_train_program(name)
+        t = DistributeTranspiler()
+        try:
+            t.transpile(program=main, startup_program=startup,
+                        pservers="a:1,b:2", shard_params=True)
+        except ProgramVerificationError as e:
+            failures.append(f"[{name}] {e.args[0].splitlines()[0]}")
+            continue
+        diags = analysis.check_distributed_spec(main, t.spec)
+        for d in diags:
+            failures.append(f"[{name}] {d.severity}[{d.code}]: "
+                            f"{d.message}")
+    return _section("zoo-distribute",
+                    "DistributeTranspiler plan verification, 2 shards",
+                    failures)
+
+
+def _check_zoo_pipeline():
+    """Every splittable zoo model's 2-stage pipeline split verifies
+    clean (models whose split is rejected outright — a tensor_array
+    crossing a cut — are skipped, as the transpiler itself refuses
+    them with a recipe)."""
+    from paddle_tpu import analysis
+    from paddle_tpu.models import ZOO_MODELS, build_train_program
+
+    failures = []
+    skipped = []
+    for name in ZOO_MODELS:
+        main, _startup, feeds, fetches = build_train_program(name)
+        if feeds is None:
+            feeds = [v.name
+                     for v in main.global_block().vars.values()
+                     if getattr(v, "is_data", False)]
+        try:
+            r = analysis.lint_pipeline(main, 2, feeds, fetches)
+        except ValueError:
+            skipped.append(name)
+            continue
+        for d in r.diagnostics:
+            failures.append(f"[{name}] {d.severity}[{d.code}]: "
+                            f"{d.message}")
+    detail = "2-stage split verification"
+    if skipped:
+        detail += f" (unsplittable, skipped: {', '.join(skipped)})"
+    return _section("zoo-pipeline", detail, failures)
+
+
+def _check_gen_bundle():
+    """A freshly exported generation bundle (prefill/decode/meta) lints
+    clean in multi-program mode."""
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import ProgramVerificationError
+    from paddle_tpu.models import gen_lm
+
+    failures = []
+    hp = gen_lm.GenConfig()
+    hp.vocab_size, hp.d_model, hp.d_ffn = 32, 16, 32
+    hp.n_head = hp.n_layer = 2
+    hp.d_head, hp.max_len = 8, 16
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_selfcheck_gen_")
+    try:
+        try:
+            gen_lm.export_gen_model(tmp, hp, num_slots=2)
+        except ProgramVerificationError as e:
+            failures.append(e.args[0].splitlines()[0])
+        else:
+            for label, r in analysis.lint_gen_bundle(tmp):
+                for d in r.diagnostics:
+                    failures.append(f"[{label}] {d.severity}[{d.code}]: "
+                                    f"{d.message}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return _section("gen-bundle",
+                    "export + multi-program lint of prefill/decode",
+                    failures)
+
+
+# ---------------------------------------------------------------------------
+# registry scanners (the doc/code lockstep gates)
+# ---------------------------------------------------------------------------
+
+def _check_diagnostic_registry():
+    from paddle_tpu.analysis.diagnostics import DIAGNOSTIC_CODES
+
+    emitted = set()
+    for path, text in _iter_sources():
+        rel = os.path.relpath(path, SRC_ROOT)
+        if os.path.dirname(rel) != "analysis" or \
+                os.path.basename(rel) == "diagnostics.py":
+            continue
+        emitted.update(_CODE.findall(text))
+    documented = set(_DOC_CODE.findall(_read_doc("static_analysis.md")))
+    failures = []
+    for code in sorted(emitted - set(DIAGNOSTIC_CODES)):
+        failures.append(f"emitted but undeclared: {code}")
+    for code in sorted(set(DIAGNOSTIC_CODES) - emitted):
+        failures.append(f"declared but no pass emits it: {code}")
+    for code in sorted(set(DIAGNOSTIC_CODES) - documented):
+        failures.append(f"undocumented in static_analysis.md: {code}")
+    for code in sorted(documented - set(DIAGNOSTIC_CODES)):
+        failures.append(f"documented but unknown: {code}")
+    return _section("diagnostic-registry",
+                    f"{len(DIAGNOSTIC_CODES)} codes declared/emitted/"
+                    f"documented in lockstep", failures)
+
+
+def _emitted_metric_names():
+    names = set()
+    latency = set()
+    for path, text in _iter_sources():
+        names.update(_METRIC_LITERAL.findall(text))
+        found = _METRIC_LATENCY.findall(text)
+        latency.update(found)
+        names.update(found)
+        for suffix in _METRIC_STAGE.findall(text):
+            names.add(f"datapipe.<stage>.{suffix}")
+        if path.endswith("profiler.py"):
+            names.update(_METRIC_MIRROR.findall(text))
+    names.update(f"{n}.errors" for n in latency)
+    return names
+
+
+def _check_metric_registry():
+    documented = set(_DOC_METRIC.findall(_read_doc("observability.md")))
+    failures = []
+    for name in sorted(_emitted_metric_names()):
+        if name in documented:
+            continue
+        if name.endswith(".errors") and "<series>.errors" in documented:
+            continue
+        m = re.match(r"datapipe\.[a-zA-Z0-9_]+\.([a-zA-Z0-9_]+)$", name)
+        if m and f"datapipe.<stage>.{m.group(1)}" in documented:
+            continue
+        failures.append(f"emitted but undocumented: {name}")
+    return _section("metric-registry",
+                    f"{len(documented)} documented metric rows",
+                    failures)
+
+
+def _check_failpoint_registry():
+    fired = set()
+    for path, text in _iter_sources():
+        if os.path.relpath(path, SRC_ROOT) == os.path.join("fault",
+                                                           "chaos.py"):
+            continue
+        fired.update(_FIRE.findall(text))
+    documented = set(_DOC_FAILPOINT.findall(
+        _read_doc("fault_tolerance.md")))
+    failures = [f"fired but undocumented: {n}"
+                for n in sorted(fired - documented)]
+    return _section("failpoint-registry",
+                    f"{len(fired)} fire sites scanned", failures)
+
+
+def run_selfcheck():
+    """Run every section; returns the report dict."""
+    sections = [
+        _check_zoo_lint(),
+        _check_zoo_distribute(),
+        _check_zoo_pipeline(),
+        _check_gen_bundle(),
+        _check_diagnostic_registry(),
+        _check_metric_registry(),
+        _check_failpoint_registry(),
+    ]
+    return {"ok": all(s["ok"] for s in sections), "sections": sections}

@@ -631,6 +631,87 @@ def _r_layer_norm(op, tc):
     tc.set_output(op, "Y", shape=x.shape, dtype=x.dtype)
 
 
+# ---------------------------------------------------------------------------
+# gradient-op rules: the single largest warn-list family.  Every
+# ``<type>_grad`` op built by ``registry.default_grad_maker`` follows
+# one slot convention — inputs carry the forward slots (same names) and
+# outputs carry ``<slot>@GRAD`` per differentiable forward input — and
+# the cotangent of a tensor always has THAT TENSOR's shape and dtype.
+# So one mirror rule covers the family soundly: each ``<slot>@GRAD``
+# output copies the shape/dtype of the forward input it differentiates,
+# index-aligned within the slot (nothing is ever *reported* here —
+# propagation only, so downstream rules like the optimizer Param/Grad
+# agreement can see through backward chains).
+# ---------------------------------------------------------------------------
+
+_GRAD_MIRROR_OPS = tuple(
+    t + "_grad" for t in _UNARY_OPS + (
+        "mul", "matmul", "elementwise_add", "elementwise_sub",
+        "elementwise_mul", "elementwise_div", "elementwise_max",
+        "elementwise_min", "elementwise_pow", "sum", "mean", "concat",
+        "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+        "reduce_prod", "cross_entropy", "softmax_with_cross_entropy",
+        "lookup_table", "reshape", "reshape2", "transpose",
+        "transpose2", "conv2d", "pool2d", "batch_norm", "layer_norm",
+        "sequence_pool", "lstm",
+    ))
+
+
+@rule(*_GRAD_MIRROR_OPS)
+def _r_grad_mirror(op, tc):
+    for slot, names in op.outputs.items():
+        if not slot.endswith(framework.GRAD_SUFFIX):
+            # auxiliary outputs (saved state, scratch): unknown
+            tc.set_output(op, slot)
+            continue
+        fwd = op.input(slot[:-len(framework.GRAD_SUFFIX)])
+        for i, n in enumerate(names):
+            src = tc.info(fwd[i]) if i < len(fwd) else _UNKNOWN
+            tc.set(n, shape=src.shape, dtype=src.dtype)
+
+
+@rule("increment")
+def _r_increment(op, tc):
+    tc.copy_unary(op)
+
+
+@rule("assign_value")
+def _r_assign_value(op, tc):
+    tc.set_output(op, "Out", shape=op.attr("shape"),
+                  dtype=op.attr("dtype", "float32"))
+
+
+@rule("max_sequence_len")
+def _r_max_sequence_len(op, tc):
+    tc.set_output(op, "Out", shape=(1,), dtype="int64")
+
+
+@rule("sequence_expand")
+def _r_sequence_expand(op, tc):
+    # row count follows the LoD expansion (unknown statically);
+    # feature dims and dtype carry through
+    x = tc.input_info(op, "X")
+    shape = (-1,) + tuple(x.shape[1:]) if x.shape is not None else None
+    tc.set_output(op, "Out", shape=shape, dtype=x.dtype)
+
+
+@rule("less_than", "less_equal", "greater_than", "greater_equal",
+      "equal", "not_equal")
+def _r_compare(op, tc):
+    x = tc.input_info(op, "X")
+    tc.set_output(op, "Out", shape=x.shape, dtype="bool")
+
+
+@rule("sequence_pool")
+def _r_sequence_pool(op, tc):
+    # rows collapse per sequence: the batch dim is LoD-dependent
+    # (unknown statically), the feature dims and dtype carry through
+    x = tc.input_info(op, "X")
+    shape = (-1,) + tuple(x.shape[1:]) if x.shape is not None else None
+    tc.set_output(op, "Out", shape=shape, dtype=x.dtype)
+    tc.set_output(op, "MaxIndex", shape=shape, dtype="int32")
+
+
 @rule("sgd", "momentum", "adam", "adamax", "adagrad", "adadelta",
       "decayed_adagrad", "rmsprop", "ftrl", "lars_momentum")
 def _r_optimizer(op, tc):

@@ -39,7 +39,17 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              rustc-style diagnostics with
                                              stable PTA*** codes
                                              (docs/static_analysis.md);
-                                             exit 1 on errors
+                                             exit 1 on errors.  Multi-
+                                             program: a gen-bundle dir
+                                             lints prefill+decode as one
+                                             unit; --pair T P lints a
+                                             transpiled trainer/pserver
+                                             pair; --pipeline N verifies
+                                             an N-stage split
+  selfcheck                                  strict zoo lint (single- and
+                                             multi-program) + every
+                                             scanner-enforced registry in
+                                             one exit-coded pass
   profile [--model transformer|resnet ...]   per-op device-time table of
                                              one compiled training step
   version
@@ -355,17 +365,58 @@ def _cmd_replay(args):
     return 0 if report["reproduced"] else 1
 
 
+def _load_saved_program(target):
+    """(program, feeds, fetches) from a save_inference_model dir or a
+    ``__model__`` json file; raises the loader errors."""
+    from paddle_tpu.analysis.distributed import load_saved_program
+    return load_saved_program(target)
+
+
 def _cmd_lint(args):
     """Static analysis over a Program IR (``paddle_tpu.analysis``):
     lint a saved inference model (its ``__model__`` program, no params
     or executor needed — the analysis is static) or a model-zoo
-    program built forward+backward.  Prints rustc-style diagnostics
-    with stable ``PTA***`` codes; exit 0 = clean, 1 = findings
-    (errors always; warnings only under --strict), 2 = bad target."""
+    program built forward+backward.  Multi-program modes lint a whole
+    transpiled FAMILY as one unit: a dir with ``gen_meta.json`` lints
+    the prefill+decode pair plus the cross-program signature checks, a
+    ``--pair trainer pserver`` lints Send/Recv matching and split
+    reassembly, ``--pipeline N`` splits the program into N stages and
+    verifies boundary carriers and cross-stage collective sync.
+    Prints rustc-style diagnostics with stable ``PTA***`` codes; exit
+    0 = clean, 1 = findings (errors always; warnings only under
+    --strict), 2 = bad target."""
     import json as _json
 
     from paddle_tpu import analysis
-    from paddle_tpu.framework import Program
+
+    # ---- multi-program modes: results come pre-analyzed ----
+    results = None  # list of (label, AnalysisResult)
+    if args.pair:
+        members = []
+        for role, target in zip(("trainer", "pserver"), args.pair):
+            try:
+                program, feeds, fetches = _load_saved_program(target)
+            except (OSError, ValueError, KeyError) as e:
+                print(f"lint: cannot load a program from {target!r}: "
+                      f"{e}", file=sys.stderr)
+                return 2
+            members.append((role, program, feeds, fetches))
+        results = [(label, analysis.lint_program(
+            program, feed_names=feeds, fetch_names=fetches))
+            for label, program, feeds, fetches in members]
+        results.append(("pair", analysis.lint_pair(
+            (members[0][0], members[0][1]),
+            [(members[1][0], members[1][1])])))
+    elif args.target and os.path.isdir(args.target) and \
+            os.path.isfile(os.path.join(args.target, "gen_meta.json")):
+        try:
+            results = analysis.lint_gen_bundle(args.target)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"lint: cannot load the gen bundle at "
+                  f"{args.target!r}: {e}", file=sys.stderr)
+            return 2
+    if results is not None:
+        return _report_lint(results, args)
 
     targets = []  # (label, program, feed_names, fetch_names)
     if args.zoo:
@@ -381,21 +432,16 @@ def _cmd_lint(args):
             targets.append((name, main, feeds, fetches))
             targets.append((f"{name}/startup", startup, None, None))
     elif args.target:
-        model_path = os.path.join(args.target, "__model__") \
-            if os.path.isdir(args.target) else args.target
         try:
-            with open(model_path) as f:
-                model = _json.load(f)
-            program = Program.from_dict(model["program"])
+            program, feeds, fetches = _load_saved_program(args.target)
         except (OSError, ValueError, KeyError) as e:
             print(f"lint: cannot load a program from "
                   f"{args.target!r}: {e}", file=sys.stderr)
             return 2
-        targets.append((args.target, program,
-                        model.get("feed_var_names"),
-                        model.get("fetch_var_names")))
+        targets.append((args.target, program, feeds, fetches))
     else:
-        print("lint: need a MODEL_DIR or --zoo NAME|all", file=sys.stderr)
+        print("lint: need a MODEL_DIR, --zoo NAME|all, or --pair "
+              "TRAINER PSERVER", file=sys.stderr)
         return 2
 
     # --feed/--fetch override the MAIN programs only: the auto-added
@@ -411,34 +457,83 @@ def _cmd_lint(args):
                     ft if lbl.endswith("/startup") else fetch_override)
                    for lbl, p, fd, ft in targets]
 
-    n_err = n_warn = 0
-    uncovered = set()
-    reports = []
+    results = []
     for label, program, feeds, fetches in targets:
-        result = analysis.lint_program(program, feed_names=feeds,
-                                       fetch_names=fetches)
-        n_err += len(result.errors)
-        n_warn += len(result.warnings)
-        uncovered.update(result.uncovered_op_types)
-        if args.json:
-            reports.append({
-                "target": label,
-                "diagnostics": [d.to_dict() for d in result.diagnostics],
-                "uncovered_op_types": result.uncovered_op_types})
-        else:
-            for d in result.diagnostics:
-                print(f"[{label}] {d.format()}")
+        results.append((label, analysis.lint_program(
+            program, feed_names=feeds, fetch_names=fetches)))
+        # like --feed/--fetch, --pipeline applies to MAIN programs
+        # only: splitting a */startup initializer into "stages"
+        # verifies nothing and its host-op shape could abort the run
+        if args.pipeline and not label.endswith("/startup"):
+            try:
+                results.append((f"{label}/pipeline{args.pipeline}",
+                                analysis.lint_pipeline(
+                                    program, args.pipeline, feeds,
+                                    fetches)))
+            except ValueError as e:
+                # the split itself rejected the program (e.g. a
+                # tensor_array would cross a cut) — a target problem,
+                # not a diagnostic
+                print(f"lint: {label}: {e}", file=sys.stderr)
+                return 2
+    return _report_lint(results, args)
+
+
+def _report_lint(results, args):
+    """Shared tail of ``paddle_tpu lint``: print (or JSON-dump) a list
+    of ``(label, AnalysisResult)`` and map findings to the exit code."""
+    import json as _json
+
+    n_err = sum(len(r.errors) for _, r in results)
+    n_warn = sum(len(r.warnings) for _, r in results)
+    uncovered = set()
+    for _, r in results:
+        uncovered.update(r.uncovered_op_types)
     if args.json:
+        reports = [{
+            "target": label,
+            "diagnostics": [d.to_dict() for d in r.diagnostics],
+            "uncovered_op_types": r.uncovered_op_types}
+            for label, r in results]
         print(_json.dumps({"targets": reports, "errors": n_err,
                            "warnings": n_warn}, indent=2))
     else:
-        print(f"lint: {len(targets)} program(s): {n_err} error(s), "
+        for label, r in results:
+            for d in r.diagnostics:
+                print(f"[{label}] {d.format()}")
+        print(f"lint: {len(results)} program(s): {n_err} error(s), "
               f"{n_warn} warning(s)")
         if uncovered and args.verbose:
             print(f"  warn-list ({len(uncovered)} op type(s) without an "
                   f"inference rule — shapes/dtypes not propagated "
                   f"through them): {', '.join(sorted(uncovered))}")
     return 1 if n_err or (args.strict and n_warn) else 0
+
+
+def _cmd_selfcheck(args):
+    """One exit-coded pass over every static gate (the pre-merge /
+    pre-deploy command CI runs): strict lint of the whole model zoo in
+    single-program AND multi-program (distribute-transpiled, pipeline-
+    split, gen-exported) modes, plus the scanner-enforced registries —
+    diagnostics, metrics, failpoints — that keep docs and code in
+    lockstep.  Exit 0 = everything green, 1 = any section failed."""
+    import json as _json
+
+    from paddle_tpu.analysis.selfcheck import run_selfcheck
+
+    report = run_selfcheck()
+    if args.json:
+        print(_json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for section in report["sections"]:
+            mark = "ok  " if section["ok"] else "FAIL"
+            print(f"[{mark}] {section['name']}: {section['detail']}")
+            for line in section.get("failures", []):
+                print(f"       {line}")
+        print(f"selfcheck: {'PASS' if report['ok'] else 'FAIL'} "
+              f"({sum(s['ok'] for s in report['sections'])}/"
+              f"{len(report['sections'])} sections green)")
+    return 0 if report["ok"] else 1
 
 
 def _cmd_launch(args):
@@ -681,7 +776,18 @@ def main(argv=None):
                                     "docs/static_analysis.md)")
     p.add_argument("target", nargs="?", default=None,
                    help="save_inference_model dir (or a __model__ json "
-                        "file) to lint")
+                        "file) to lint; a dir with gen_meta.json lints "
+                        "the whole generation bundle (prefill + decode "
+                        "+ cross-program signature checks)")
+    p.add_argument("--pair", nargs=2, metavar=("TRAINER", "PSERVER"),
+                   default=None,
+                   help="lint a transpiled trainer/pserver pair as one "
+                        "unit: Send/Recv matching, split reassembly, "
+                        "collective sync (PTA011-PTA014)")
+    p.add_argument("--pipeline", type=int, default=None, metavar="N",
+                   help="also split each linted program into N "
+                        "pipeline stages and verify boundary carriers "
+                        "+ cross-stage collective sync (PTA011/PTA015)")
     p.add_argument("--zoo", default=None,
                    help="lint a built-in model's forward+backward "
                         "program instead (mnist|resnet|vgg|transformer|"
@@ -702,6 +808,15 @@ def main(argv=None):
                    help="also print the warn-list of op types without "
                         "an inference rule")
     p.set_defaults(fn=_cmd_lint)
+
+    p = sub.add_parser("selfcheck",
+                       help="one exit-coded pass over every static "
+                            "gate: strict zoo lint (single- AND "
+                            "multi-program) plus the scanner-enforced "
+                            "diagnostic/metric/failpoint registries")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable section report")
+    p.set_defaults(fn=_cmd_selfcheck)
 
     p = sub.add_parser("profile", help="per-op device-time table of one "
                                        "compiled training step")

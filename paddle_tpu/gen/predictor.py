@@ -70,6 +70,18 @@ class GenPredictor:
             (self._dec_prog, self._dec_feeds,
              self._dec_fetch) = fluid.io.load_inference_model(
                 os.path.join(model_dir, "decode"), self._exe)
+        # load-time contract check (analysis/distributed.py): the
+        # bundle's prefill/decode pair must satisfy the constant-jit-
+        # key contract against gen_meta.json — a bundle that drifted
+        # (hand-edited meta, mixed exports) fails HERE, before the
+        # server ever flips /readyz, instead of recompiling per decode
+        # step or seeding misshapen cache rows mid-request
+        from paddle_tpu.analysis import (AnalysisResult,
+                                         check_gen_bundle)
+        AnalysisResult(check_gen_bundle(
+            (self._pre_prog, self._pre_feeds, self._pre_fetch),
+            (self._dec_prog, self._dec_feeds, self._dec_fetch),
+            self.meta)).raise_on_errors(where="gen.GenPredictor")
         # per-bucket constant prefill feeds (causal bias template)
         self._tri = {}
 

@@ -359,4 +359,13 @@ def export_gen_model(dirname, hp: GenConfig = None, num_slots=8,
     }
     with open(os.path.join(dirname, META_FILENAME), "w") as f:
         json.dump(meta, f, indent=2)
+    # post-export contract (analysis/distributed.py): the bundle's
+    # prefill/decode pair must satisfy the constant-jit-key contract
+    # (static decode signature, cache geometry matching the meta,
+    # prefill K/V fetches seeding exactly the cache) — a drifted
+    # bundle fails HERE, at export, not at the first /generate;
+    # unwarmable prompt buckets (the PTA018 recompile hazard) are
+    # logged at warning level by the same check
+    from paddle_tpu.analysis import verify_gen_bundle
+    verify_gen_bundle(dirname, where="gen_lm.export_gen_model")
     return dirname

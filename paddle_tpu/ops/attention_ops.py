@@ -757,7 +757,7 @@ def _fsm_fwd_kernel(x_ref, rb_ref, tb_ref, o_ref):
     if rb_ref is not None:
         x = x + rb_ref[0, 0].astype(jnp.float32)[None, :]  # [S] row bias
     if tb_ref is not None:
-        x = x + tb_ref[...].astype(jnp.float32)    # [bs, S] causal rows
+        x = x + tb_ref[0].astype(jnp.float32)      # [bs, S] causal rows
     m = jnp.max(x, axis=-1, keepdims=True)
     e = jnp.exp(x - m)
     o_ref[0, 0, ...] = (e / jnp.sum(e, axis=-1, keepdims=True)) \
@@ -789,7 +789,9 @@ def _fsm_ok(Sq, Sk, interpret):
 
 
 def _pallas_softmax_fwd(x, row_bias, tri_bias, interpret):
-    """x [B,H,Sq,Sk]; row_bias [B,Sk] or None; tri_bias [Sq,Sk] or None."""
+    """x [B,H,Sq,Sk]; row_bias [B,Sk] or None; tri_bias [Sq,Sk] shared,
+    [1,Sq,Sk], or [B,Sq,Sk] per-batch (the decoder's combined
+    padding+causal bias, one causal plane per batch row) or None."""
     B, H, Sq, Sk = x.shape
     bs = _fsm_ok(Sq, Sk, interpret)
     if bs is None:
@@ -805,7 +807,15 @@ def _pallas_softmax_fwd(x, row_bias, tri_bias, interpret):
                                      lambda b, h, i: (b, 0, 0)))
         operands.append(row_bias.reshape(B, 1, Sk))
     if tri_bias is not None:
-        in_specs.append(pl.BlockSpec((bs, Sk), lambda b, h, i: (i, 0)))
+        if tri_bias.ndim == 2:
+            tri_bias = tri_bias[None]
+        if tri_bias.shape[0] not in (1, B):
+            return None
+        if tri_bias.shape[0] > 1:  # per-batch plane, indexed by b
+            tb_index = lambda b, h, i: (b, i, 0)
+        else:                      # one shared causal plane
+            tb_index = lambda b, h, i: (0, i, 0)
+        in_specs.append(pl.BlockSpec((1, bs, Sk), tb_index))
         operands.append(tri_bias)
 
     def kernel(*refs):
@@ -867,7 +877,10 @@ def _xla_softmax(x, row_bias, tri_bias):
     if row_bias is not None:
         xf = xf + row_bias[:, None, None, :].astype(jnp.float32)
     if tri_bias is not None:
-        xf = xf + tri_bias[None, None].astype(jnp.float32)
+        if tri_bias.ndim == 3:   # [B|1, Sq, Sk] per-batch planes
+            xf = xf + tri_bias[:, None].astype(jnp.float32)
+        else:                    # [Sq, Sk] shared plane
+            xf = xf + tri_bias[None, None].astype(jnp.float32)
     return jax.nn.softmax(xf, axis=-1).astype(x.dtype)
 
 
@@ -876,6 +889,13 @@ def _fused_softmax_fwd(x, row_bias, tri_bias, interpret):
     if _HAS_PALLAS:
         out = _pallas_softmax_fwd(x, row_bias, tri_bias, interpret)
     if out is None:
+        # tiling/VMEM-gate fallback: same coverage signal as the
+        # bias-decomposition fallback in nn_ops.softmax_lower — the
+        # counter's contract is "zero means every softmax ran the
+        # kernel", so a shape that fails _fsm_ok must move it too
+        # (fires at trace time: once per compiled signature)
+        from paddle_tpu.profiler import runtime_metrics
+        runtime_metrics.inc("attention.fused_softmax_fallback")
         out = _xla_softmax(x, row_bias, tri_bias)
     return out, out
 

@@ -596,10 +596,14 @@ def softmax_lower(ctx):
                 ok = False
             elif bias.shape[0] == 1:
                 row_bias = jnp.broadcast_to(row_bias, (B, Sk))
-        elif bias.ndim == 4 and bias.shape[0] == 1 and \
-                bias.shape[1] == 1 and bias.shape[2] == Sq and \
-                bias.shape[3] == Sk:
-            tri_bias = bias.reshape(Sq, Sk)
+        elif bias.ndim == 4 and bias.shape[1] == 1 and \
+                bias.shape[2] == Sq and bias.shape[3] == Sk and \
+                bias.shape[0] in (1, B):
+            # one causal plane per batch row: covers BOTH the shared
+            # causal mask [1,1,Sq,Sk] and the decoder's combined
+            # padding+causal [B,1,Sq,Sk] bias (ADVICE r5 / ROADMAP
+            # item 4 — the kernel now spans the full decoder)
+            tri_bias = bias.reshape(bias.shape[0], Sq, Sk)
         else:
             ok = False
         if ok:
@@ -607,19 +611,22 @@ def softmax_lower(ctx):
                 x, row_bias, tri_bias, _use_interpret()))
             return
         # fallback SIGNAL (ADVICE r5): with the kernel opted in, a bias
-        # the kernel cannot decompose — e.g. the decoder's combined
-        # padding+causal [B,1,S,S] — silently takes the XLA path below;
-        # without this line an experiment reading "fused softmax on"
-        # would misread its partial coverage
+        # the kernel cannot decompose silently takes the XLA path below
+        # — the counter makes partial kernel coverage measurable (an
+        # experiment reading "fused softmax on" checks it is zero), the
+        # debug log names the offending shape
+        from paddle_tpu.profiler import runtime_metrics
+        runtime_metrics.inc("attention.fused_softmax_fallback")
         logger.debug(
             "fused softmax (PADDLE_TPU_FUSED_SOFTMAX=1) fell back to "
             "the XLA path for scores %s: bias shape %s is neither a "
-            "per-row padding mask [B|1,1,1,Sk] nor a shared causal "
-            "mask [1,1,Sq,Sk] (a combined padding+causal [B,1,Sq,Sk] "
-            "bias is not decomposable by the Pallas kernel)",
+            "per-row padding mask [B|1,1,1,Sk] nor a causal mask "
+            "[B|1,1,Sq,Sk]",
             tuple(x.shape), tuple(bias.shape))
     elif bias is not None and \
             os.environ.get("PADDLE_TPU_FUSED_SOFTMAX", "0") == "1":
+        from paddle_tpu.profiler import runtime_metrics
+        runtime_metrics.inc("attention.fused_softmax_fallback")
         logger.debug(
             "fused softmax (PADDLE_TPU_FUSED_SOFTMAX=1) fell back to "
             "the XLA path: scores are rank %d, the Pallas kernel needs "

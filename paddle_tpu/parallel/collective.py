@@ -72,7 +72,12 @@ def broadcast(x, axis_name, root=0):
 # -- IR ops -----------------------------------------------------------------
 
 def _axis(ctx: LowerContext):
-    return ctx.aux.get("spmd_axis")
+    # an explicit ``axis`` attr pins the collective to a named mesh
+    # axis AT THE IR LEVEL — a program-order fact the distributed
+    # verifier (analysis/distributed.py PTA011/PTA012) can then prove
+    # consistent across replicas/stages; without it the axis is the
+    # lowering context's spmd axis, as before
+    return ctx.attr("axis", None) or ctx.aux.get("spmd_axis")
 
 
 def _make_allreduce(op_name, reducer):

@@ -69,13 +69,15 @@ class DistributeTranspiler:
 
     def transpile(self, trainer_id=0, program=None, pservers="", trainers=1,
                   split_method=round_robin_split, startup_program=None,
-                  shard_params=False, mesh_axis=MODEL_AXIS):
+                  shard_params=False, mesh_axis=MODEL_AXIS, mesh=None):
         """Record the distribution plan.
 
         ``pservers``/``trainers`` are accepted for API parity; the TPU plan
         ignores endpoints (no gRPC) and instead decides, per parameter,
         whether to shard it over ``mesh_axis`` (the pserver-sharding analog)
-        or replicate it.
+        or replicate it.  ``mesh``: optional Mesh — when given, the
+        post-transpile plan verification also proves axis existence and
+        divisibility against the actual axis sizes.
 
         Sparse path: the reference distributes ``is_distributed`` embedding
         tables across pservers and rewrites lookups into ``prefetch_op``
@@ -124,9 +126,20 @@ class DistributeTranspiler:
             else:
                 self.spec.param_specs[p.name] = P()
         # post-transpile contract (paddle_tpu.analysis): the plan is
-        # recorded against a structurally verified program
-        from paddle_tpu.analysis import verify_transpiled
+        # recorded against a structurally verified program, and the
+        # plan ITSELF is verified — every declared placement must be
+        # well-formed against the program (and the mesh, when given)
+        # and propagate without a provable param/grad disagreement
+        from paddle_tpu.analysis import (AnalysisResult,
+                                         check_distributed_spec,
+                                         verify_transpiled)
         verify_transpiled(self._program, where="distribute_transpiler")
+        mesh_axes = None
+        if mesh is not None:
+            mesh_axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        AnalysisResult(check_distributed_spec(
+            self._program, self.spec, mesh_axes=mesh_axes)) \
+            .raise_on_errors(where="distribute_transpiler")
         return self
 
     def placement(self):

@@ -325,13 +325,24 @@ class PipelinedProgram:
         # int64 constant provably outside int32 range may cross a stage
         # boundary on the i32 carrier lane (the static half of
         # _Layout.pack's runtime range guard)
-        from paddle_tpu.analysis import (check_pipeline_carriers,
+        from paddle_tpu.analysis import (AnalysisResult,
+                                         check_pipeline_carriers,
+                                         check_stage_set,
                                          verify_transpiled)
         verify_transpiled(program, where="pipeline_transpiler")
         (self.block, self.stage_ops, self.stage_param_names,
          self.boundaries) = split_program(program, n_stages, feed_names,
                                           fetch_names)
         check_pipeline_carriers(self.block, self.boundaries)
+        # cross-stage contract (analysis/distributed.py): every consumed
+        # upstream value rides its boundary carrier, and the stages —
+        # run as lax.switch branches on the SAME devices — emit matching
+        # collective sequences (a branch-local collective its peers
+        # don't run would deadlock the mesh: PTA011/PTA015)
+        AnalysisResult(check_stage_set(
+            self.block, self.stage_ops, self.boundaries,
+            feed_names=self.feed_names)) \
+            .raise_on_errors(where="pipeline_transpiler")
 
         def check_rng(op):
             opdef = _registry.lookup(op.type)

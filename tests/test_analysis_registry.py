@@ -12,7 +12,14 @@ import re
 import paddle_tpu
 from paddle_tpu.analysis.diagnostics import DIAGNOSTIC_CODES
 
-from tests.test_analysis import NEGATIVE_CASES
+from tests.test_analysis import NEGATIVE_CASES as SINGLE_PROGRAM_CASES
+from tests.test_analysis_distributed import \
+    NEGATIVE_CASES as CROSS_PROGRAM_CASES
+
+# single-program codes live in tests/test_analysis.py, cross-program
+# (distributed verifier) codes in tests/test_analysis_distributed.py;
+# together they must cover the declared table exactly
+NEGATIVE_CASES = {**SINGLE_PROGRAM_CASES, **CROSS_PROGRAM_CASES}
 
 SRC_ROOT = os.path.dirname(os.path.abspath(paddle_tpu.__file__))
 ANALYSIS_DIR = os.path.join(SRC_ROOT, "analysis")
@@ -75,12 +82,17 @@ class TestDiagnosticRegistry:
     def test_every_code_has_a_negative_test(self):
         missing = sorted(set(DIAGNOSTIC_CODES) - set(NEGATIVE_CASES))
         assert not missing, (
-            f"codes without a negative case in "
-            f"tests/test_analysis.py::NEGATIVE_CASES (each code needs "
-            f"a deliberately broken program that triggers it): "
-            f"{missing}")
+            f"codes without a negative case in tests/test_analysis.py "
+            f"or tests/test_analysis_distributed.py NEGATIVE_CASES "
+            f"(each code needs a deliberately broken program/family "
+            f"that triggers it): {missing}")
         stale = sorted(set(NEGATIVE_CASES) - set(DIAGNOSTIC_CODES))
         assert not stale, f"negative cases for unknown codes: {stale}"
+        overlap = sorted(set(SINGLE_PROGRAM_CASES) &
+                         set(CROSS_PROGRAM_CASES))
+        assert not overlap, (
+            f"codes registered in BOTH negative-case files (one owner "
+            f"each): {overlap}")
 
     def test_doc_table_states_severity(self):
         with open(DOC) as f:

@@ -54,3 +54,73 @@ def test_zoo_cli_lint_exits_clean():
     agrees with the API-level gate."""
     from paddle_tpu.cli import main
     assert main(["lint", "--zoo", "all"]) == 0
+
+
+def test_gen_bundle_lints_clean(tmp_path, capsys):
+    """A freshly exported generation bundle joins the zoo gate:
+    `paddle_tpu lint <bundle>` lints prefill AND decode (plus the
+    cross-program signature checks) as one unit, clean."""
+    from paddle_tpu.cli import main
+    from paddle_tpu.models import gen_lm
+    hp = gen_lm.GenConfig()
+    hp.vocab_size, hp.d_model, hp.d_ffn = 32, 16, 32
+    hp.n_head = hp.n_layer = 2
+    hp.d_head, hp.max_len = 8, 16
+    bundle = str(tmp_path / "bundle")
+    gen_lm.export_gen_model(bundle, hp, num_slots=2)
+    assert main(["lint", bundle]) == 0
+    out = capsys.readouterr().out
+    assert "3 program(s)" in out and "0 error(s)" in out
+    results = analysis.lint_gen_bundle(bundle)
+    assert [label for label, _ in results] == ["prefill", "decode",
+                                               "bundle"]
+    for label, r in results:
+        assert not r.diagnostics, f"{label}:\n{r.format()}"
+
+
+# ---------------------------------------------------------------------------
+# typecheck coverage ratchet: the zoo-wide warn-list may shrink, never
+# grow — a new model (or a rule regression) that adds uncovered op
+# types must either get rules or consciously raise the ceiling here
+# ---------------------------------------------------------------------------
+
+ZOO_UNCOVERED_CEILING = 13
+
+#: op families frequent enough that losing their rules would blind the
+#: type checker across most of the zoo (the satellite's shrink target)
+MUST_BE_COVERED = {
+    "mul_grad", "matmul_grad", "elementwise_add_grad", "mean_grad",
+    "softmax_grad", "cross_entropy_grad", "relu_grad", "tanh_grad",
+    "conv2d_grad", "pool2d_grad", "layer_norm_grad",
+    "lookup_table_grad", "reshape_grad", "transpose_grad",
+    "dropout_grad", "concat_grad", "reduce_sum_grad",
+    "softmax_with_cross_entropy_grad", "lstm_grad",
+    "sequence_pool_grad", "increment", "less_than", "sequence_pool",
+    "sequence_expand", "assign_value", "max_sequence_len",
+}
+
+
+def test_zoo_uncovered_op_ratchet():
+    uncovered = set()
+    for name in ZOO_MODELS:
+        main, _startup, feeds, fetches = build_train_program(name)
+        r = analysis.lint_program(main, feed_names=feeds,
+                                  fetch_names=fetches)
+        uncovered.update(r.uncovered_op_types)
+    blind = sorted(uncovered & MUST_BE_COVERED)
+    assert not blind, (
+        f"op types the type checker must keep rules for are back on "
+        f"the warn-list: {blind}")
+    assert len(uncovered) <= ZOO_UNCOVERED_CEILING, (
+        f"zoo-wide uncovered op types grew to {len(uncovered)} "
+        f"(ceiling {ZOO_UNCOVERED_CEILING}): {sorted(uncovered)} — "
+        f"add @typecheck.rule coverage for the new ops instead of "
+        f"raising the ceiling")
+
+
+def test_selfcheck_cli_passes():
+    """`paddle_tpu selfcheck` — strict zoo lint (single- and multi-
+    program) plus every scanner-enforced registry in one exit-coded
+    pass; drift in any section fails tier-1 here."""
+    from paddle_tpu.cli import main
+    assert main(["selfcheck"]) == 0

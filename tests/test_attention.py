@@ -271,11 +271,13 @@ class TestComposedPathMaskWiring:
 
 
 class TestFusedSoftmaxFallbackSignal:
-    """ADVICE r5: under PADDLE_TPU_FUSED_SOFTMAX=1 a bias the Pallas
-    kernel cannot decompose — the decoder's combined padding+causal
-    [B,1,S,S] — silently takes the XLA path; the lowering must emit a
-    debug-log fallback signal with the reason so an experiment cannot
-    misread partial kernel coverage as full coverage."""
+    """ADVICE r5 / ROADMAP item 4: the decoder's combined
+    padding+causal [B,1,S,S] bias is now a PER-BATCH tri_bias the
+    Pallas kernel consumes directly (no fallback), and a bias the
+    kernel genuinely cannot decompose takes the XLA path with BOTH a
+    debug-log signal and the scanner-registered
+    ``attention.fused_softmax_fallback`` counter — partial kernel
+    coverage is measurable, not just loggable."""
 
     def _softmax_program(self, bias_shape):
         main = fluid.Program()
@@ -308,21 +310,92 @@ class TestFusedSoftmaxFallbackSignal:
         return [r for r in caplog.records
                 if "fell back" in r.getMessage()]
 
-    def test_combined_bias_fallback_logs_reason(self, monkeypatch,
-                                                caplog):
-        # combined padding+causal bias [B,1,S,S]: decomposable by
-        # neither the row nor the causal form -> XLA path + signal
+    @staticmethod
+    def _fallback_count():
+        from paddle_tpu.profiler import runtime_metrics
+        return runtime_metrics.counter("attention.fused_softmax_fallback")
+
+    def test_combined_bias_takes_kernel_path(self, monkeypatch, caplog):
+        # the decoder's combined padding+causal bias [B,1,S,S] rides
+        # the per-batch tri_bias form now: kernel path, no signal
+        # (numerics vs the XLA reference asserted inside _run)
+        before = self._fallback_count()
         records = self._run((B, 1, S, S), monkeypatch, caplog)
+        assert not records, [r.getMessage() for r in records]
+        assert self._fallback_count() == before
+
+    def test_undecomposable_bias_falls_back_with_counter(
+            self, monkeypatch, caplog):
+        # a full per-head bias [B,H,S,S] has no row/tri decomposition:
+        # XLA path + debug signal + the fallback counter moves
+        before = self._fallback_count()
+        records = self._run((B, H, S, S), monkeypatch, caplog)
         assert records, "fallback emitted no debug-log signal"
         msg = records[0].getMessage()
         assert "PADDLE_TPU_FUSED_SOFTMAX" in msg
-        assert str((B, 1, S, S)) in msg  # the reason names the shape
+        assert str((B, H, S, S)) in msg  # the reason names the shape
+        assert self._fallback_count() == before + 1
+
+    def test_untileable_shape_moves_counter_too(self, monkeypatch,
+                                                caplog):
+        # a decomposable bias whose SCORES fail the kernel's tiling
+        # gate (Sq=30: no block size divides it) silently takes the
+        # XLA path inside fused_softmax — the counter must cover that
+        # fallback as well, or counter==0 lies about kernel coverage
+        import logging
+
+        monkeypatch.setenv("PADDLE_TPU_FUSED_SOFTMAX", "1")
+        before = self._fallback_count()
+        S_odd = 30
+        rng = np.random.RandomState(1)
+        main = fluid.Program()
+        block = main.global_block()
+        block.create_var(name="x", shape=(B, H, S_odd, S_odd),
+                         dtype="float32", is_data=True)
+        block.create_var(name="bias", shape=(1, 1, S_odd, S_odd),
+                         dtype="float32", is_data=True)
+        block.append_op(type="softmax",
+                        inputs={"X": ["x"], "Bias": ["bias"]},
+                        outputs={"Out": ["out"]})
+        feed = {"x": rng.randn(B, H, S_odd, S_odd).astype("float32"),
+                "bias": rng.randn(1, 1, S_odd, S_odd).astype("float32")}
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            with caplog.at_level(logging.DEBUG,
+                                 logger="paddle_tpu.ops.nn_ops"):
+                out, = exe.run(main, feed=feed, fetch_list=["out"])
+        want = jax.nn.softmax(feed["x"] + feed["bias"], axis=-1)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
+        assert self._fallback_count() == before + 1
 
     def test_supported_bias_does_not_log_fallback(self, monkeypatch,
                                                   caplog):
         # shared causal [1,1,S,S] IS decomposable: no fallback signal
+        before = self._fallback_count()
         records = self._run((1, 1, S, S), monkeypatch, caplog)
         assert not records, [r.getMessage() for r in records]
+        assert self._fallback_count() == before
+
+    def test_per_batch_tri_bias_matches_xla(self):
+        # the kernel itself (interpret mode), per-batch planes vs the
+        # XLA fallback — bit-level agreement within f32 rounding
+        from paddle_tpu.ops import attention_ops as A
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(B, H, S, S).astype("float32"))
+        tri = jnp.asarray(
+            rng.randn(B, S, S).astype("float32"))  # B distinct planes
+        out = A._pallas_softmax_fwd(x, None, tri, interpret=True)
+        assert out is not None, "per-batch tri_bias failed the gate"
+        want = A._xla_softmax(x, None, tri)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
+        # and the planes actually differ per batch row: swapping them
+        # changes the answer (guards against a broadcast-of-plane-0 bug)
+        out_swapped = A._pallas_softmax_fwd(
+            x, None, tri[::-1], interpret=True)
+        assert np.max(np.abs(np.asarray(out_swapped)
+                             - np.asarray(out))) > 1e-3
 
 
 class TestFusedSoftmaxGradPrecision:

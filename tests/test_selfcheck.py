@@ -1,0 +1,54 @@
+"""`paddle_tpu selfcheck` keeps its own copies of the registry-scanner
+regexes (it must run without a tests/ checkout); these agreement checks
+are the lockstep guard the copies rely on: if a scanner idiom changes
+on either side, the sets diverge and THIS file fails — not a release
+gate at deploy time.
+
+The end-to-end smoke (`paddle_tpu selfcheck` exits 0) lives in
+tests/test_analysis_zoo.py::test_selfcheck_cli_passes, next to the zoo
+gates it wraps.
+"""
+
+from paddle_tpu.analysis import selfcheck as sc
+
+from tests import test_analysis_registry as reg
+from tests import test_chaos_failpoint_registry as fp
+from tests import test_obs_metric_registry as met
+
+
+def test_metric_scanner_agrees_with_registry_test():
+    assert sc._emitted_metric_names() == met.emitted_metric_names()
+    doc = set(sc._DOC_METRIC.findall(sc._read_doc("observability.md")))
+    assert doc == met.documented_metric_names()
+
+
+def test_failpoint_scanner_agrees_with_registry_test():
+    fired = set()
+    for path, text in sc._iter_sources():
+        import os
+        if os.path.relpath(path, sc.SRC_ROOT) == os.path.join(
+                "fault", "chaos.py"):
+            continue
+        fired.update(sc._FIRE.findall(text))
+    assert fired == fp.fired_failpoint_names()
+    doc = set(sc._DOC_FAILPOINT.findall(
+        sc._read_doc("fault_tolerance.md")))
+    assert doc == fp.documented_failpoint_names()
+
+
+def test_diagnostic_scanner_agrees_with_registry_test():
+    section = sc._check_diagnostic_registry()
+    assert section["ok"], section["failures"]
+    doc = set(sc._DOC_CODE.findall(sc._read_doc("static_analysis.md")))
+    assert doc == reg.documented_codes()
+
+
+def test_selfcheck_sections_are_complete():
+    """Every gate selfcheck promises (docstring + CLI help) is present;
+    a section silently dropped from run_selfcheck would hollow out the
+    release gate."""
+    report = sc.run_selfcheck()
+    names = {s["name"] for s in report["sections"]}
+    assert {"zoo-lint", "zoo-distribute", "zoo-pipeline", "gen-bundle",
+            "diagnostic-registry", "metric-registry",
+            "failpoint-registry"} <= names
