@@ -191,6 +191,8 @@ def main(argv=None):
     ap.add_argument("--smoke", action="store_true",
                     help="small fast run for CI schema checks")
     ap.add_argument("--out", default=None, help="write the JSON summary")
+    from paddle_tpu.obs import bench_history
+    bench_history.add_record_args(ap)
     args = ap.parse_args(argv)
     kw = dict(n_samples=args.n_samples, feature_dim=args.feature_dim,
               payload_floats=args.payload_floats, hidden=args.hidden,
@@ -207,6 +209,8 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
+    bench_history.record_from_args("datapipe", summary, args,
+                                   "bench_datapipe.py")
     return 0
 
 

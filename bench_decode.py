@@ -183,6 +183,8 @@ def main(argv=None):
     ap.add_argument("--duration", type=float, default=3.0)
     ap.add_argument("--step-ms", type=float, default=20.0)
     ap.add_argument("--out", default=None, help="write the JSON summary")
+    from paddle_tpu.obs import bench_history
+    bench_history.add_record_args(ap)
     args = ap.parse_args(argv)
     summary = run_bench(clients=args.clients, duration=args.duration,
                         step_ms=args.step_ms)
@@ -191,6 +193,8 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
+    bench_history.record_from_args("decode", summary, args,
+                                   "bench_decode.py")
     return 0
 
 

@@ -175,6 +175,8 @@ def main(argv=None):
     ap.add_argument("--service-ms", type=float, default=30.0)
     ap.add_argument("--scale-to", type=int, default=3)
     ap.add_argument("--out", default=None, help="write the JSON summary")
+    from paddle_tpu.obs import bench_history
+    bench_history.add_record_args(ap)
     args = ap.parse_args(argv)
     summary = run_bench(clients=args.clients, duration=args.duration,
                         service_ms=args.service_ms,
@@ -184,6 +186,8 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
+    bench_history.record_from_args("fleet", summary, args,
+                                   "bench_fleet.py")
     return 0
 
 

@@ -200,6 +200,8 @@ def main(argv=None):
     ap.add_argument("--quick", action="store_true",
                     help="fast smoke run (4 clients, 1s, narrower model)")
     ap.add_argument("--out", default=None, help="write the JSON summary")
+    from paddle_tpu.obs import bench_history
+    bench_history.add_record_args(ap)
     args = ap.parse_args(argv)
     if args.quick:
         args.clients, args.duration = min(args.clients, 4), 1.0
@@ -215,6 +217,8 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
+    bench_history.record_from_args("serving", summary, args,
+                                   "bench_serving.py")
     return 0
 
 

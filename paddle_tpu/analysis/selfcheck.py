@@ -2,9 +2,11 @@
 
 CI and humans need ONE command that answers "is the static story
 green?": the model zoo lints clean (single-program AND as the
-transpiled families the distributed verifier covers), and every
+transpiled families the distributed verifier covers), every
 scanner-enforced registry — diagnostic codes, metric names, chaos
-failpoints — agrees with its documentation table.  The pytest suite
+failpoints — agrees with its documentation table, the SLO spec schema
+validates (example + any armed ``PADDLE_TPU_SLO`` file), and the bench
+trajectory's schema is intact (``bench check --dry``).  The pytest suite
 enforces the same invariants test-by-test; this module re-runs them as
 a deployable command (no pytest, no tests/ checkout needed) so drift
 fails a release gate, not a 3am dashboard hunt.
@@ -254,6 +256,45 @@ def _check_failpoint_registry():
                     f"{len(fired)} fire sites scanned", failures)
 
 
+# ---------------------------------------------------------------------------
+# observability-plane gates: SLO spec schema + bench trajectory schema
+# ---------------------------------------------------------------------------
+
+def _check_slo_spec():
+    """The SLO spec schema validator runs against the documented
+    example spec (so the validator itself is exercised on every
+    selfcheck) AND against the operator's armed ``PADDLE_TPU_SLO`` file
+    when set — a malformed spec fails HERE, not as a runtime warning
+    three breaches too late."""
+    from paddle_tpu.obs import slo
+
+    failures = [f"EXAMPLE_SPEC: {p}"
+                for p in slo.validate_spec(slo.EXAMPLE_SPEC)]
+    path = os.environ.get(slo.SLO_ENV, "").strip()
+    detail = "example spec"
+    if path:
+        detail += f" + {slo.SLO_ENV}={path}"
+        try:
+            slo.load_spec(path)
+        except (OSError, ValueError) as e:
+            failures.extend(str(e).splitlines())
+    return _section("slo-spec", detail, failures)
+
+
+def _check_bench_trajectory():
+    """``bench check --dry`` against the repo's BENCH_TRAJECTORY.json:
+    a drifted or malformed trajectory schema fails the static gate (the
+    regression COMPARISON stays in `paddle_tpu bench check` proper —
+    perf verdicts don't belong in a schema gate)."""
+    from paddle_tpu.obs import bench_history
+
+    path = bench_history.default_path()
+    report = bench_history.check(path=path, dry=True)
+    failures = list(report["problems"])
+    detail = f"schema of {os.path.basename(path)}"
+    return _section("bench-trajectory", detail, failures)
+
+
 def run_selfcheck():
     """Run every section; returns the report dict."""
     sections = [
@@ -264,5 +305,7 @@ def run_selfcheck():
         _check_diagnostic_registry(),
         _check_metric_registry(),
         _check_failpoint_registry(),
+        _check_slo_spec(),
+        _check_bench_trajectory(),
     ]
     return {"ok": all(s["ok"] for s in sections), "sections": sections}

@@ -26,7 +26,20 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              --prom for Prometheus text
   trace   dump [--addr HOST:PORT|--local]    Chrome trace-event JSON of
                                              the span ring (PADDLE_TPU_
-                                             TRACE); load in Perfetto
+                                             TRACE); load in Perfetto;
+                                             --fleet assembles the whole
+                                             fleet's rings via the
+                                             router (one pid/process)
+  fleet-stats --router HOST:PORT             federated fleet metrics:
+          | --master H:P | --replicas a,b    one exposition, per-replica
+                                             labels, rollup rates,
+                                             stale-marked corpses
+  bench   check [--dry] | record             bench-trajectory gate over
+                                             BENCH_TRAJECTORY.json:
+                                             newest run vs recorded
+                                             baseline per-metric
+                                             tolerance bands; exit 1
+                                             on regression
   replay  BUNDLE.pkl                         re-execute a sentinel-
                                              quarantined step on CPU and
                                              report whether the numerical
@@ -48,8 +61,10 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              an N-stage split
   selfcheck                                  strict zoo lint (single- and
                                              multi-program) + every
-                                             scanner-enforced registry in
-                                             one exit-coded pass
+                                             scanner-enforced registry +
+                                             SLO-spec and bench-
+                                             trajectory schemas in one
+                                             exit-coded pass
   profile [--model transformer|resnet ...]   per-op device-time table of
                                              one compiled training step
   version
@@ -229,7 +244,8 @@ def _cmd_router(args):
                          replicas=replicas or None,
                          host=args.host, port=args.port,
                          default_deadline=args.default_deadline,
-                         poll_interval=args.poll_interval)
+                         poll_interval=args.poll_interval,
+                         slo_spec=args.slo or None)
     n = len(router.live_replicas())
     print(f"fleet router on {router.addr[0]}:{router.addr[1]} "
           f"({'master ' + args.master if args.master else 'static'}; "
@@ -292,18 +308,122 @@ def _cmd_stats(args):
     return 0
 
 
+def _cmd_fleet_stats(args):
+    """Fleet-level federated metrics: scrape every replica's /stats and
+    render ONE Prometheus exposition with per-replica labels + rollups
+    (dead replicas marked stale, never fatal).  Three target modes:
+    --router proxies the router's own /metrics?fleet=1 (the router's
+    scraper keeps rate state between pulls); --master discovers the
+    lease table and scrapes in-process; --replicas scrapes a static
+    list."""
+    import json as _json
+    import urllib.request
+
+    from paddle_tpu.obs import aggregate
+
+    if args.router:
+        url = f"http://{args.router}/metrics?fleet=1"
+        with urllib.request.urlopen(url, timeout=args.timeout) as r:
+            print(r.read().decode(), end="")
+        return 0
+    if args.master:
+        from paddle_tpu.parallel.master import MasterClient
+        client = MasterClient(args.master)
+        try:
+            targets = [(r["addr"], r["id"])
+                       for r in client.list_replicas()]
+        finally:
+            client.close()
+    elif args.replicas:
+        targets = [(a, a) for a in args.replicas.split(",") if a]
+    else:
+        print("fleet-stats: need --router, --master, or --replicas",
+              file=sys.stderr)
+        return 2
+    scraper = aggregate.FleetScraper(lambda: targets,
+                                     timeout=args.timeout)
+    text, scrapes = scraper.federate()
+    if args.json:
+        print(_json.dumps(
+            {"replicas": [{k: s[k] for k in
+                           ("addr", "id", "ok", "error", "rtt_s")}
+                          for s in scrapes]},
+            indent=2, sort_keys=True))
+    else:
+        print(text, end="")
+    return 0
+
+
+def _cmd_bench(args):
+    """Bench trajectory gate: `bench check` compares each bench's
+    newest BENCH_TRAJECTORY.json run against its recorded baseline
+    under per-metric tolerance bands (exit 1 on regression or schema
+    problem); `bench record` imports a bench summary JSON (e.g.
+    BENCH_DECODE.json) as a new trajectory run."""
+    import json as _json
+
+    from paddle_tpu.obs import bench_history
+
+    if args.action == "record":
+        if not args.bench or not args.summary:
+            print("bench record: need --bench NAME --summary FILE",
+                  file=sys.stderr)
+            return 2
+        try:
+            with open(args.summary) as f:
+                summary = _json.load(f)
+            metrics = bench_history.summary_metrics(args.bench, summary)
+            entry = bench_history.record(
+                args.bench, metrics, path=args.trajectory,
+                baseline=args.baseline, source=args.summary)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"bench record: {e}", file=sys.stderr)
+            return 2
+        print(_json.dumps(entry, indent=2, sort_keys=True))
+        return 0
+    report = bench_history.check(path=args.trajectory, dry=args.dry)
+    if args.json:
+        print(_json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for line in report["problems"]:
+            print(f"schema: {line}")
+        for bench, b in sorted(report.get("benches", {}).items()):
+            for row in b["comparisons"]:
+                mark = "ok  " if row["ok"] else "FAIL"
+                print(f"[{mark}] {bench}.{row['metric']}: "
+                      f"newest={row['newest']:g} vs "
+                      f"baseline={row['baseline']:g} "
+                      f"({row['direction']}, band={row['band']:g}, "
+                      f"bound={row['bound']:g})")
+        verdict = "PASS" if report["ok"] else "FAIL"
+        what = "schema" if args.dry else "regression gate"
+        print(f"bench check ({what}): {verdict} [{report['path']}]")
+    return 0 if report["ok"] else 1
+
+
 def _cmd_trace(args):
     """Dump the span ring as Chrome trace-event JSON — this process's
-    ring with --local (enable PADDLE_TPU_TRACE first), or a serving
-    replica's via its /trace endpoint.  The output loads directly in
-    Perfetto (ui.perfetto.dev) or chrome://tracing."""
+    ring with --local (enable PADDLE_TPU_TRACE first), a serving
+    replica's via its /trace endpoint, or (--fleet, against a router)
+    the ASSEMBLED fleet timeline: every process's spans merged onto one
+    clock with a distinct pid row per process.  The output loads
+    directly in Perfetto (ui.perfetto.dev) or chrome://tracing."""
     import json as _json
 
     if args.action != "dump":
         print(f"trace: unknown action {args.action!r} (want: dump)",
               file=sys.stderr)
         return 2
-    if args.addr:
+    if args.fleet:
+        import urllib.request
+        if not args.addr:
+            print("trace dump --fleet: need --addr ROUTER_HOST:PORT",
+                  file=sys.stderr)
+            return 2
+        url = f"http://{args.addr}/trace?fleet=1"
+        with urllib.request.urlopen(url, timeout=60) as r:
+            obj = _json.loads(r.read())
+    elif args.addr:
         from paddle_tpu.serving import ServingClient
         obj = ServingClient(args.addr).trace()
     else:
@@ -734,6 +854,10 @@ def main(argv=None):
                         "an X-Deadline-Ms header")
     p.add_argument("--poll-interval", type=float, default=0.25,
                    help="master discovery poll interval seconds")
+    p.add_argument("--slo", default=None, metavar="SPEC.json",
+                   help="SLO spec to evaluate in-router (breach "
+                        "counters + post-mortem on sustained breach; "
+                        "default: PADDLE_TPU_SLO when set)")
     p.set_defaults(fn=_cmd_router)
 
     p = sub.add_parser("stats", help="fetch a serving replica's /stats "
@@ -753,14 +877,65 @@ def main(argv=None):
                                      "trace-event JSON (Perfetto)")
     p.add_argument("action", choices=["dump"])
     p.add_argument("--addr", default=None,
-                   help="host:port of a serving replica (/trace); "
+                   help="host:port of a serving replica (/trace) or, "
+                        "with --fleet, of the fleet router; "
                         "default: this process's ring (--local)")
     p.add_argument("--local", action="store_true",
                    help="this process's span ring (the default when "
                         "--addr is not given)")
+    p.add_argument("--fleet", action="store_true",
+                   help="assembled fleet timeline via the router's "
+                        "/trace?fleet=1: every process's spans merged "
+                        "onto one clock, one pid row per process")
     p.add_argument("--output", default=None,
                    help="write the JSON here instead of stdout")
     p.set_defaults(fn=_cmd_trace)
+
+    p = sub.add_parser("fleet-stats",
+                       help="federated fleet metrics: one Prometheus "
+                            "exposition over every replica's registry "
+                            "(per-replica labels + rollups; dead "
+                            "replicas marked stale)")
+    p.add_argument("--router", default=None,
+                   help="host:port of the fleet router (proxies its "
+                        "/metrics?fleet=1 — keeps rate state between "
+                        "pulls)")
+    p.add_argument("--master", default=None,
+                   help="HOST:PORT of the fleet master: scrape the "
+                        "current lease table in-process")
+    p.add_argument("--replicas", default=None,
+                   help="comma-separated host:port list to scrape "
+                        "(static fleet, no master)")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-replica scrape timeout seconds")
+    p.add_argument("--json", action="store_true",
+                   help="per-replica scrape health instead of the "
+                        "exposition text")
+    p.set_defaults(fn=_cmd_fleet_stats)
+
+    p = sub.add_parser("bench",
+                       help="bench trajectory: record runs into "
+                            "BENCH_TRAJECTORY.json and gate on "
+                            "regressions vs the recorded baseline")
+    p.add_argument("action", choices=["check", "record"])
+    p.add_argument("--trajectory", default=None,
+                   help="trajectory file (default: the repo's "
+                        "BENCH_TRAJECTORY.json)")
+    p.add_argument("--dry", action="store_true",
+                   help="with check: validate the schema only (the "
+                        "selfcheck gate), no regression comparison")
+    p.add_argument("--bench", default=None,
+                   help="with record: bench name (serving|datapipe|"
+                        "fleet|decode)")
+    p.add_argument("--summary", default=None,
+                   help="with record: the bench's summary JSON to "
+                        "import (e.g. BENCH_DECODE.json)")
+    p.add_argument("--baseline", action="store_true",
+                   help="with record: flag the run as the bench's "
+                        "comparison baseline")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.set_defaults(fn=_cmd_bench)
 
     p = sub.add_parser("replay", help="re-execute a sentinel-quarantined "
                                       "step on CPU (exit 0 = fault "
@@ -812,8 +987,10 @@ def main(argv=None):
     p = sub.add_parser("selfcheck",
                        help="one exit-coded pass over every static "
                             "gate: strict zoo lint (single- AND "
-                            "multi-program) plus the scanner-enforced "
-                            "diagnostic/metric/failpoint registries")
+                            "multi-program), the scanner-enforced "
+                            "diagnostic/metric/failpoint registries, "
+                            "the SLO spec schema, and the bench-"
+                            "trajectory schema (bench check --dry)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable section report")
     p.set_defaults(fn=_cmd_selfcheck)

@@ -78,6 +78,10 @@ class FleetReplica:
     def start(self, ready_timeout=300.0):
         """Serve, wait for readiness (load + warmup), THEN register.
 
+        Also labels this process's timeline row for merged fleet traces
+        (``obs.trace.set_process_name``; first caller wins, so an
+        operator-chosen name is never overwritten).
+
         Registration is deliberately last: the router must never
         discover a replica whose `/readyz` would still say 503 — a
         rolling-restart replacement enters the table only once it can
@@ -85,6 +89,8 @@ class FleetReplica:
         failed start tears down what it already built (listener, master
         connection), so the caller is not left with a leaked port it
         has no handle to drain."""
+        from paddle_tpu.obs import trace as _trace
+        _trace.set_process_name(f"replica:{self.replica_id}")
         self._serve_thread = self.server.start_background()
         try:
             if not self.server.wait_until_ready(ready_timeout):

@@ -16,6 +16,17 @@ The router holds no model state and does no JSON re-encoding of predict
 bodies — request and reply bytes pass through verbatim — so it stays
 cheap enough to front many replicas from one process.
 
+It is also the fleet's observability vantage point
+(``docs/observability.md`` § Fleet observability):
+``/metrics?fleet=1`` federates every replica's registry into one
+exposition (``obs.aggregate.FleetScraper``; dead replicas marked stale,
+never fatal), ``/trace?fleet=1`` assembles every process's span ring
+into one clock-normalized Chrome timeline, ``/spans`` serves the
+router's own ring in the same scrape shape, and an optional SLO
+watchdog (``slo_spec=`` / ``PADDLE_TPU_SLO``) evaluates declarative
+objectives over the runtime metrics in a background thread, surfacing
+its breach log under ``/stats``.
+
 Failpoints: ``fleet.route.blackhole`` fires per forward attempt (armed
 ``error`` turns the attempt into a connection failure — the drill for a
 partitioned replica the lease hasn't expired yet).
@@ -27,7 +38,10 @@ import collections
 import json
 import threading
 import time
+from urllib.parse import parse_qs, urlsplit
 
+from paddle_tpu.obs import aggregate as _aggregate
+from paddle_tpu.obs import slo as _slo
 from paddle_tpu.obs import trace as _trace
 from paddle_tpu.obs.trace import span as _span
 
@@ -65,7 +79,7 @@ class FleetRouter:
     def __init__(self, master_addr=None, replicas=None, host="127.0.0.1",
                  port=0, retry=None, poll_interval=0.25,
                  default_deadline=30.0, attempt_timeout=30.0,
-                 down_cooldown=1.0):
+                 down_cooldown=1.0, slo_spec=None, scrape_timeout=2.0):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         from paddle_tpu.fault.retry import RetryPolicy, parse_hostport
@@ -95,6 +109,17 @@ class FleetRouter:
         # last N failovers: (request_id, failed addrs..., served-by) —
         # the drill's evidence that a specific request changed replicas
         self.failover_log = collections.deque(maxlen=256)
+        # fleet observability plane: federation scraper over the
+        # routing table (obs.aggregate) + optional SLO watchdog
+        # (obs.slo; explicit spec wins over PADDLE_TPU_SLO)
+        self._scrape_timeout = float(scrape_timeout)
+        self._scraper = _aggregate.FleetScraper(
+            self.scrape_targets, timeout=self._scrape_timeout)
+        self._slo = (_slo.SLOWatchdog(slo_spec) if slo_spec is not None
+                     else _slo.watchdog_from_env())
+        if self._slo is not None:
+            self._slo.start()
+        _trace.set_process_name("router")
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -127,9 +152,17 @@ class FleetRouter:
             def do_GET(self):
                 self._request_id = (self.headers.get("X-Request-Id")
                                     or "").strip() or None
-                if self.path in ("/health", "/healthz"):
+                parts = urlsplit(self.path)
+                path = parts.path
+                query = parse_qs(parts.query)
+                # ?fleet=1 flips /metrics and /trace from this
+                # process's view to the FEDERATED one (every replica
+                # scraped, merged, labelled)
+                fleet = (query.get("fleet", ["0"])[0].lower()
+                         not in ("", "0", "false", "no"))
+                if path in ("/health", "/healthz"):
                     self._reply(200, {"status": "ok"})
-                elif self.path == "/readyz":
+                elif path == "/readyz":
                     n = len(router.live_replicas())
                     if n > 0:
                         self._reply(200, {"status": "ready",
@@ -138,9 +171,9 @@ class FleetRouter:
                         self._error(503, "no_replicas",
                                     "no live replicas in the routing "
                                     "table", retryable=True)
-                elif self.path == "/replicas":
+                elif path == "/replicas":
                     self._reply(200, {"replicas": router.table()})
-                elif self.path == "/stats":
+                elif path == "/stats":
                     from paddle_tpu import profiler as _profiler
                     snap = _profiler.runtime_metrics.snapshot()
                     snap["router"] = {
@@ -148,16 +181,34 @@ class FleetRouter:
                         "failovers": [list(f) for f in
                                       router.failover_log],
                     }
+                    if router._slo is not None:
+                        snap["slo"] = router._slo.state()
                     self._reply(200, snap)
-                elif self.path == "/metrics":
+                elif path == "/metrics":
+                    if fleet:
+                        self._reply_raw(
+                            200, router.fleet_metrics().encode(),
+                            _aggregate.CONTENT_TYPE)
+                        return
                     from paddle_tpu.obs import prom as _prom
                     self._reply_raw(
                         200, _prom.render_prometheus().encode(),
                         _prom.CONTENT_TYPE)
-                elif self.path == "/trace":
+                elif path == "/trace":
+                    if fleet:
+                        self._reply_raw(
+                            200,
+                            json.dumps(router.fleet_trace()).encode(),
+                            "application/json")
+                        return
                     self._reply_raw(200,
                                     _trace.dump_chrome_trace().encode(),
                                     "application/json")
+                elif path == "/spans":
+                    # the router's own ring, in the same scrape shape
+                    # replicas serve (so a higher-level aggregator can
+                    # treat the router as just another process)
+                    self._reply(200, _trace.snapshot_payload())
                 else:
                     self._error(404, "not_found", self.path,
                                 retryable=False)
@@ -307,6 +358,38 @@ class FleetRouter:
             if e is not None:
                 e["failures"] += 1
                 e["down_until"] = time.monotonic() + self._down_cooldown
+
+    # -- fleet observability plane -----------------------------------------
+    def scrape_targets(self):
+        """Federation scrape set: EVERY replica in the table, including
+        cooling-down ones — the scrape itself decides staleness by
+        failing, and a corpse must show up as ``stale=1``, not vanish
+        from the fleet view before its lease expires."""
+        with self._lock:
+            return [(a, e["id"]) for a, e in sorted(self._table.items())]
+
+    def fleet_metrics(self):
+        """The federated ``/metrics?fleet=1`` body: every replica's
+        registry under ``replica=`` labels plus fleet rollups; dead
+        replicas are marked stale, never fatal."""
+        text, _scrapes = self._scraper.federate()
+        return text
+
+    def fleet_trace(self):
+        """The assembled ``/trace?fleet=1`` body: the router's own span
+        ring merged with every reachable replica's (clock-skew
+        normalized against this process's send/recv envelopes,
+        scraped concurrently), one timeline row per process.
+        Unreachable replicas are reported in
+        ``fleetAssembly.failures`` — a hard-killed replica must not
+        take the fleet timeline down with it."""
+        sources = [{"source": "router",
+                    "payload": _trace.snapshot_payload(),
+                    "envelope": None}]
+        sources.extend(_aggregate.fetch_spans_many(
+            [addr for addr, _rid in self.scrape_targets()],
+            timeout=self._scrape_timeout))
+        return _aggregate.assemble_fleet_trace(sources)
 
     # -- request path ------------------------------------------------------
     def route(self, path, raw, request_id, budget):
@@ -707,6 +790,8 @@ class FleetRouter:
 
     def shutdown(self):
         self._stop.set()
+        if self._slo is not None:
+            self._slo.stop()
         self._server.shutdown()
         self._server.server_close()
         if self._master is not None:

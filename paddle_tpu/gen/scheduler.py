@@ -31,6 +31,7 @@ import time
 import numpy as np
 
 from paddle_tpu.obs import trace as _trace
+from paddle_tpu.obs.slo import tick as _slo_tick
 from paddle_tpu.obs.trace import span as _span
 
 logger = logging.getLogger(__name__)
@@ -125,11 +126,19 @@ class GenScheduler:
     """Continuous-batching decode loop over a :class:`GenPredictor`."""
 
     def __init__(self, predictor, queue_size=64, admission="continuous",
-                 max_restarts=5):
+                 max_restarts=5, slo_watchdog=None):
         if admission not in ("continuous", "batch"):
             raise ValueError(
                 f"admission must be 'continuous' or 'batch', "
                 f"got {admission!r}")
+        # SLO watchdog (obs.slo): evaluated from the scheduler loop so
+        # TTFT/tokens-per-sec objectives are judged by the thread that
+        # produces them.  Default arms from PADDLE_TPU_SLO; unarmed the
+        # per-iteration cost is one None check (tick()).
+        if slo_watchdog is None:
+            from paddle_tpu.obs import slo as _slo
+            slo_watchdog = _slo.watchdog_from_env()
+        self.slo_watchdog = slo_watchdog
         self.predictor = predictor
         self.queue_size = max(1, int(queue_size))
         self.admission = admission
@@ -271,6 +280,7 @@ class GenScheduler:
                     self._restarts = 0
             _profiler.runtime_metrics.set_gauge("gen.slots_active",
                                                 len(self._slots))
+            _slo_tick(self.slo_watchdog)
         err = RuntimeError("generation scheduler shut down")
         for _, slot in active:
             slot.stream.fail(err)

@@ -12,6 +12,22 @@ Three layers over the existing ``profiler.RuntimeMetrics`` counters:
 - :mod:`paddle_tpu.obs.prom` — Prometheus text exposition of the
   runtime metrics (``/metrics``, ``paddle_tpu stats --prom``).
 
+Three FLEET-level layers on top (the multi-process plane):
+
+- :mod:`paddle_tpu.obs.aggregate` — metrics federation (one exposition
+  over every replica's registry, ``replica=`` labels + rollups) and
+  cross-process trace assembly (clock-skew-normalized merged Chrome
+  timelines), served by the fleet router (``/metrics?fleet=1``,
+  ``/trace?fleet=1``) and ``paddle_tpu fleet-stats``.
+- :mod:`paddle_tpu.obs.slo` — declarative SLO specs
+  (``PADDLE_TPU_SLO``) evaluated on a sliding window over the runtime
+  metrics, with breach counters, a structured breach log, and a
+  flight-recorder post-mortem on sustained breach.
+- :mod:`paddle_tpu.obs.bench_history` — the bench trajectory
+  (``BENCH_TRAJECTORY.json``): bench scripts append headline metrics,
+  ``paddle_tpu bench check`` fails on regression past per-metric
+  tolerance bands.
+
 See ``docs/observability.md`` for the span API, the trace-context
 headers, the post-mortem file format, and the metric-name registry.
 """
@@ -21,16 +37,26 @@ from __future__ import annotations
 from paddle_tpu.obs import trace
 from paddle_tpu.obs import flight
 from paddle_tpu.obs import prom
+from paddle_tpu.obs import aggregate
+from paddle_tpu.obs import bench_history
+from paddle_tpu.obs import slo
 from paddle_tpu.obs.trace import (span, record_span, trace_context,
                                   current_trace_id, new_trace_id,
-                                  chrome_trace, dump_chrome_trace)
+                                  chrome_trace, dump_chrome_trace,
+                                  set_process_name, snapshot_payload)
 from paddle_tpu.obs.flight import write_postmortem, read_postmortem
 from paddle_tpu.obs.prom import render_prometheus
+from paddle_tpu.obs.aggregate import (FleetScraper, assemble_fleet_trace,
+                                      render_federated)
+from paddle_tpu.obs.slo import SLOWatchdog, load_spec, validate_spec
 
-__all__ = ["trace", "flight", "prom", "span", "record_span",
-           "trace_context", "current_trace_id", "new_trace_id",
-           "chrome_trace", "dump_chrome_trace", "write_postmortem",
-           "read_postmortem", "render_prometheus"]
+__all__ = ["trace", "flight", "prom", "aggregate", "bench_history",
+           "slo", "span", "record_span", "trace_context",
+           "current_trace_id", "new_trace_id", "chrome_trace",
+           "dump_chrome_trace", "set_process_name", "snapshot_payload",
+           "write_postmortem", "read_postmortem", "render_prometheus",
+           "FleetScraper", "assemble_fleet_trace", "render_federated",
+           "SLOWatchdog", "load_spec", "validate_spec"]
 
 # arm the uncaught-exception post-mortem hook iff the operator asked
 # for one (PADDLE_TPU_POSTMORTEM); unarmed this changes nothing

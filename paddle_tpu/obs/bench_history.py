@@ -1,0 +1,318 @@
+"""Bench trajectory: machine-checked performance history.
+
+Every bench script (``bench_serving.py``, ``bench_datapipe.py``,
+``bench_fleet.py``, ``bench_decode.py``) can append its headline
+metrics to ``BENCH_TRAJECTORY.json`` through :func:`record`, and
+``paddle_tpu bench check`` compares the NEWEST run of each bench
+against its recorded BASELINE under per-metric tolerance bands —
+exiting nonzero on regression, so a change that quietly halves
+tokens/s fails a gate instead of landing silently (the repo's
+BENCH_*.json artifacts record point-in-time runs; the trajectory is
+the line through them).
+
+File format (``"format": 1``)::
+
+    {"format": 1, "runs": [
+        {"bench": "decode", "time_unix": 1753900000.0,
+         "baseline": true,                  # optional; first run else
+         "source": "BENCH_DECODE.json",     # optional provenance
+         "metrics": {"tokens_per_sec": 217.8, ...}}
+    ]}
+
+Baseline selection per bench: the LAST run flagged ``"baseline":
+true``, else the first recorded run.  Newest = the last recorded run.
+Tolerance bands live in :data:`BENCH_METRICS` (direction + band per
+metric); a baseline entry may override them via a ``"tolerances"``
+mapping of the same shape.  Metrics absent from the table (or from
+either run) are reported but never judged — a bench may grow metrics
+without invalidating its history.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["TRAJECTORY_FILE", "BENCH_METRICS", "record", "check",
+           "load_trajectory", "validate_trajectory", "summary_metrics",
+           "default_path", "add_record_args", "record_from_args"]
+
+TRAJECTORY_FILE = "BENCH_TRAJECTORY.json"
+FORMAT = 1
+
+# direction: "higher" / "lower" with a RELATIVE tolerance band (0.25 =
+# newest may be up to 25% worse than baseline before it counts as a
+# regression — the 2-vCPU bench hosts are noisy); "max_abs" is an
+# ABSOLUTE ceiling above baseline (failures: 0 means zero, always).
+BENCH_METRICS = {
+    "serving": {"rps_batched": ("higher", 0.30),
+                "speedup": ("higher", 0.30),
+                "p99_ms": ("lower", 0.75)},
+    "datapipe": {"samples_per_sec": ("higher", 0.30),
+                 "speedup": ("higher", 0.30)},
+    "fleet": {"rps_aggregate": ("higher", 0.30),
+              "scaling": ("higher", 0.25),
+              "kill_failures": ("max_abs", 0.0)},
+    "decode": {"tokens_per_sec": ("higher", 0.30),
+               "tokens_per_sec_ratio": ("higher", 0.25),
+               "ttft_p99_ms": ("lower", 0.75),
+               "lost_requests": ("max_abs", 0.0)},
+    "train_transformer": {"tokens_per_sec_per_chip": ("higher", 0.10),
+                          "mfu": ("higher", 0.05)},
+}
+
+
+def default_path():
+    """Repo-root ``BENCH_TRAJECTORY.json`` (next to the BENCH_*.json
+    artifacts), resolved relative to the installed package."""
+    import paddle_tpu
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(paddle_tpu.__file__)))
+    return os.path.join(root, TRAJECTORY_FILE)
+
+
+# ---------------------------------------------------------------------------
+# schema
+# ---------------------------------------------------------------------------
+
+def validate_trajectory(obj):
+    """Schema problems as a list of strings (empty = valid); the
+    ``bench check --dry`` / selfcheck gate."""
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"trajectory must be a JSON object, "
+                f"got {type(obj).__name__}"]
+    if obj.get("format") != FORMAT:
+        problems.append(f"format must be {FORMAT}, "
+                        f"got {obj.get('format')!r}")
+    runs = obj.get("runs")
+    if not isinstance(runs, list):
+        return problems + ["runs must be a list"]
+    for i, run in enumerate(runs):
+        where = f"runs[{i}]"
+        if not isinstance(run, dict):
+            problems.append(f"{where}: must be an object")
+            continue
+        if not isinstance(run.get("bench"), str) or not run.get("bench"):
+            problems.append(f"{where}: needs a non-empty bench name")
+        t = run.get("time_unix")
+        if not isinstance(t, (int, float)) or isinstance(t, bool) or \
+                t <= 0:
+            problems.append(f"{where}: needs a positive time_unix")
+        metrics = run.get("metrics")
+        if not isinstance(metrics, dict) or not metrics:
+            problems.append(f"{where}: needs a non-empty metrics object")
+        else:
+            for k, v in metrics.items():
+                if not isinstance(k, str):
+                    problems.append(f"{where}: metric keys must be "
+                                    f"strings")
+                    break
+                if not isinstance(v, (int, float)) or \
+                        isinstance(v, bool) or v != v:
+                    problems.append(f"{where}: metric {k!r} must be a "
+                                    f"finite number, got {v!r}")
+        if "baseline" in run and not isinstance(run["baseline"], bool):
+            problems.append(f"{where}: baseline must be a boolean")
+        if "tolerances" in run:
+            tol = run["tolerances"]
+            if not isinstance(tol, dict):
+                problems.append(f"{where}: tolerances must be an object")
+            else:
+                for k, v in tol.items():
+                    if (not isinstance(v, (list, tuple)) or len(v) != 2
+                            or v[0] not in ("higher", "lower", "max_abs")
+                            or not isinstance(v[1], (int, float))
+                            or isinstance(v[1], bool) or v[1] < 0):
+                        problems.append(
+                            f"{where}: tolerances[{k!r}] must be "
+                            f"[\"higher\"|\"lower\"|\"max_abs\", "
+                            f"band>=0]")
+    return problems
+
+
+def load_trajectory(path=None):
+    """Load and schema-validate; raises ``ValueError`` on any problem
+    (including unreadable/non-JSON files)."""
+    path = path or default_path()
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except OSError as e:
+        raise ValueError(f"cannot read trajectory {path!r}: {e}")
+    except json.JSONDecodeError as e:
+        raise ValueError(f"trajectory {path!r} is not JSON: {e}")
+    problems = validate_trajectory(obj)
+    if problems:
+        raise ValueError(f"trajectory {path!r} fails schema:\n  "
+                         + "\n  ".join(problems))
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+def record(bench, metrics, path=None, baseline=False, source=None,
+           meta=None, now=None):
+    """Append one run to the trajectory (atomic tmp+rename; creates the
+    file on first use).  Returns the run entry written."""
+    from paddle_tpu import profiler as _profiler
+    path = path or default_path()
+    entry = {"bench": str(bench),
+             "time_unix": float(now if now is not None else time.time()),
+             "metrics": {str(k): float(v) for k, v in metrics.items()}}
+    if baseline:
+        entry["baseline"] = True
+    if source:
+        entry["source"] = str(source)
+    if meta:
+        entry["meta"] = meta
+    problems = validate_trajectory({"format": FORMAT, "runs": [entry]})
+    if problems:
+        raise ValueError("refusing to record an invalid run:\n  "
+                         + "\n  ".join(problems))
+    if os.path.exists(path):
+        obj = load_trajectory(path)
+    else:
+        obj = {"format": FORMAT, "runs": []}
+    obj["runs"].append(entry)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _profiler.runtime_metrics.inc("bench.recorded")
+    return entry
+
+
+def summary_metrics(bench, summary):
+    """Flatten a bench script's summary dict into the trajectory's
+    headline metrics for that bench (the shared extraction the scripts
+    and the import path both use)."""
+    if bench == "serving":
+        return {"rps_batched": summary["batched"]["rps"],
+                "speedup": summary["speedup"],
+                "p99_ms": summary["batched"]["latency_ms"]["p99"]}
+    if bench == "datapipe":
+        return {"samples_per_sec": summary["datapipe"]
+                ["samples_per_sec"],
+                "speedup": summary["speedup"]}
+    if bench == "fleet":
+        scale_key = max((k for k in summary["fleet"] if k != "1"),
+                        key=int)
+        return {"rps_aggregate": summary["fleet"][scale_key]["rps"],
+                "scaling": summary["scaling"],
+                "kill_failures": summary["kill_drill"]["failures"]}
+    if bench == "decode":
+        cont = summary["modes"]["continuous"]
+        return {"tokens_per_sec": cont["tokens_per_sec"],
+                "tokens_per_sec_ratio": summary["tokens_per_sec_ratio"],
+                "ttft_p99_ms": summary["ttft_p99_ms"]["continuous"],
+                "lost_requests": cont["failures"]}
+    raise ValueError(f"no trajectory extraction for bench {bench!r} "
+                     f"(known: serving, datapipe, fleet, decode)")
+
+
+def add_record_args(parser):
+    """The bench scripts' shared ``--record-trajectory`` /
+    ``--record-baseline`` argparse flags (one definition, four
+    scripts)."""
+    parser.add_argument(
+        "--record-trajectory", default=None, metavar="PATH",
+        help="append this run's headline metrics to the bench "
+             "trajectory ('default' = the repo's BENCH_TRAJECTORY.json;"
+             " `paddle_tpu bench check` gates on it)")
+    parser.add_argument(
+        "--record-baseline", action="store_true",
+        help="flag the recorded run as the comparison baseline")
+
+
+def record_from_args(bench, summary, args, source):
+    """The bench scripts' shared recording tail: extract ``bench``'s
+    headline metrics from ``summary`` and append them per the
+    :func:`add_record_args` flags.  No-op (returns None) when
+    ``--record-trajectory`` was not given."""
+    if not getattr(args, "record_trajectory", None):
+        return None
+    return record(
+        bench, summary_metrics(bench, summary),
+        path=(None if args.record_trajectory == "default"
+              else args.record_trajectory),
+        baseline=args.record_baseline, source=source)
+
+
+# ---------------------------------------------------------------------------
+# checking
+# ---------------------------------------------------------------------------
+
+def _judge(direction, band, base, new):
+    """(ok, bound) under one tolerance band."""
+    if direction == "higher":
+        bound = base * (1.0 - band)
+        return new >= bound, bound
+    if direction == "lower":
+        bound = base * (1.0 + band)
+        return new <= bound, bound
+    # max_abs: absolute ceiling above baseline
+    bound = base + band
+    return new <= bound, bound
+
+
+def check(path=None, dry=False):
+    """Compare each bench's newest run against its baseline.
+
+    Returns ``{"ok", "problems", "benches": {name: {"baseline",
+    "newest", "comparisons", "regressions"}}}``.  ``dry=True`` stops
+    after schema validation (the selfcheck gate).  Schema problems OR
+    any regression flip ``ok`` to False."""
+    from paddle_tpu import profiler as _profiler
+    path = path or default_path()
+    report = {"ok": True, "path": path, "problems": [], "benches": {}}
+    _profiler.runtime_metrics.inc("bench.checks")
+    try:
+        obj = load_trajectory(path)
+    except ValueError as e:
+        report["ok"] = False
+        report["problems"] = str(e).splitlines()
+        return report
+    if dry:
+        return report
+    by_bench = {}
+    for run in obj["runs"]:
+        by_bench.setdefault(run["bench"], []).append(run)
+    for bench, runs in sorted(by_bench.items()):
+        baselines = [r for r in runs if r.get("baseline")]
+        base = baselines[-1] if baselines else runs[0]
+        newest = runs[-1]
+        tolerances = dict(BENCH_METRICS.get(bench, {}))
+        tolerances.update({k: tuple(v) for k, v
+                           in (base.get("tolerances") or {}).items()})
+        comparisons = []
+        regressions = []
+        for metric, (direction, band) in sorted(tolerances.items()):
+            if metric not in base["metrics"] or \
+                    metric not in newest["metrics"]:
+                continue
+            b, n = base["metrics"][metric], newest["metrics"][metric]
+            ok, bound = _judge(direction, band, b, n)
+            row = {"metric": metric, "direction": direction,
+                   "band": band, "baseline": b, "newest": n,
+                   "bound": bound, "ok": ok}
+            comparisons.append(row)
+            if not ok:
+                regressions.append(row)
+                _profiler.runtime_metrics.inc("bench.regressions")
+        report["benches"][bench] = {
+            "runs": len(runs),
+            "baseline_time_unix": base["time_unix"],
+            "newest_time_unix": newest["time_unix"],
+            "comparisons": comparisons,
+            "regressions": regressions,
+        }
+        if regressions:
+            report["ok"] = False
+    return report

@@ -61,51 +61,79 @@ def _esc_label(value):
         .replace("\n", "\\n")
 
 
-def render_prometheus(snapshot=None):
+def _labelset(labels, **inline):
+    """``{a="1",b="2"}`` rendering of fixed labels + per-sample ones
+    (``quantile``/``le``); empty when there are none."""
+    pairs = list((labels or {}).items()) + list(inline.items())
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc_label(v)}"' for k, v in pairs) + "}"
+
+
+def render_prometheus(snapshot=None, labels=None, emit_meta=True):
     """Render a ``RuntimeMetrics.snapshot()`` (or the live process
-    registry when None) as Prometheus text exposition format."""
+    registry when None) as Prometheus text exposition format.
+
+    ``labels`` attaches a fixed label set to EVERY sample (the fleet
+    federation path renders each replica's snapshot under its
+    ``replica="host:port"`` identity); ``emit_meta=False`` suppresses
+    the ``# HELP``/``# TYPE`` comments so a federated exposition can
+    declare each family once and append the per-replica sample blocks
+    after it.
+
+    Conformance contract (locked by tests/test_obs_prom.py): histogram
+    buckets render in ascending ``le`` order with cumulative counts, a
+    terminal ``+Inf`` bucket, and ``_count`` equal to the ``+Inf``
+    bucket; summaries carry ascending ``quantile`` in [0, 1] plus
+    ``_sum``/``_count``; counters end in ``_total``."""
     if snapshot is None:
         from paddle_tpu.profiler import runtime_metrics
         snapshot = runtime_metrics.snapshot()
     lines = []
 
+    def meta(metric, name, kind):
+        if emit_meta:
+            lines.append(f"# HELP {metric} {name} ({kind})")
+            lines.append(f"# TYPE {metric} {kind.split()[-1]}")
+
     for name, value in sorted((snapshot.get("counters") or {}).items()):
         metric = sanitize_name(name) + "_total"
-        lines.append(f"# HELP {metric} {name} (counter)")
-        lines.append(f"# TYPE {metric} counter")
-        lines.append(f"{metric} {_fmt(value)}")
+        meta(metric, name, "counter")
+        lines.append(f"{metric}{_labelset(labels)} {_fmt(value)}")
 
     for name, value in sorted((snapshot.get("gauges") or {}).items()):
         metric = sanitize_name(name)
-        lines.append(f"# HELP {metric} {name} (gauge)")
-        lines.append(f"# TYPE {metric} gauge")
-        lines.append(f"{metric} {_fmt(value)}")
+        meta(metric, name, "gauge")
+        lines.append(f"{metric}{_labelset(labels)} {_fmt(value)}")
 
     for name, s in sorted((snapshot.get("series") or {}).items()):
         metric = sanitize_name(name)
-        lines.append(f"# HELP {metric} {name} (windowed summary)")
-        lines.append(f"# TYPE {metric} summary")
+        meta(metric, name, "windowed summary")
         for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
             v = s.get(key)
             if v is not None:
-                lines.append(f'{metric}{{quantile="{q}"}} {_fmt(v)}')
-        lines.append(f"{metric}_sum {_fmt(s.get('total', 0.0))}")
-        lines.append(f"{metric}_count {_fmt(s.get('count', 0))}")
+                lines.append(f"{metric}{_labelset(labels, quantile=q)} "
+                             f"{_fmt(v)}")
+        lines.append(f"{metric}_sum{_labelset(labels)} "
+                     f"{_fmt(s.get('total', 0.0))}")
+        lines.append(f"{metric}_count{_labelset(labels)} "
+                     f"{_fmt(s.get('count', 0))}")
 
     for name, hist in sorted((snapshot.get("histograms") or {}).items()):
         metric = sanitize_name(name)
-        lines.append(f"# HELP {metric} {name} (histogram)")
-        lines.append(f"# TYPE {metric} histogram")
+        meta(metric, name, "histogram")
         total = 0
         weighted = 0.0
-        # discrete observed values become cumulative le edges
+        # discrete observed values become cumulative le edges, emitted
+        # strictly ascending (numeric sort, not lexicographic)
         for key, count in sorted(hist.items(), key=lambda kv: float(kv[0])):
             total += int(count)
             weighted += float(key) * int(count)
-            lines.append(
-                f'{metric}_bucket{{le="{_esc_label(key)}"}} {_fmt(total)}')
-        lines.append(f'{metric}_bucket{{le="+Inf"}} {_fmt(total)}')
-        lines.append(f"{metric}_sum {_fmt(weighted)}")
-        lines.append(f"{metric}_count {_fmt(total)}")
+            lines.append(f"{metric}_bucket{_labelset(labels, le=key)} "
+                         f"{_fmt(total)}")
+        lines.append(f'{metric}_bucket{_labelset(labels, le="+Inf")} '
+                     f"{_fmt(total)}")
+        lines.append(f"{metric}_sum{_labelset(labels)} {_fmt(weighted)}")
+        lines.append(f"{metric}_count{_labelset(labels)} {_fmt(total)}")
 
     return "\n".join(lines) + "\n"

@@ -43,14 +43,43 @@ import collections
 
 __all__ = ["span", "record_span", "enable", "disable", "enabled",
            "configure_from_env", "trace_context", "current_trace_id",
-           "new_trace_id", "snapshot_spans", "clear", "chrome_trace",
-           "dump_chrome_trace", "DEFAULT_RING"]
+           "new_trace_id", "snapshot_spans", "snapshot_payload", "clear",
+           "chrome_trace", "dump_chrome_trace", "set_process_name",
+           "process_name", "epoch_unix", "DEFAULT_RING"]
 
 DEFAULT_RING = 4096
 
 # one steady clock for every span: ts/dur subtract against this epoch so
 # nesting math (child inside parent interval) is exact within a process
 _EPOCH = time.perf_counter()
+
+# human-readable process role for merged fleet timelines ("router",
+# "replica:r0", ...); None renders as "pid <pid>" in the Chrome export
+_proc_name = None
+
+
+def set_process_name(name):
+    """Name this process's timeline row in merged fleet traces.  The
+    first caller wins by default (a FleetReplica must not rename a
+    process the operator already labelled); pass ``name=None`` to
+    clear."""
+    global _proc_name
+    if name is None:
+        _proc_name = None
+    elif _proc_name is None:
+        _proc_name = str(name)
+    return _proc_name
+
+
+def process_name():
+    return _proc_name
+
+
+def epoch_unix():
+    """Wall-clock time (``time.time()``) of this process's trace epoch:
+    ``epoch_unix() + span["ts"]`` is a span's absolute start time, the
+    anchor cross-process assembly normalizes clock skew against."""
+    return time.time() - (time.perf_counter() - _EPOCH)
 
 _current_span = contextvars.ContextVar("paddle_tpu_span", default=None)
 _ambient_trace = contextvars.ContextVar("paddle_tpu_trace_id",
@@ -246,23 +275,45 @@ def configure_from_env(value=None):
 
 def snapshot_spans():
     """Recorded spans, oldest first, as JSON-able dicts.  ``ts``/``dur``
-    are seconds relative to the process trace epoch."""
+    are seconds relative to the process trace epoch; every dict carries
+    the recording process's ``pid`` (and ``proc`` role name) so span
+    lists from several processes stay self-describing when merged."""
     spans = list(_ring)  # atomic under the GIL; appends during the copy
     # land in later snapshots
+    pid = os.getpid()
     return [{"name": sp.name, "trace_id": sp.trace_id,
              "span_id": sp.span_id, "parent_id": sp.parent_id,
              "ts": sp.t0 - _EPOCH, "dur": sp.dur, "tid": sp.tid,
+             "pid": pid, "proc": _proc_name,
              "attrs": dict(sp.attrs)} for sp in spans]
+
+
+def snapshot_payload():
+    """The ``/spans`` scrape body: this process's span ring plus the
+    identity and clock anchors cross-process trace assembly needs —
+    ``pid``/``process_name`` pick the timeline row, ``epoch_unix``
+    converts span ``ts`` to absolute time, and ``now_unix`` (this
+    process's wall clock at serialization) lets the scraper estimate
+    clock skew against its own send/recv envelope."""
+    return {"pid": os.getpid(), "process_name": _proc_name,
+            "epoch_unix": epoch_unix(), "now_unix": time.time(),
+            "spans": snapshot_spans()}
 
 
 def chrome_trace(spans=None):
     """Chrome trace-event JSON object (Perfetto-loadable): complete
     ``ph: "X"`` events with microsecond ``ts``/``dur``, one ``tid`` row
-    per recording thread, span attributes + ids under ``args``."""
+    per recording thread, span attributes + ids under ``args``.
+
+    Each span's OWN ``pid`` is honored (falling back to this process),
+    and every distinct pid gets a ``process_name`` metadata event — so a
+    merged fleet span list renders one labelled row group per process
+    instead of interleaving every process into this one's."""
     if spans is None:
         spans = snapshot_spans()
-    pid = os.getpid()
+    own_pid = os.getpid()
     events = []
+    proc_names = {}  # pid -> process_name metadata value
     for sp in spans:
         args = dict(sp["attrs"])
         if sp["trace_id"] is not None:
@@ -270,10 +321,17 @@ def chrome_trace(spans=None):
         args["span_id"] = sp["span_id"]
         if sp["parent_id"] is not None:
             args["parent_id"] = sp["parent_id"]
+        pid = sp.get("pid") or own_pid
+        proc = sp.get("proc") or (_proc_name if pid == own_pid else None)
+        if proc or pid not in proc_names:
+            proc_names[pid] = proc or f"pid {pid}"
         events.append({"name": sp["name"], "ph": "X", "cat": "paddle_tpu",
                        "ts": sp["ts"] * 1e6, "dur": sp["dur"] * 1e6,
                        "pid": pid, "tid": sp["tid"], "args": args})
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": name}}
+            for pid, name in sorted(proc_names.items())]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
 def dump_chrome_trace(path=None, spans=None):

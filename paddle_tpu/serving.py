@@ -788,6 +788,11 @@ class InferenceServer:
                     self._reply_raw(200,
                                     _trace.dump_chrome_trace().encode(),
                                     "application/json")
+                elif self.path == "/spans":
+                    # raw span ring + pid/process-name/clock anchors:
+                    # the scrape body fleet-level trace assembly merges
+                    # (obs.aggregate.assemble_fleet_trace)
+                    self._reply(200, _trace.snapshot_payload())
                 else:
                     self._error(404, "not_found", self.path,
                                 retryable=False)

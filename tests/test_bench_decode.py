@@ -67,6 +67,24 @@ def test_no_lost_requests(smoke_summary):
         assert mode["failures"] == 0, mode
 
 
+def test_trajectory_gate_wiring(smoke_summary, tmp_path):
+    """Smoke metrics record into a trajectory the bench gate accepts;
+    a degraded tokens/s entry fails `paddle_tpu bench check`."""
+    from paddle_tpu import cli
+    from paddle_tpu.obs import bench_history
+
+    path = str(tmp_path / "traj.json")
+    metrics = bench_history.summary_metrics("decode", smoke_summary)
+    bench_history.record("decode", metrics, path=path, baseline=True,
+                         source="test_bench_decode")
+    assert cli.main(["bench", "check", "--trajectory", path]) == 0
+    degraded = dict(metrics,
+                    tokens_per_sec=metrics["tokens_per_sec"] / 3,
+                    tokens_per_sec_ratio=1.0)
+    bench_history.record("decode", degraded, path=path)
+    assert cli.main(["bench", "check", "--trajectory", path]) == 1
+
+
 @pytest.mark.slow
 def test_acceptance_full_run():
     summary = _bench_with_retries(4, 2.0, clients=8, duration=3.0,

@@ -65,6 +65,26 @@ def test_healthy_modes_never_fail_over(smoke_summary):
     assert smoke_summary["fleet"]["1"]["killed"] == []
 
 
+def test_trajectory_gate_wiring(smoke_summary, tmp_path):
+    """The smoke run's metrics flow through the shared recorder into a
+    trajectory `paddle_tpu bench check` accepts — and a synthetically
+    degraded follow-up run flips the gate to exit-1 (the regression
+    the trajectory exists to catch)."""
+    from paddle_tpu import cli
+    from paddle_tpu.obs import bench_history
+
+    path = str(tmp_path / "traj.json")
+    metrics = bench_history.summary_metrics("fleet", smoke_summary)
+    bench_history.record("fleet", metrics, path=path, baseline=True,
+                         source="test_bench_fleet")
+    bench_history.record("fleet", dict(metrics), path=path)
+    assert cli.main(["bench", "check", "--trajectory", path]) == 0
+    degraded = dict(metrics, scaling=1.0,
+                    rps_aggregate=metrics["rps_aggregate"] / 10)
+    bench_history.record("fleet", degraded, path=path)
+    assert cli.main(["bench", "check", "--trajectory", path]) == 1
+
+
 @pytest.mark.slow
 def test_acceptance_full_run():
     summary = _bench_with_retries(4, 1.7, clients=8, duration=3.0,

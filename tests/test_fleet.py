@@ -492,6 +492,222 @@ class TestChaosDrillKillReplica:
             _trace.clear()
 
 
+class TestFleetObservabilityChurn:
+    """Acceptance drill (observability plane): 3 replicas under load,
+    one hard-killed mid-flight — the federated /metrics?fleet=1 rollup
+    stays servable THROUGHOUT (the corpse marked stale=1, never a
+    failed scrape), and /trace?fleet=1 returns ONE merged timeline in
+    which a failed-over X-Request-Id's full story reads end-to-end:
+    the router's attempt on the replica that died, the failover, the
+    survivor's serving.request — next to the dead replica's own
+    pre-death spans."""
+
+    @pytest.mark.chaos
+    def test_federation_and_trace_assembly_survive_kill(
+            self, model_dir, master):
+        import urllib.parse
+
+        svc, maddr = master
+        _trace.enable(65536)
+        _trace.clear()
+        reps = _start_replicas(model_dir, maddr, 3, lease_ttl=3.0,
+                               warmup=True, warmup_batch_sizes=(3,))
+        router = FleetRouter(master_addr=maddr, poll_interval=0.05)
+        router.start_background()
+
+        def fleet_metrics():
+            host, port = router.addr
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics?fleet=1",
+                    timeout=30) as r:
+                assert r.status == 200
+                return r.read().decode()
+
+        def fleet_trace():
+            host, port = router.addr
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/trace?fleet=1",
+                    timeout=30) as r:
+                assert r.status == 200
+                return json.loads(r.read())
+
+        stats = [{"latencies": [], "failures": []} for _ in range(4)]
+        try:
+            deadline = time.time() + 5
+            while len(router.live_replicas()) < 3 and \
+                    time.time() < deadline:
+                time.sleep(0.05)
+            warm = ServingClient(router.addr)
+            for _ in range(6):
+                warm.predict(FEED)
+
+            # healthy federation: all three replicas live, no stale
+            text = fleet_metrics()
+            assert 'stale="0"' in text and 'stale="1"' not in text
+            assert text.count("paddle_tpu_fleet_replica_up{") == 3
+
+            def loop(out, stop_at):
+                client = ServingClient(
+                    router.addr, deadline=10.0,
+                    retry=RetryPolicy(max_attempts=8, base_delay=0.05,
+                                      max_delay=0.5, jitter="full"))
+                while time.monotonic() < stop_at:
+                    t0 = time.perf_counter()
+                    try:
+                        client.predict(FEED)
+                        out["latencies"].append(
+                            time.perf_counter() - t0)
+                    except Exception as e:
+                        out["failures"].append(repr(e))
+
+            stop_at = time.monotonic() + 2.5
+            threads = [threading.Thread(target=loop,
+                                        args=(stats[i], stop_at))
+                       for i in range(len(stats))]
+            for t in threads:
+                t.start()
+            time.sleep(0.8)
+            chaos.inject("fleet.replica.kill", error=True, times=1)
+            # mid-churn: the fleet view must stay servable while the
+            # corpse is dying/dead but still leased into the table
+            deadline = time.time() + 5
+            text = fleet_metrics()
+            while 'stale="1"' not in text and time.time() < deadline:
+                time.sleep(0.1)
+                text = fleet_metrics()
+            for t in threads:
+                t.join()
+            chaos.clear("fleet.replica.kill")
+
+            killed = [r for r in reps if r.killed]
+            assert len(killed) == 1
+            dead = killed[0]
+            dead_addr = f"{dead.addr[0]}:{dead.addr[1]}"
+            assert not [f for s in stats for f in s["failures"]]
+            assert router.failover_log, "no failover recorded"
+
+            # (1) the rollup rendered WITH the corpse marked stale
+            assert (f'paddle_tpu_fleet_replica_up{{replica='
+                    f'"{dead_addr}"') in text
+            assert 'stale="1"} 0' in text
+            assert "paddle_tpu_fleet_replicas_stale 1" in text
+            # survivors' samples still labelled and present
+            for r in reps:
+                if not r.killed:
+                    assert f'replica="{r.addr[0]}:{r.addr[1]}"' in text
+
+            # (2) one merged timeline tells the failed-over request's
+            # whole story
+            obj = fleet_trace()
+            asm = obj["fleetAssembly"]
+            assert any(f["source"] == dead_addr
+                       for f in asm["failures"])   # corpse unreachable
+            assert any(p["source"] == "router"
+                       for p in asm["processes"])
+            evs = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+            by_rid = {}
+            for e in evs:
+                rid = e["args"].get("trace_id") or \
+                    e["args"].get("request_id")
+                if rid:
+                    by_rid.setdefault(rid, []).append(e)
+            survivor_ports = {r.addr[1] for r in reps if not r.killed}
+            proved = False
+            for rid, *chain in router.failover_log:
+                spans = by_rid.get(rid, [])
+                names = {e["name"] for e in spans}
+                if not {"fleet.request", "fleet.attempt",
+                        "serving.request"} <= names:
+                    continue
+                attempted = {e["args"].get("replica") for e in spans
+                             if e["name"] == "fleet.attempt"}
+                served_ports = {e["args"].get("port") for e in spans
+                                if e["name"] == "serving.request"}
+                if dead_addr in attempted and \
+                        served_ports & survivor_ports:
+                    proved = True
+                    break
+            assert proved, (list(router.failover_log)[:3],
+                            sorted(by_rid)[:5])
+            # the dead replica's own (pre-death) spans are in the SAME
+            # artifact — the in-process ring outlives the listener, so
+            # its timeline row survives the kill
+            dead_spans = [e for e in evs
+                          if e["name"] == "serving.request"
+                          and e["args"].get("port") == dead.addr[1]]
+            assert dead_spans, "dead replica's timeline row is empty"
+        finally:
+            chaos.clear()
+            for r in reps:
+                if not r.killed:
+                    r.drain()
+            router.shutdown()
+            _trace.disable()
+            _trace.clear()
+
+
+class TestRouterSLOWatchdog:
+    """Acceptance: a deliberately induced latency SLO breach inside a
+    live router produces `slo.breach` + a flight-recorder post-mortem
+    carrying the breach."""
+
+    @pytest.mark.chaos
+    def test_induced_latency_breach_and_postmortem(
+            self, model_dir, master, tmp_path, monkeypatch):
+        import os
+
+        from paddle_tpu import profiler
+
+        svc, maddr = master
+        monkeypatch.setenv("PADDLE_TPU_POSTMORTEM", str(tmp_path))
+        spec = {"version": 1, "interval_seconds": 0.1,
+                "sustained_breaches": 2,
+                "objectives": [
+                    {"name": "router-latency-p99", "kind": "quantile",
+                     "series": "fleet.request_seconds",
+                     "quantile": "p99", "max": 0.05}]}
+        reps = _start_replicas(model_dir, maddr, 1, warmup=True,
+                               warmup_batch_sizes=(3,))
+        breaches0 = profiler.runtime_metrics.counter("slo.breach")
+        pms0 = profiler.runtime_metrics.counter("slo.postmortems")
+        router = FleetRouter(master_addr=maddr, poll_interval=0.05,
+                             slo_spec=spec)
+        router.start_background()
+        try:
+            deadline = time.time() + 5
+            while not router.live_replicas() and \
+                    time.time() < deadline:
+                time.sleep(0.05)
+            client = ServingClient(router.addr)
+            client.predict(FEED)  # warm, fast: no breach material yet
+            # the induced degradation: every dispatch now stalls 200ms,
+            # blowing the 50ms p99 objective
+            chaos.inject("serving.predict", delay=0.2)
+            deadline = time.time() + 15
+            while (profiler.runtime_metrics.counter("slo.postmortems")
+                   == pms0) and time.time() < deadline:
+                client.predict(FEED)
+            assert profiler.runtime_metrics.counter("slo.breach") \
+                > breaches0
+            assert profiler.runtime_metrics.counter("slo.postmortems") \
+                > pms0
+            pm_file = tmp_path / f"postmortem-{os.getpid()}.json"
+            body = json.loads(pm_file.read_text())
+            assert "sustained SLO breach: router-latency-p99" in \
+                body["reason"]
+            breach = body["extra"]["slo_breach"]
+            assert breach["value"] > 0.05
+            # the breach log is surfaced on the router's /stats
+            code, snap = _get(router.addr, "/stats")
+            assert code == 200
+            assert snap["slo"]["breaching"].get("router-latency-p99")
+        finally:
+            chaos.clear()
+            for r in reps:
+                r.drain()
+            router.shutdown()
+
+
 class TestRollingRestartDrill:
     """Acceptance drill: drain one replica and replace it with the
     compile cache warm — the replacement flips /readyz without paying a

@@ -13,7 +13,7 @@ with the noise term removed instead of averaged over."""
 
 import time
 
-from paddle_tpu.obs import trace
+from paddle_tpu.obs import slo, trace
 from paddle_tpu.profiler import RuntimeMetrics, record_latency
 
 # the modeled production step: 1 ms of compiled dispatch (the serving
@@ -23,9 +23,15 @@ STEP_SECONDS = 0.001
 MAX_OVERHEAD_FRACTION = 0.05
 
 
-def _shell_once(metrics, i):
+def _shell_once(metrics, i, watchdog=None):
     """The per-step instrumentation shell of Executor.run_pipeline +
-    run: one step span, three phase spans, one latency series."""
+    run AND the fleet-plane hooks the hot loops now carry: one step
+    span, three phase spans, one latency series, plus the SLO tick the
+    GenScheduler loop makes (a None check unarmed; one clock read
+    armed-but-not-due).  Federation adds NO per-step hook — it is
+    pull-based, so with no scrape active its steady-state cost is
+    exactly zero — which this shell demonstrates by containing nothing
+    for it."""
     with trace.span("train.step", step=i):
         with record_latency("obs_overhead.step_seconds",
                             metrics=metrics):
@@ -35,12 +41,13 @@ def _shell_once(metrics, i):
                 pass
             with trace.span("executor.fetch"):
                 pass
+    slo.tick(watchdog)
 
 
-def _per_step_shell_seconds(metrics, iters=2000):
+def _per_step_shell_seconds(metrics, iters=2000, watchdog=None):
     t0 = time.perf_counter()
     for i in range(iters):
-        _shell_once(metrics, i)
+        _shell_once(metrics, i, watchdog)
     return (time.perf_counter() - t0) / iters
 
 
@@ -63,6 +70,32 @@ class TestDisabledTracingOverhead:
         # the latency series keeps recording while spans are disabled
         assert m.snapshot()["series"][
             "obs_overhead.step_seconds"]["count"] == 5 * 2000
+
+    def test_armed_slo_watchdog_stays_under_5_percent(self):
+        """Satellite: the SLO evaluator's hot-loop hook with a REAL
+        armed watchdog (interval not yet due — the steady state between
+        evaluations) still fits the disabled-shell budget; PADDLE_TPU_
+        TRACE=0 and no scrape active, so this is the whole fleet-plane
+        cost a decode iteration pays."""
+        trace.disable()
+        m = RuntimeMetrics()
+        wd = slo.SLOWatchdog(
+            {"version": 1, "interval_seconds": 3600.0,
+             "objectives": [{"name": "lat", "kind": "quantile",
+                             "series": "obs_overhead.step_seconds",
+                             "quantile": "p99", "max": 10.0}]},
+            metrics=m)
+        wd.evaluate()   # seed _last_eval: steady state = not-due path
+        shell = min(_per_step_shell_seconds(m, watchdog=wd)
+                    for _ in range(5))
+        budget = STEP_SECONDS * MAX_OVERHEAD_FRACTION
+        assert shell <= budget, (
+            f"armed-SLO instrumentation shell costs "
+            f"{shell * 1e6:.1f}us per step — over "
+            f"{MAX_OVERHEAD_FRACTION:.0%} of a "
+            f"{STEP_SECONDS * 1e3:.0f}ms step ({budget * 1e6:.0f}us)")
+        # the not-due path really did skip evaluation (1 seed pass)
+        assert wd.evaluations == 1
 
     def test_enabled_tracing_records_bounded_spans(self):
         trace.enable(ring_size=256)

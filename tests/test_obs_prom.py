@@ -1,0 +1,212 @@
+"""Strict Prometheus text-format (v0.0.4) conformance tests.
+
+test_obs_trace.py's ``assert_valid_exposition`` only checks line shape
+and TYPE declarations; nothing machine-validated the HISTOGRAM
+invariants the format requires — buckets ascending by ``le`` with a
+terminal ``+Inf``, cumulative counts monotone non-decreasing, and
+``_count`` equal to the ``+Inf`` bucket — nor the summary/counter
+conventions, nor label rendering (which the fleet federation now
+depends on).  This module is that parser: it fully tokenizes an
+exposition into families and asserts every per-family invariant, so a
+renderer regression fails here instead of in a scraper."""
+
+import math
+import re
+
+import pytest
+
+from paddle_tpu.obs import prom
+from paddle_tpu.profiler import RuntimeMetrics
+
+_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^{}]*)\})?"
+    r" (?P<value>NaN|[+-]?Inf|[-+0-9.eE]+)$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _value(tok):
+    if tok == "NaN":
+        return float("nan")
+    if tok in ("+Inf", "Inf"):
+        return float("inf")
+    if tok == "-Inf":
+        return float("-inf")
+    return float(tok)
+
+
+def parse_exposition(text):
+    """Parse an exposition into ``{family: {"type": t, "samples":
+    [(name, labels_dict, value)]}}``; asserts the line grammar, that
+    every sample's family is TYPE-declared BEFORE its samples, and that
+    the text ends with a newline."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    families = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert name not in families, f"duplicate TYPE for {name}"
+            assert kind in ("counter", "gauge", "summary", "histogram",
+                            "untyped"), kind
+            families[name] = {"type": kind, "samples": []}
+            continue
+        if line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        assert m, f"bad exposition line: {line!r}"
+        name = m.group("name")
+        labels = dict(_LABEL.findall(m.group("labels") or ""))
+        fam = re.sub(r"_(sum|count|bucket|total)$", "", name)
+        key = name if name in families else fam
+        assert key in families or name in families, \
+            f"sample {name!r} precedes/misses its TYPE declaration"
+        target = families.get(name) or families[key]
+        target["samples"].append((name, labels, _value(m.group("value"))))
+    return families
+
+
+def assert_conformant(text):
+    """Every family obeys its kind's invariants.  Returns the parsed
+    families for further assertions."""
+    families = parse_exposition(text)
+    for fname, fam in families.items():
+        kind, samples = fam["type"], fam["samples"]
+        if kind == "counter":
+            assert fname.endswith("_total"), fname
+            for name, _labels, value in samples:
+                assert value >= 0 or math.isnan(value), (fname, value)
+        elif kind == "summary":
+            _check_summary(fname, samples)
+        elif kind == "histogram":
+            _check_histogram(fname, samples)
+    return families
+
+
+def _group_by_labelset(samples, drop):
+    """Group a family's samples by the label set EXCLUDING ``drop``
+    (quantile/le), so labelled federated expositions are validated
+    per-replica rather than mixing replicas into one family check."""
+    groups = {}
+    for name, labels, value in samples:
+        ident = tuple(sorted((k, v) for k, v in labels.items()
+                             if k != drop))
+        groups.setdefault(ident, []).append((name, labels, value))
+    return groups
+
+
+def _check_summary(fname, samples):
+    for _ident, group in _group_by_labelset(samples, "quantile").items():
+        quantiles = [(float(labels["quantile"]), value)
+                     for name, labels, value in group
+                     if name == fname]
+        for q, _v in quantiles:
+            assert 0.0 <= q <= 1.0, (fname, q)
+        assert quantiles == sorted(quantiles), \
+            f"{fname}: quantiles not ascending"
+        names = [name for name, _l, _v in group]
+        assert f"{fname}_sum" in names, f"{fname}: missing _sum"
+        assert f"{fname}_count" in names, f"{fname}: missing _count"
+
+
+def _check_histogram(fname, samples):
+    for _ident, group in _group_by_labelset(samples, "le").items():
+        buckets = [(labels["le"], value) for name, labels, value in group
+                   if name == f"{fname}_bucket"]
+        assert buckets, f"{fname}: histogram with no buckets"
+        assert buckets[-1][0] == "+Inf", \
+            f"{fname}: last bucket must be +Inf, got {buckets[-1][0]!r}"
+        edges = [float("inf") if le == "+Inf" else float(le)
+                 for le, _v in buckets]
+        assert edges == sorted(edges), f"{fname}: le edges not ascending"
+        assert len(set(edges)) == len(edges), f"{fname}: duplicate le"
+        counts = [v for _le, v in buckets]
+        assert counts == sorted(counts), \
+            f"{fname}: cumulative bucket counts decreased"
+        count = next(v for name, _l, v in group
+                     if name == f"{fname}_count")
+        assert count == counts[-1], \
+            f"{fname}: _count {count} != +Inf bucket {counts[-1]}"
+        assert any(name == f"{fname}_sum" for name, _l, _v in group), \
+            f"{fname}: missing _sum"
+
+
+def _registry():
+    m = RuntimeMetrics()
+    m.inc("serving.requests_ok", 7)
+    m.inc("fleet.shed")
+    for v in (0.1, 0.2, 0.4, 0.8):
+        m.observe("serving.request_seconds", v)
+    # deliberately out-of-insertion-order discrete values, including
+    # a two-digit one that would sort lexicographically BEFORE "2"
+    for occ in (8, 1, 16, 2, 2, 16):
+        m.bucket("serving.batch_occupancy", occ)
+    m.set_gauge("gen.slots_active", 3)
+    return m
+
+
+class TestExpositionConformance:
+    def test_full_registry_is_conformant(self):
+        families = assert_conformant(
+            prom.render_prometheus(_registry().snapshot()))
+        assert "paddle_tpu_serving_requests_ok_total" in families
+        assert families["paddle_tpu_serving_request_seconds"]["type"] \
+            == "summary"
+        assert families["paddle_tpu_serving_batch_occupancy"]["type"] \
+            == "histogram"
+
+    def test_histogram_le_is_numeric_not_lexicographic(self):
+        """The regression this file exists for: "16" must sort after
+        "2" (float order), and +Inf must terminate the family with the
+        exact _count."""
+        text = prom.render_prometheus(_registry().snapshot())
+        les = re.findall(
+            r'paddle_tpu_serving_batch_occupancy_bucket\{le="([^"]+)"\}',
+            text)
+        assert les == ["1", "2", "8", "16", "+Inf"]
+        counts = [int(v) for v in re.findall(
+            r'paddle_tpu_serving_batch_occupancy_bucket\{le="[^"]+"\} '
+            r'(\d+)', text)]
+        assert counts == [1, 3, 4, 6, 6]       # cumulative
+        assert "paddle_tpu_serving_batch_occupancy_count 6" in text
+
+    def test_histogram_sum_agrees_with_observations(self):
+        text = prom.render_prometheus(_registry().snapshot())
+        m = re.search(r"paddle_tpu_serving_batch_occupancy_sum (\S+)",
+                      text)
+        assert float(m.group(1)) == pytest.approx(8 + 1 + 16 + 2 + 2 + 16)
+
+    def test_fixed_labels_render_on_every_sample(self):
+        """Federation contract: a replica's snapshot rendered under its
+        identity labels stays conformant, and every sample carries the
+        label."""
+        text = prom.render_prometheus(
+            _registry().snapshot(), labels={"replica": "127.0.0.1:9001"})
+        families = assert_conformant(text)
+        for fam in families.values():
+            for _name, labels, _value in fam["samples"]:
+                assert labels.get("replica") == "127.0.0.1:9001"
+        # per-sample labels compose with the fixed ones
+        assert re.search(
+            r'paddle_tpu_serving_batch_occupancy_bucket\{'
+            r'replica="127\.0\.0\.1:9001",le="\+Inf"\}', text)
+
+    def test_emit_meta_false_suppresses_comments(self):
+        text = prom.render_prometheus(_registry().snapshot(),
+                                      labels={"replica": "a:1"},
+                                      emit_meta=False)
+        assert "# TYPE" not in text and "# HELP" not in text
+        assert "paddle_tpu_serving_requests_ok_total" in text
+
+    def test_label_values_escaped(self):
+        m = RuntimeMetrics()
+        m.inc("c")
+        text = prom.render_prometheus(
+            m.snapshot(), labels={"replica": 'evil"\\\nhost'})
+        assert '\\"' in text and "\\\\" in text and "\\n" in text
+        assert_conformant(text)
+
+    def test_live_registry_default_is_conformant(self):
+        # whatever the process has emitted so far must render clean
+        assert_conformant(prom.render_prometheus())

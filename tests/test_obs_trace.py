@@ -102,7 +102,8 @@ class TestSpans:
         trace.record_span("pull", time.perf_counter(), 0.001)
         (sp,) = trace.snapshot_spans()
         assert sp["trace_id"] is None
-        (ev,) = trace.chrome_trace()["traceEvents"]
+        (ev,) = [e for e in trace.chrome_trace()["traceEvents"]
+                 if e["ph"] == "X"]
         assert "trace_id" not in ev["args"]
 
     def test_env_grammar(self, monkeypatch):
@@ -131,7 +132,8 @@ class TestChromeExport:
         body = trace.dump_chrome_trace()
         obj = json.loads(body)              # valid JSON round-trip
         assert obj["displayTimeUnit"] == "ms"
-        evs = {e["name"]: e for e in obj["traceEvents"]}
+        evs = {e["name"]: e for e in obj["traceEvents"]
+               if e["ph"] == "X"}
         for e in evs.values():
             assert e["ph"] == "X" and e["pid"] == os.getpid()
             assert isinstance(e["ts"], float) and e["dur"] >= 0
@@ -149,7 +151,47 @@ class TestChromeExport:
         assert trace.dump_chrome_trace(str(p)) == str(p)
         with open(p) as f:
             obj = json.load(f)
-        assert len(obj["traceEvents"]) == 1
+        assert len([e for e in obj["traceEvents"]
+                    if e["ph"] == "X"]) == 1
+
+    def test_per_process_pid_and_process_name_metadata(self):
+        """Satellite regression: chrome_trace honors each span's OWN
+        pid (not a constant) and emits one process_name metadata event
+        per distinct pid — merging two processes' span lists must
+        produce two labelled timeline rows, not one interleaved row."""
+        with trace.span("local.work"):
+            pass
+        ours = trace.snapshot_spans()
+        assert all(s["pid"] == os.getpid() for s in ours)
+        # a second process's snapshot, as its /spans scrape would carry
+        theirs = [dict(s, pid=os.getpid() + 1, proc="replica:r9",
+                       name="remote.work") for s in ours]
+        obj = trace.chrome_trace(ours + theirs)
+        complete = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+        meta = [e for e in obj["traceEvents"]
+                if e["ph"] == "M" and e["name"] == "process_name"]
+        assert {e["pid"] for e in complete} == \
+            {os.getpid(), os.getpid() + 1}
+        by_pid = {e["pid"]: e["args"]["name"] for e in meta}
+        assert by_pid[os.getpid() + 1] == "replica:r9"
+        assert by_pid[os.getpid()]  # the local row is labelled too
+        # the two processes' spans landed on different rows
+        local = next(e for e in complete if e["name"] == "local.work")
+        remote = next(e for e in complete if e["name"] == "remote.work")
+        assert local["pid"] != remote["pid"]
+
+    def test_snapshot_payload_carries_clock_anchors(self):
+        with trace.span("s"):
+            pass
+        payload = trace.snapshot_payload()
+        assert payload["pid"] == os.getpid()
+        assert payload["spans"]
+        # epoch_unix + ts ~= the span's absolute wall time, and now_unix
+        # sits at/after it (same process, same clock)
+        sp = payload["spans"][-1]
+        abs_t = payload["epoch_unix"] + sp["ts"]
+        assert abs_t == pytest.approx(time.time(), abs=5.0)
+        assert payload["now_unix"] >= abs_t - 1e-3
 
 
 # ---------------------------------------------------------------------------

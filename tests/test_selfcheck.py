@@ -51,4 +51,20 @@ def test_selfcheck_sections_are_complete():
     names = {s["name"] for s in report["sections"]}
     assert {"zoo-lint", "zoo-distribute", "zoo-pipeline", "gen-bundle",
             "diagnostic-registry", "metric-registry",
-            "failpoint-registry"} <= names
+            "failpoint-registry", "slo-spec",
+            "bench-trajectory"} <= names
+
+
+def test_slo_spec_section_fails_on_malformed_env_spec(tmp_path,
+                                                      monkeypatch):
+    bad = tmp_path / "slo.json"
+    bad.write_text('{"version": 1, "objectives": []}')
+    monkeypatch.setenv("PADDLE_TPU_SLO", str(bad))
+    section = sc._check_slo_spec()
+    assert not section["ok"]
+    assert any("objectives" in f for f in section["failures"])
+
+
+def test_bench_trajectory_section_validates_repo_file():
+    section = sc._check_bench_trajectory()
+    assert section["ok"], section["failures"]
