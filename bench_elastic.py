@@ -1,0 +1,212 @@
+"""Elastic-resume drill + wall-time bench: kill a dp4 ZeRO run
+mid-step, resume on dp2 from the last-good shard checkpoint, and time
+the resume (restore planning + shard re-slicing + device placement).
+
+This is the acceptance drill of docs/fault_tolerance.md "Elastic
+resume" run as a measurable artifact: the resumed run must reach the
+same final loss as an uninterrupted reference run (``loss_delta_rel``),
+the restore plan must verify on the shrunk mesh with zero
+``reshard_failures``, and ``resume_seconds`` — the time
+``restore_last_good(mesh=dp2)`` takes — is recorded into
+``BENCH_TRAJECTORY.json`` (``--record-trajectory``) so ``paddle_tpu
+bench check`` guards resume wall-time against regression.
+
+    python bench_elastic.py --out BENCH_ELASTIC.json
+    python bench_elastic.py --smoke      # fast CI schema check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+TRAINER = r'''
+import argparse
+import json
+import os
+import time
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.datapipe as dp
+from paddle_tpu import layers
+from paddle_tpu.fault import CheckpointManager, chaos
+from paddle_tpu.parallel import ParallelExecutor
+from paddle_tpu.parallel.mesh import make_mesh
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--ckpt", required=True)
+ap.add_argument("--dp", type=int, required=True)
+ap.add_argument("--hidden", type=int, default=64)
+ap.add_argument("--samples", type=int, default=160)
+ap.add_argument("--batch", type=int, default=16)
+ap.add_argument("--out", required=True)
+args = ap.parse_args()
+
+main, startup = fluid.Program(), fluid.Program()
+main.random_seed = startup.random_seed = 11
+with fluid.program_guard(main, startup):
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = layers.fc(x, args.hidden, act="relu", param_attr="w1",
+                  bias_attr="b1")
+    pred = layers.fc(h, 1, param_attr="w2", bias_attr="b2")
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+rng = np.random.RandomState(7)
+w_true = np.arange(1.0, 9.0, dtype="float32").reshape(8, 1) * 0.2
+xs = rng.rand(args.samples, 8).astype("float32")
+samples = [{"x": xs[i], "y": (xs[i:i + 1] @ w_true)[0].astype("float32")}
+           for i in range(args.samples)]
+pipe = dp.InMemorySource(samples).batch(args.batch, drop_last=True)
+
+mesh = make_mesh((args.dp,), ("data",), devices=jax.devices()[:args.dp])
+exe = fluid.Executor()
+exe.run(startup)
+pexe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                        mesh=mesh, zero=True)
+mgr = CheckpointManager(args.ckpt, keep=5, executor=pexe,
+                        main_program=main, datapipe=pipe, mesh=mesh,
+                        shard_specs=pexe.zero_plan.checkpoint_specs())
+t0 = time.perf_counter()
+resumed = mgr.restore_last_good()
+restore_seconds = time.perf_counter() - t0
+step = resumed or 0
+
+losses = []
+for batch in pipe:
+    step += 1
+    chaos.fire("train.step", step=step)
+    (lv,) = pexe.run(feed=batch, fetch_list=[loss.name])
+    losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    mgr.save_async(step)
+    mgr.mark_good(step)                  # drains the pending commit
+
+with open(args.out, "w") as f:
+    json.dump({"final_loss": losses[-1], "resumed_from": resumed,
+               "steps": len(losses), "dp": args.dp,
+               "restore_seconds": restore_seconds}, f)
+'''
+
+KILL_EXIT_CODE = 137
+
+
+def _run_trainer(workdir, trainer, ckpt, out, dp, hidden, samples,
+                 batch, chaos_spec=None, timeout=600):
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_TPU_CHAOS", None)
+    if chaos_spec:
+        env["PADDLE_TPU_CHAOS"] = chaos_spec
+    r = subprocess.run(
+        [sys.executable, trainer, "--ckpt", ckpt, "--dp", str(dp),
+         "--hidden", str(hidden), "--samples", str(samples),
+         "--batch", str(batch), "--out", out],
+        cwd=repo_root, env=env, capture_output=True, text=True,
+        timeout=timeout)
+    return r
+
+
+def run_bench(dp_from=4, dp_to=2, hidden=128, samples=160, batch=16,
+              kill_after=5, smoke=False):
+    if smoke:
+        hidden, samples = min(hidden, 32), min(samples, 96)
+    steps_total = samples // batch
+    summary = {
+        "workload": {"dp_from": dp_from, "dp_to": dp_to,
+                     "hidden": hidden, "samples": samples,
+                     "batch": batch, "steps": steps_total,
+                     "kill_after": kill_after},
+        "smoke": bool(smoke),
+        "reshard_failures": 0,
+    }
+    with tempfile.TemporaryDirectory(prefix="bench_elastic_") as tmp:
+        trainer = os.path.join(tmp, "trainer.py")
+        with open(trainer, "w") as f:
+            f.write(TRAINER)
+        common = dict(hidden=hidden, samples=samples, batch=batch)
+
+        # uninterrupted reference on the full mesh
+        ref_out = os.path.join(tmp, "ref.json")
+        r = _run_trainer(tmp, trainer, os.path.join(tmp, "ref_ckpt"),
+                         ref_out, dp_from, **common)
+        if r.returncode != 0:
+            raise RuntimeError(f"reference run failed: "
+                               f"{r.stderr[-2000:]}")
+        with open(ref_out) as f:
+            ref = json.load(f)
+        summary["reference"] = ref
+
+        # chaos run: hard-killed mid-step on the full mesh
+        ckpt = os.path.join(tmp, "ckpt")
+        got_out = os.path.join(tmp, "got.json")
+        r = _run_trainer(tmp, trainer, ckpt, got_out, dp_from,
+                         chaos_spec=f"train.step=kill@{kill_after}",
+                         **common)
+        if r.returncode != KILL_EXIT_CODE:
+            raise RuntimeError(
+                f"kill run exited {r.returncode}, wanted "
+                f"{KILL_EXIT_CODE}: {r.stderr[-2000:]}")
+        summary["killed"] = {"exit_code": r.returncode,
+                             "at_step": kill_after + 1}
+
+        # resume on the SHRUNK mesh from the last-good shard checkpoint
+        r = _run_trainer(tmp, trainer, ckpt, got_out, dp_to, **common)
+        if r.returncode != 0:
+            summary["reshard_failures"] = 1
+            raise RuntimeError(f"shrink-resume failed: "
+                               f"{r.stderr[-2000:]}")
+        with open(got_out) as f:
+            resume = json.load(f)
+        summary["resume"] = resume
+
+    ref_loss, got_loss = ref["final_loss"], resume["final_loss"]
+    summary["loss_delta_rel"] = abs(got_loss - ref_loss) / max(
+        abs(ref_loss), 1e-12)
+    summary["exactly_once"] = (resume["resumed_from"] +
+                               resume["steps"] == steps_total)
+    return summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dp-from", type=int, default=4)
+    ap.add_argument("--dp-to", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--samples", type=int, default=160)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--kill-after", type=int, default=5)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small fast run for CI schema checks")
+    ap.add_argument("--out", default=None, help="write the JSON summary")
+    from paddle_tpu.obs import bench_history
+    bench_history.add_record_args(ap)
+    args = ap.parse_args(argv)
+    summary = run_bench(dp_from=args.dp_from, dp_to=args.dp_to,
+                        hidden=args.hidden, samples=args.samples,
+                        batch=args.batch, kill_after=args.kill_after,
+                        smoke=args.smoke)
+    text = json.dumps(summary, indent=2, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    bench_history.record_from_args("elastic", summary, args,
+                                   "bench_elastic.py")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

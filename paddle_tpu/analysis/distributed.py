@@ -784,7 +784,7 @@ def _s_lookup_table(op, senv):
 
 
 @sharding_rule("sgd", "momentum", "adam", "adamax", "adagrad",
-               "rmsprop")
+               "rmsprop", "decayed_adagrad", "adadelta", "ftrl")
 def _s_optimizer(op, senv):
     p = senv.input_spec(op, "Param")
     g = senv.input_spec(op, "Grad")
@@ -794,6 +794,30 @@ def _s_optimizer(op, senv):
             f"{op.type} updates `{op.input('Param')[0]}` (placed {p}) "
             f"with a gradient placed {g} — param and grad shardings "
             f"must agree", op=op, var=op.input("Param")[0])
+    # ZeRO discipline: every param-shaped state slot of ONE update op
+    # must share one placement — a plan that shards moment1 but leaves
+    # moment2 replicated (or splits them over different axes) computes
+    # the update across misaligned slices.  Params replicated + state
+    # sharded is the *intended* ZeRO shape, so param-vs-state
+    # disagreement stays silent; only state-vs-state is provably wrong.
+    from paddle_tpu.parallel.zero import OPTIMIZER_STATE_SLOTS
+    known = []
+    for slot in OPTIMIZER_STATE_SLOTS.get(op.type, ()):
+        if not op.input(slot):
+            continue
+        spec = senv.input_spec(op, slot)
+        if spec is not None:
+            known.append((slot, op.input(slot)[0], spec))
+    for (a_slot, a_name, a_spec), (b_slot, b_name, b_spec) in \
+            zip(known, known[1:]):
+        if a_spec != b_spec:
+            senv.report(
+                "PTA016",
+                f"{op.type} optimizer state is inconsistently sharded: "
+                f"`{a_name}` ({a_slot}) placed {a_spec} but `{b_name}` "
+                f"({b_slot}) placed {b_spec} — all state slots of one "
+                f"update must share a placement (the ZeRO plan owns "
+                f"them together)", op=op, var=b_name)
     senv.set_output(op, "ParamOut", p)
 
 

@@ -281,6 +281,76 @@ def _check_slo_spec():
     return _section("slo-spec", detail, failures)
 
 
+def _check_ckpt_manifest():
+    """Checkpoint-manifest schema gate: write a fresh SHARD-format
+    checkpoint (synthetic state, no executor, no program) through the
+    real ``fault.shard_ckpt`` writer + atomic commit, and prove the
+    manifest's topology record is present and self-consistent —
+    ``verify_checkpoint`` passes (per-shard hashes AND topology
+    cross-checks), and a deliberately tampered topology fails.  The
+    elastic-resume contract breaks silently if the schema drifts; this
+    fails the static gate instead."""
+    import json
+
+    import numpy as np
+
+    from paddle_tpu.fault import shard_ckpt
+    from paddle_tpu.fault.checkpoint import (CorruptCheckpoint,
+                                             MANIFEST_NAME,
+                                             commit_checkpoint,
+                                             verify_checkpoint)
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    failures = []
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_selfcheck_ckpt_")
+    try:
+        mesh = make_mesh()
+        dp = int(mesh.devices.shape[0])
+        state = {"w": np.arange(8 * dp * 3, dtype="float32").reshape(
+                     8 * dp, 3),
+                 "moment.w": np.ones((8 * dp, 3), "float32"),
+                 "lr": np.asarray([0.1], "float32")}
+        topo = shard_ckpt.build_topology(
+            mesh, state, {"moment.w": ("data", None)})
+        tmp_dir = os.path.join(tmp, ".tmp-ckpt-1")
+        final = os.path.join(tmp, "ckpt-1")
+        os.makedirs(tmp_dir)
+        shard_ckpt.write_state(tmp_dir, state, topo, step=1)
+        commit_checkpoint(tmp_dir, final, step=1,
+                          extra={"topology": topo})
+        manifest = shard_ckpt.read_manifest(final)
+        if manifest is None or "topology" not in manifest:
+            failures.append("committed manifest lacks a topology record")
+        else:
+            failures.extend(shard_ckpt.validate_topology(manifest))
+            try:
+                verify_checkpoint(final)
+            except CorruptCheckpoint as e:
+                failures.append(f"fresh shard checkpoint fails "
+                                f"verification: {e}")
+            rec = manifest["topology"]["shards"]["moment.w"]
+            if dp > 1 and rec["num_shards"] != dp:
+                failures.append(
+                    f"moment.w should shard {dp}-way over `data`, "
+                    f"topology records {rec['num_shards']}")
+            # the negative direction: a tampered record must FAIL
+            manifest["topology"]["shards"]["moment.w"]["num_shards"] = \
+                rec["num_shards"] + 1
+            with open(os.path.join(final, MANIFEST_NAME), "w") as f:
+                json.dump(manifest, f)
+            try:
+                verify_checkpoint(final)
+                failures.append("tampered topology record passed "
+                                "verification")
+            except CorruptCheckpoint:
+                pass
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return _section("ckpt-manifest",
+                    "shard-checkpoint topology record write/verify "
+                    "round-trip", failures)
+
+
 def _check_bench_trajectory():
     """``bench check --dry`` against the repo's BENCH_TRAJECTORY.json:
     a drifted or malformed trajectory schema fails the static gate (the
@@ -307,5 +377,6 @@ def run_selfcheck():
         _check_failpoint_registry(),
         _check_slo_spec(),
         _check_bench_trajectory(),
+        _check_ckpt_manifest(),
     ]
     return {"ok": all(s["ok"] for s in sections), "sections": sections}

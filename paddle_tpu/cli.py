@@ -59,6 +59,15 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              transpiled trainer/pserver
                                              pair; --pipeline N verifies
                                              an N-stage split
+  ckpt    inspect DIR | verify DIR           checkpoint-dir survey:
+                                             committed steps, per-shard
+                                             manifest status, saved mesh
+                                             topology, latest/last-good
+                                             pointers; verify re-hashes
+                                             every file and exits 1 on
+                                             corruption (operator
+                                             restorability probe — no
+                                             program load, no device)
   selfcheck                                  strict zoo lint (single- and
                                              multi-program) + every
                                              scanner-enforced registry +
@@ -398,6 +407,137 @@ def _cmd_bench(args):
         verdict = "PASS" if report["ok"] else "FAIL"
         what = "schema" if args.dry else "regression gate"
         print(f"bench check ({what}): {verdict} [{report['path']}]")
+    return 0 if report["ok"] else 1
+
+
+def _ckpt_report(dirname, step=None, deep=False):
+    """The ``paddle_tpu ckpt`` survey of a checkpoint directory — pure
+    directory/manifest reads (no executor, no program, no device):
+    committed steps with per-step manifest status (and per-shard file
+    presence for shard-format checkpoints), the saved mesh topology,
+    the latest/last-good pointers, and quarantined dirs.  ``deep``
+    re-hashes every file (``verify``); shallow reads manifests only."""
+    from paddle_tpu.fault import checkpoint as ckpt_mod
+    from paddle_tpu.fault import shard_ckpt
+    from paddle_tpu.fault.checkpoint import (CorruptCheckpoint,
+                                             GOOD_POINTER_NAME)
+
+    report = {"dir": os.path.abspath(dirname), "steps": [],
+              "latest": None, "last_good": None, "quarantined": [],
+              "ok": True}
+    for pointer, key in (("latest", "latest"),
+                         (GOOD_POINTER_NAME, "last_good")):
+        try:
+            with open(os.path.join(dirname, pointer)) as f:
+                report[key] = int(f.read().strip())
+        except (OSError, ValueError):
+            pass
+    steps = []
+    for name in sorted(os.listdir(dirname)):
+        if name.endswith(".corrupt"):
+            report["quarantined"].append(name)
+            continue
+        if not name.startswith("ckpt-") or \
+                not name[len("ckpt-"):].isdigit():
+            continue
+        steps.append(int(name[len("ckpt-"):]))
+    for s in sorted(steps):
+        if step is not None and s != int(step):
+            continue
+        path = os.path.join(dirname, f"ckpt-{s}")
+        row = {"step": s, "format": "legacy", "status": "unverifiable",
+               "topology": None, "shards": None}
+        manifest = shard_ckpt.read_manifest(path)
+        if manifest is not None:
+            row["format"] = "manifest"
+            topo = manifest.get("topology")
+            if topo is not None:
+                row["format"] = "sharded"
+                shards = topo.get("shards") or {}
+                counts = [r.get("num_shards", 1) for r in shards.values()]
+                row["topology"] = {
+                    "mesh_shape": topo.get("mesh_shape"),
+                    "axis_names": topo.get("axis_names"),
+                    "processes": topo.get("processes"),
+                }
+                row["shards"] = {
+                    "vars": len(shards),
+                    "sharded_vars": sum(1 for c in counts if c > 1),
+                    "shard_files": sum(counts),
+                }
+            try:
+                if deep:
+                    ckpt_mod.verify_checkpoint(path)
+                else:
+                    # shallow: file presence + size + topology
+                    # self-consistency, no re-hash
+                    for rel, want in manifest.get("files", {}).items():
+                        p = os.path.join(path, rel)
+                        if not os.path.exists(p):
+                            raise CorruptCheckpoint(
+                                f"{path}: missing file {rel!r}")
+                        if os.path.getsize(p) != want["size"]:
+                            raise CorruptCheckpoint(
+                                f"{path}: {rel!r} size mismatch")
+                    if topo is not None:
+                        problems = shard_ckpt.validate_topology(manifest)
+                        if problems:
+                            raise CorruptCheckpoint("; ".join(problems))
+                row["status"] = "verified" if deep else "present"
+            except CorruptCheckpoint as e:
+                row["status"] = "CORRUPT"
+                row["error"] = str(e)
+                report["ok"] = False
+        report["steps"].append(row)
+    if step is not None and not report["steps"]:
+        report["ok"] = False
+        report["error"] = f"no committed ckpt-{int(step)} in {dirname}"
+    return report
+
+
+def _cmd_ckpt(args):
+    """Operator-facing checkpoint survey: ``inspect`` prints steps,
+    per-shard manifest status, the saved mesh topology, and the
+    latest/last-good pointers; ``verify`` re-hashes every file of every
+    committed step (or ``--step N``) and exit-codes on corruption — so
+    restorability is checkable from a cron job without loading a
+    program or touching a device."""
+    import json as _json
+
+    if not os.path.isdir(args.dir):
+        print(f"ckpt {args.action}: no such directory {args.dir!r}",
+              file=sys.stderr)
+        return 2
+    deep = args.action == "verify"
+    report = _ckpt_report(args.dir, step=args.step, deep=deep)
+    if args.json:
+        print(_json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(f"checkpoint dir: {report['dir']}")
+        print(f"latest: {report['latest']}   "
+              f"last_good: {report['last_good']}")
+        for row in report["steps"]:
+            line = (f"  ckpt-{row['step']}: {row['status']} "
+                    f"[{row['format']}]")
+            topo = row.get("topology")
+            if topo:
+                line += (f" mesh={topo['mesh_shape']}"
+                         f"{topo['axis_names']}")
+            sh = row.get("shards")
+            if sh:
+                line += (f" vars={sh['vars']} "
+                         f"sharded={sh['sharded_vars']} "
+                         f"shard_files={sh['shard_files']}")
+            print(line)
+            if row.get("error"):
+                print(f"    {row['error']}")
+        for q in report["quarantined"]:
+            print(f"  {q}: quarantined")
+        if report.get("error"):
+            print(f"ckpt {args.action}: {report['error']}",
+                  file=sys.stderr)
+        verdict = "PASS" if report["ok"] else "FAIL"
+        print(f"ckpt {args.action}: {verdict}")
     return 0 if report["ok"] else 1
 
 
@@ -983,6 +1123,20 @@ def main(argv=None):
                    help="also print the warn-list of op types without "
                         "an inference rule")
     p.set_defaults(fn=_cmd_lint)
+
+    p = sub.add_parser("ckpt",
+                       help="survey a checkpoint directory: steps, "
+                            "per-shard manifest status, saved mesh "
+                            "topology, last-good pointer; verify "
+                            "re-hashes and exit-codes on corruption")
+    p.add_argument("action", choices=["inspect", "verify"])
+    p.add_argument("dir", help="checkpoint directory "
+                               "(CheckpointManager dirname)")
+    p.add_argument("--step", type=int, default=None,
+                   help="limit to one committed step")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.set_defaults(fn=_cmd_ckpt)
 
     p = sub.add_parser("selfcheck",
                        help="one exit-coded pass over every static "

@@ -105,11 +105,39 @@ class Source(Stage):
             yield sample
 
     def _state(self):
-        return {"epoch": self._epoch, "offset": self._offset}
+        # sharding geometry rides the state so a restore onto a
+        # DIFFERENT dp degree (elastic shrink/grow) can reposition
+        # instead of silently replaying/skipping the wrong stride
+        return {"epoch": self._epoch, "offset": self._offset,
+                "num_shards": self.num_shards,
+                "shard_index": self.shard_index}
 
     def _load_state(self, state):
         self._epoch = int(state["epoch"])
-        self._offset = int(state["offset"])
+        offset = int(state["offset"])
+        saved_shards = int(state.get("num_shards", self.num_shards))
+        if saved_shards != self.num_shards:
+            # elastic resume: the stream was consumed with a different
+            # stride.  All shards advance in lockstep (one batch per
+            # step, checkpoints at step boundaries), so the saved
+            # per-shard offset means ``saved_shards * offset`` samples
+            # of the epoch are consumed globally; this shard resumes at
+            # its slice of the remainder.  Exactly-once requires the
+            # global position to land on a whole new-stride row — a
+            # ragged cut would force replays (duplicates) or skips
+            # (gaps), so it fails loudly instead.
+            global_consumed = saved_shards * offset
+            if global_consumed % self.num_shards:
+                from paddle_tpu.datapipe.core import PipelineStateError
+                raise PipelineStateError(
+                    f"source {self.name!r}: cannot reposition a "
+                    f"checkpoint of {saved_shards} shard(s) at offset "
+                    f"{offset} onto {self.num_shards} shard(s) — "
+                    f"global position {global_consumed} does not align "
+                    f"with the new stride (checkpoint at an aligned "
+                    f"step, or restore onto the saved degree)")
+            offset = global_consumed // self.num_shards
+        self._offset = offset
 
     def _reset_local(self):
         self._epoch = 0

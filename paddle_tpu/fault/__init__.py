@@ -26,6 +26,10 @@ survives failure:
   bundles replayable via ``paddle_tpu replay``), and automatic rollback
   to the last known-good checkpoint
   (``CheckpointManager.mark_good()/restore_last_good()``).
+- :mod:`paddle_tpu.fault.shard_ckpt` — the elastic per-shard checkpoint
+  format: concurrent one-file-per-mesh-shard writes inside the atomic
+  commit, a manifest topology record, and the statically-verified
+  restore planner that maps a dp4 checkpoint onto a dp2 (or dp8) mesh.
 """
 
 from __future__ import annotations
@@ -38,11 +42,12 @@ from paddle_tpu.fault.lifecycle import GracefulShutdown, graceful_shutdown
 from paddle_tpu.fault.retry import RetryError, RetryPolicy, retrying
 from paddle_tpu.fault.sentinel import (NumericalFault, Sentinel,
                                        replay_bundle, sentinel_from_env)
+from paddle_tpu.fault.shard_ckpt import ReshardError
 
 __all__ = [
     "chaos", "FaultInjected", "fire", "inject",
     "CheckpointManager", "CorruptCheckpoint", "manager_from_env",
-    "verify_checkpoint",
+    "verify_checkpoint", "ReshardError",
     "GracefulShutdown", "graceful_shutdown",
     "RetryError", "RetryPolicy", "retrying",
     "NumericalFault", "Sentinel", "replay_bundle", "sentinel_from_env",

@@ -31,14 +31,21 @@ DATAPIPE_STATE_NAME = "datapipe_state.pkl"
 GOOD_POINTER_NAME = "last_good"
 
 
-def _datapipe_state_name():
+def _datapipe_state_name(rank=None, processes=None):
     """Per-host sidecar name: each trainer's iterator position is
     host-local state (its own input shard), so multi-host runs save one
-    file per process; single-host keeps the unsuffixed legacy name."""
+    file per process; single-host keeps the unsuffixed legacy name.
+    ``rank``/``processes`` override the live process coordinates (the
+    topology-changed restore derives rank 0's saved name through the
+    same formatter that wrote it)."""
     import jax
-    if jax.process_count() == 1:
+    if processes is None:
+        processes = jax.process_count()
+    if rank is None:
+        rank = jax.process_index()
+    if processes == 1:
         return DATAPIPE_STATE_NAME
-    return f"datapipe_state.{jax.process_index()}.pkl"
+    return f"datapipe_state.{rank}.pkl"
 MANIFEST_FORMAT = 1
 _TMP_PREFIX = ".tmp-"
 _QUARANTINE_SUFFIX = ".corrupt"
@@ -71,8 +78,11 @@ def _fsync_dir(path):
         os.close(fd)
 
 
-def write_manifest(path, step=None):
-    """Checksum every file under ``path`` into ``MANIFEST.json`` (fsynced)."""
+def write_manifest(path, step=None, extra=None):
+    """Checksum every file under ``path`` into ``MANIFEST.json``
+    (fsynced).  ``extra``: additional top-level manifest entries — the
+    shard checkpoint writer records its mesh ``topology`` here, inside
+    the same fsynced commit as the checksums."""
     files = {}
     for rel, abs_p in _walk_files(path):
         if rel == MANIFEST_NAME:
@@ -80,6 +90,8 @@ def write_manifest(path, step=None):
         files[rel] = {"sha256": _sha256(abs_p),
                       "size": os.path.getsize(abs_p)}
     manifest = {"format": MANIFEST_FORMAT, "step": step, "files": files}
+    for key, value in (extra or {}).items():
+        manifest[key] = value
     mpath = os.path.join(path, MANIFEST_NAME)
     with open(mpath, "w") as f:
         json.dump(manifest, f, indent=1)
@@ -114,10 +126,21 @@ def verify_checkpoint(path):
                 f"{path}: {rel!r} size {size} != manifest {want['size']}")
         if _sha256(abs_p) != want["sha256"]:
             raise CorruptCheckpoint(f"{path}: {rel!r} checksum mismatch")
+    if "topology" in manifest:
+        # shard-format checkpoint: the topology record must also be
+        # SELF-consistent (every declared shard file checksummed, shard
+        # counts matching the saved mesh axis, shapes slicing evenly) —
+        # per-file hashes prove bytes, this proves the geometry
+        from paddle_tpu.fault import shard_ckpt
+        problems = shard_ckpt.validate_topology(manifest)
+        if problems:
+            raise CorruptCheckpoint(
+                f"{path}: inconsistent topology record: "
+                + "; ".join(problems))
     return manifest
 
 
-def commit_checkpoint(tmp_path, final_path, step=None):
+def commit_checkpoint(tmp_path, final_path, step=None, extra=None):
     """Manifest + fsync + atomic rename: the commit point of a save.
 
     The ``ckpt.commit`` failpoint sits after the full temp write and
@@ -125,7 +148,7 @@ def commit_checkpoint(tmp_path, final_path, step=None):
     checkpoint as the restore target.
     """
     with _span("ckpt.manifest", step=step):
-        write_manifest(tmp_path, step=step)
+        write_manifest(tmp_path, step=step, extra=extra)
         _fsync_dir(tmp_path)
     chaos.fire("ckpt.commit", step=step)
     with _span("ckpt.rename", step=step):
@@ -179,16 +202,30 @@ class CheckpointManager:
     serialized into every checkpoint (same atomic commit as the
     tensors) and restored alongside them, so a killed trainer resumes
     mid-epoch with the exact sample sequence it would have seen.
+
+    ``mesh`` + ``shard_specs``: switch saves to the ELASTIC per-shard
+    format (``fault.shard_ckpt``) — each var one file per mesh shard,
+    written concurrently, topology recorded in the manifest — and let
+    every restore accept a ``mesh=`` that *differs* from the one that
+    saved (dp4 → dp2 and back), with the restore plan statically
+    verified before any device allocation.  ``save_async`` moves the
+    whole write+commit off the step path: the state snapshot is taken
+    synchronously (jax arrays are immutable), the serialization, shard
+    writes, and atomic commit run on a background thread.
     """
 
     def __init__(self, dirname, keep=5, executor=None, main_program=None,
-                 scope=None, datapipe=None):
+                 scope=None, datapipe=None, mesh=None, shard_specs=None):
         self.dirname = str(dirname)
         self.keep = keep
         self.executor = executor
         self.main_program = main_program
         self.scope = scope
         self.datapipe = datapipe
+        self.mesh = mesh
+        self.shard_specs = dict(shard_specs or {})
+        self._async_pool = None       # lazily-built single writer thread
+        self._pending = None          # in-flight async save future
         self._committed = set()       # every step saved by this process
         self._verified = set()        # steps read-verified this process
         self._verify_failed = set()   # ...and ones that failed, so a
@@ -229,6 +266,56 @@ class CheckpointManager:
     def save(self, step):
         """Commit the current training state as ``ckpt-<step>`` (plus the
         datapipe iterator position, when a pipeline is attached)."""
+        self.wait_pending()   # one writer: never overlap an async save
+        state, extras = self._snapshot()
+        return self._save_committed(step, state, extras)
+
+    def save_async(self, step):
+        """Commit ``ckpt-<step>`` OFF the step path: the state is
+        snapshotted to HOST now (the next guarded step would otherwise
+        donate the device buffers out from under the writer — host
+        materialization is the one synchronous cost, the standard async
+        checkpoint split; the datapipe position is captured at the same
+        point), then serialization, shard writes, and the atomic commit
+        run on a background writer thread.  Returns a
+        ``concurrent.futures.Future`` resolving to the committed path;
+        saves are serialized on one writer thread (single-writer
+        directory protocol), and a pending save is drained before any
+        synchronous :meth:`save`, any restore, or
+        :meth:`wait_pending`."""
+        from concurrent.futures import ThreadPoolExecutor
+        self.wait_pending()
+        state, extras = self._snapshot(materialize=True)
+        if self._async_pool is None:
+            self._async_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-async")
+        self._pending = self._async_pool.submit(
+            self._save_committed, step, state, extras)
+        return self._pending
+
+    def wait_pending(self):
+        """Block until the in-flight async save (if any) committed;
+        re-raises its failure.  Returns the committed path or None."""
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return None
+        return pending.result()
+
+    def _snapshot(self, materialize=False):
+        import numpy as np
+        from paddle_tpu import io
+        state = io.snapshot_state(self.main_program, self.scope)
+        if materialize:
+            # host copies: donation on the next step may delete the
+            # device buffers this snapshot references
+            state = {n: np.asarray(v) for n, v in state.items()}
+        extras = None
+        if self.datapipe is not None:
+            extras = {_datapipe_state_name(): pickle.dumps(
+                self.datapipe.state_dict(), protocol=4)}
+        return state, extras
+
+    def _save_committed(self, step, state, extras):
         from paddle_tpu import io
         import jax
         if jax.process_index() == 0 and \
@@ -244,14 +331,12 @@ class CheckpointManager:
             except OSError:
                 pass
         with _span("ckpt.save", step=step):
-            extras = None
-            if self.datapipe is not None:
-                extras = {_datapipe_state_name(): pickle.dumps(
-                    self.datapipe.state_dict(), protocol=4)}
             path = io.save_checkpoint(self.executor, self.dirname,
                                       main_program=self.main_program,
                                       step=step, scope=self.scope,
-                                      extras=extras)
+                                      extras=extras, mesh=self.mesh,
+                                      shard_specs=self.shard_specs,
+                                      state=state)
             self._committed.add(int(step))
             self._verified.discard(int(step))   # content just changed
             self._verify_failed.discard(int(step))
@@ -360,6 +445,10 @@ class CheckpointManager:
         a torn checkpoint can never become the rollback anchor; raises
         :class:`CorruptCheckpoint` on failure.  Returns the step, or
         None when there is nothing committed."""
+        # promoting the step an in-flight save_async is still writing
+        # must wait for its commit — otherwise the dir does not exist
+        # yet and the promotion silently returns None
+        self.wait_pending()
         if step is None:
             step = self.latest_step()
         if step is None:
@@ -385,13 +474,23 @@ class CheckpointManager:
         _profiler.runtime_metrics.inc("ckpt.marked_good")
         return step
 
-    def restore_last_good(self, shardings=None):
+    def restore_last_good(self, shardings=None, mesh=None):
         """Restore the last known-good checkpoint (params + datapipe
         position) — the rollback rung of the sentinel's escalation
         ladder.  A corrupt/vanished known-good is quarantined and the
         restore falls back to :meth:`restore_latest` (newest verifiable
-        wins).  Returns the restored step or None."""
+        wins).  Returns the restored step or None.
+
+        ``mesh``: the mesh the restoring run trains on — it may DIFFER
+        from the mesh that saved (elastic resume): shard-format
+        checkpoints are re-sliced onto it after the restore plan
+        verifies statically, and the datapipe position is repositioned
+        consistently with the new sharding degree (the stride sources
+        remap their saved offsets; see ``datapipe.sources.Source``).
+        Defaults to the manager's own ``mesh``."""
         from paddle_tpu import io
+        self.wait_pending()
+        mesh = mesh if mesh is not None else self.mesh
         step = self.last_good_step()
         if step is not None:
             path = self.path(step)
@@ -405,11 +504,11 @@ class CheckpointManager:
                 os.remove(self._good_pointer())
             except OSError:
                 pass
-            return self.restore_latest(shardings=shardings)
+            return self.restore_latest(shardings=shardings, mesh=mesh)
         got = io.load_checkpoint(self.executor, self.dirname,
                                  main_program=self.main_program,
                                  step=step, scope=self.scope,
-                                 shardings=shardings)
+                                 shardings=shardings, mesh=mesh)
         io._write_latest(self.dirname, step)
         self._restore_datapipe(step)
         return got
@@ -418,13 +517,17 @@ class CheckpointManager:
     def verify(self, step):
         return verify_checkpoint(self.path(step))
 
-    def restore(self, step, shardings=None):
-        """Verify + restore one specific step (no fallback)."""
+    def restore(self, step, shardings=None, mesh=None):
+        """Verify + restore one specific step (no fallback); ``mesh``
+        as in :meth:`restore_last_good` (elastic resume)."""
         from paddle_tpu import io
+        self.wait_pending()
         verify_checkpoint(self.path(step))
         got = io.load_checkpoint(self.executor, self.dirname,
                                  main_program=self.main_program, step=step,
-                                 scope=self.scope, shardings=shardings)
+                                 scope=self.scope, shardings=shardings,
+                                 mesh=mesh if mesh is not None
+                                 else self.mesh)
         self._restore_datapipe(step)
         return got
 
@@ -440,19 +543,31 @@ class CheckpointManager:
             return False
         p = os.path.join(self.path(step), _datapipe_state_name())
         if not os.path.exists(p):
-            # legacy / topology-changed fallback: the unsuffixed name
-            p = os.path.join(self.path(step), DATAPIPE_STATE_NAME)
-            if not os.path.exists(p):
+            # topology-changed fallback: a shrink/grow restore may not
+            # find this host's own sidecar — rank 0's position (all
+            # ranks checkpoint at the same step boundary, so their
+            # strides agree) or the unsuffixed single-host legacy name
+            # still repositions exactly; the stride sources remap the
+            # offsets to the restoring degree on load
+            for cand in (_datapipe_state_name(rank=0, processes=1),
+                         _datapipe_state_name(rank=0, processes=2)):
+                p = os.path.join(self.path(step), cand)
+                if os.path.exists(p):
+                    break
+            else:
                 return False
         with open(p, "rb") as f:
             self.datapipe.load_state_dict(pickle.load(f))
         self.last_restore_rewound = True
         return True
 
-    def restore_latest(self, shardings=None):
+    def restore_latest(self, shardings=None, mesh=None):
         """Restore the newest restorable checkpoint; returns its step or
-        None.  Corrupt/partial candidates are quarantined and skipped."""
+        None.  Corrupt/partial candidates are quarantined and skipped.
+        ``mesh`` as in :meth:`restore_last_good` (elastic resume)."""
         from paddle_tpu import io
+        self.wait_pending()
+        mesh = mesh if mesh is not None else self.mesh
         for step in reversed(self.steps()):
             path = self.path(step)
             if os.path.exists(os.path.join(path, MANIFEST_NAME)):
@@ -473,7 +588,8 @@ class CheckpointManager:
                     got = io.load_checkpoint(
                         self.executor, self.dirname,
                         main_program=self.main_program, step=step,
-                        scope=self.scope, shardings=shardings)
+                        scope=self.scope, shardings=shardings,
+                        mesh=mesh)
                 except Exception:
                     continue
                 io._write_latest(self.dirname, step)
@@ -482,7 +598,7 @@ class CheckpointManager:
             got = io.load_checkpoint(
                 self.executor, self.dirname,
                 main_program=self.main_program, step=step,
-                scope=self.scope, shardings=shardings)
+                scope=self.scope, shardings=shardings, mesh=mesh)
             # re-point ``latest`` in case it referenced a checkpoint we
             # just quarantined (load_checkpoint(step=None) keeps working)
             io._write_latest(self.dirname, step)

@@ -1,0 +1,317 @@
+"""Per-shard parallel checkpoint format + mesh-elastic restore planning.
+
+The orbax whole-state path (``io.save_checkpoint``'s default) writes one
+opaque blob per checkpoint; this module is the *elastic* format: every
+persistable tensor is written as one file per owned mesh shard
+(``shards/<var>/shard-<k>-of-<N>``), concurrently, and the manifest
+gains a **topology record** — mesh shape, axis names, and a per-var
+shard→rank map — so a later boot can read the checkpoint's geometry
+without loading a single tensor, prove a restore plan against a
+*different* mesh (dp4 → dp2, or dp2 → dp8), and only then touch data.
+
+The commit discipline is unchanged: shards land in the ``.tmp-`` dir
+and ride the existing manifest → fsync → rename atomic commit
+(``fault.checkpoint.commit_checkpoint``), with each shard file
+individually SHA-256'd in the manifest.  The ``ckpt.shard.write``
+failpoint fires before every shard write — a kill there leaves only the
+temp dir, so the previous committed checkpoint stays the restore
+target.  ``ckpt.reshard`` fires at the head of restore *planning* — an
+error there surfaces as a clean, retryable :class:`ReshardError` before
+the scope is touched.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from paddle_tpu.fault import chaos
+
+__all__ = ["ReshardError", "SHARD_DIR", "TOPOLOGY_FORMAT",
+           "build_topology", "write_state", "read_state", "plan_restore",
+           "validate_topology", "shard_relpath", "owner_process",
+           "read_manifest"]
+
+SHARD_DIR = "shards"
+TOPOLOGY_FORMAT = 1
+
+
+class ReshardError(RuntimeError):
+    """A restore plan cannot map the saved topology onto the target
+    mesh.  Raised during *planning*, before any tensor is read or any
+    scope entry mutated — the failure is clean and retryable (fix the
+    mesh, or restore onto the saved geometry)."""
+
+    retryable = True
+
+
+def _quote(name):
+    return name.replace("/", "%2F")
+
+
+def shard_relpath(name, k, n):
+    """Checkpoint-relative path of shard ``k`` of ``n`` of ``name``."""
+    return os.path.join(SHARD_DIR, _quote(name), f"shard-{k}-of-{n}")
+
+
+def owner_process(rank, num_shards, processes):
+    """Host owning dp rank ``rank``'s shard: ranks are block-assigned to
+    processes (contiguous device blocks per host on TPU meshes)."""
+    return rank * processes // num_shards
+
+
+def _shard_axis(spec):
+    """(axis_index, axis_name) of the first sharded dim, or (None, None)
+    for a replicated placement."""
+    for d, ax in enumerate(spec or ()):
+        if ax is not None:
+            return d, ax
+    return None, None
+
+
+def build_topology(mesh, state, shard_specs=None):
+    """The manifest topology record for ``state`` (name -> host array)
+    saved on ``mesh``.  ``shard_specs`` maps names to placement tuples
+    (e.g. a :meth:`ZeroPlan.checkpoint_specs` dict); unlisted vars are
+    recorded replicated (one shard)."""
+    import jax
+    shard_specs = shard_specs or {}
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    shards = {}
+    for name in sorted(state):
+        value = state[name]
+        # shape/dtype only — materializing the tensor here would pull
+        # the whole payload device->host a second time (write_state
+        # does the one real copy)
+        shape = tuple(int(d) for d in value.shape)
+        spec = tuple(shard_specs.get(name) or ())
+        axis, axis_name = _shard_axis(spec)
+        n = int(axis_sizes.get(axis_name, 1)) if axis_name else 1
+        if axis is None or n <= 1 or len(shape) <= axis or \
+                shape[axis] % n != 0:
+            spec, axis, n = (), None, 1
+        shards[name] = {
+            "shape": list(shape),
+            "dtype": str(value.dtype),
+            "spec": [a if a is None else str(a) for a in spec],
+            "axis": axis,
+            "num_shards": n,
+            "shard_ranks": list(range(n)),
+        }
+    return {
+        "format": TOPOLOGY_FORMAT,
+        "mesh_shape": [int(d) for d in mesh.devices.shape],
+        "axis_names": [str(a) for a in mesh.axis_names],
+        "processes": int(jax.process_count()),
+        "shards": shards,
+    }
+
+
+def _write_one(path, piece, name, k, n, step):
+    chaos.fire("ckpt.shard.write", var=name, shard=k, step=step)
+    from paddle_tpu import profiler as _profiler
+    t0 = time.perf_counter()
+    with open(path, "wb") as f:
+        np.save(f, piece, allow_pickle=False)
+        f.flush()
+        os.fsync(f.fileno())
+    dt = time.perf_counter() - t0
+    _profiler.runtime_metrics.inc("ckpt.shard.writes")
+    _profiler.runtime_metrics.inc("ckpt.shard.bytes", piece.nbytes)
+    _profiler.runtime_metrics.observe("ckpt.shard.write_seconds", dt)
+    from paddle_tpu.obs.trace import record_span
+    record_span("ckpt.shard.write", t0, dt, var=name, shard=k, of=n)
+
+
+def write_state(tmp_path, state, topology, step=None, max_workers=None):
+    """Write this host's owned shards of ``state`` under
+    ``tmp_path/shards/`` — one file per shard, written concurrently.
+    Replicated vars are written by the coordinator host only."""
+    import jax
+    proc, procs = jax.process_index(), int(topology["processes"])
+    jobs = []
+    for name, rec in topology["shards"].items():
+        arr = np.asarray(state[name])
+        n, axis = rec["num_shards"], rec["axis"]
+        for k in rec["shard_ranks"]:
+            if owner_process(k, max(n, 1), procs) != proc:
+                continue
+            if axis is None:
+                piece = arr
+            else:
+                size = arr.shape[axis] // n
+                sl = [slice(None)] * arr.ndim
+                sl[axis] = slice(k * size, (k + 1) * size)
+                piece = arr[tuple(sl)]
+            path = os.path.join(tmp_path, shard_relpath(name, k, n))
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            jobs.append((path, piece, name, k, n))
+    if not jobs:
+        return 0
+    workers = max_workers or min(8, len(jobs), os.cpu_count() or 1)
+    if workers <= 1:
+        for path, piece, name, k, n in jobs:
+            _write_one(path, piece, name, k, n, step)
+    else:
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            futs = [pool.submit(_write_one, path, piece, name, k, n,
+                                step)
+                    for path, piece, name, k, n in jobs]
+            for f in futs:
+                f.result()   # surface the first failure (incl. chaos)
+    return len(jobs)
+
+
+def read_state(path, topology, names=None):
+    """Reassemble host arrays from a committed shard checkpoint:
+    shards of each var concatenated along its saved axis.  Reads only —
+    callers commit to the scope after EVERY var loaded cleanly."""
+    out = {}
+    for name, rec in topology["shards"].items():
+        if names is not None and name not in names:
+            continue
+        n, axis = rec["num_shards"], rec["axis"]
+        pieces = [np.load(os.path.join(path, shard_relpath(name, k, n)),
+                          allow_pickle=False)
+                  for k in rec["shard_ranks"]]
+        arr = pieces[0] if axis is None else np.concatenate(pieces,
+                                                            axis=axis)
+        want = tuple(rec["shape"])
+        if arr.shape != want:
+            raise ReshardError(
+                f"checkpoint var `{name}` reassembles to {arr.shape} "
+                f"but the topology declares {want}")
+        out[name] = arr
+    return out
+
+
+def validate_topology(manifest):
+    """Self-consistency problems of a manifest's topology record, as a
+    list of strings (empty = consistent).  Cross-checks the record
+    against the manifest's own file table: every declared shard file
+    must be checksummed, shard counts must match the saved mesh axis
+    they ride, and shapes must slice evenly."""
+    problems = []
+    topo = manifest.get("topology")
+    if not isinstance(topo, dict):
+        return ["manifest has no topology record"]
+    if topo.get("format") != TOPOLOGY_FORMAT:
+        problems.append(f"topology format must be {TOPOLOGY_FORMAT}, "
+                        f"got {topo.get('format')!r}")
+    mesh_shape = topo.get("mesh_shape")
+    axis_names = topo.get("axis_names")
+    if not isinstance(mesh_shape, list) or not mesh_shape or \
+            not all(isinstance(d, int) and d > 0 for d in mesh_shape):
+        problems.append(f"mesh_shape must be positive ints, "
+                        f"got {mesh_shape!r}")
+        mesh_shape = []
+    if not isinstance(axis_names, list) or \
+            len(axis_names) != len(mesh_shape):
+        problems.append(f"axis_names {axis_names!r} do not label "
+                        f"mesh_shape {mesh_shape!r}")
+        axis_names = []
+    axis_sizes = dict(zip(axis_names, mesh_shape))
+    files = manifest.get("files", {})
+    shards = topo.get("shards")
+    if not isinstance(shards, dict):
+        return problems + ["topology.shards must be an object"]
+    for name, rec in sorted(shards.items()):
+        where = f"shards[{name!r}]"
+        n = rec.get("num_shards")
+        axis = rec.get("axis")
+        shape = rec.get("shape") or []
+        spec = rec.get("spec") or []
+        if not isinstance(n, int) or n < 1:
+            problems.append(f"{where}: bad num_shards {n!r}")
+            continue
+        if rec.get("shard_ranks") != list(range(n)):
+            problems.append(f"{where}: shard_ranks must be "
+                            f"0..{n - 1}, got {rec.get('shard_ranks')!r}")
+        if axis is not None:
+            if not isinstance(axis, int) or not \
+                    (0 <= axis < len(shape)):
+                problems.append(f"{where}: axis {axis!r} out of range "
+                                f"for shape {shape}")
+            elif shape[axis] % n != 0:
+                problems.append(f"{where}: dim {axis} of {shape[axis]} "
+                                f"does not slice into {n} shards")
+            _, axis_name = _shard_axis(spec)
+            if axis_name is not None and \
+                    axis_sizes.get(axis_name) not in (None, n):
+                problems.append(
+                    f"{where}: {n} shards ride mesh axis "
+                    f"`{axis_name}` of size {axis_sizes[axis_name]}")
+        elif n != 1:
+            problems.append(f"{where}: replicated var with {n} shards")
+        for k in range(n):
+            rel = shard_relpath(name, k, n)
+            if rel not in files:
+                problems.append(f"{where}: shard file {rel!r} missing "
+                                f"from the manifest file table")
+    # the reverse direction: a shard file the topology does not declare
+    declared = {shard_relpath(name, k, rec["num_shards"])
+                for name, rec in shards.items()
+                if isinstance(rec.get("num_shards"), int)
+                for k in range(max(rec["num_shards"], 0))}
+    for rel in files:
+        if rel.startswith(SHARD_DIR + os.sep) and rel not in declared:
+            problems.append(f"undeclared shard file {rel!r}")
+    return problems
+
+
+def plan_restore(topology, mesh):
+    """Map a saved topology onto ``mesh``: the *restore plan* — name ->
+    target placement tuple — statically verified against the new mesh
+    (axis exists, dims divide) BEFORE any shard is read or any device
+    allocated.  Raises :class:`ReshardError` with every violation when
+    the plan is unprovable; the scope is untouched.
+
+    The verification rides the same facts the PTA016 pass checks
+    (``analysis.distributed._validate_spec``): an elastic restore is a
+    sharding plan like any other, and it gets the same static proof.
+    """
+    chaos.fire("ckpt.reshard", mesh_shape=list(mesh.devices.shape))
+    from paddle_tpu.analysis.distributed import _validate_spec
+    axis_sizes = {str(a): int(s) for a, s in
+                  zip(mesh.axis_names, mesh.devices.shape)}
+    plan = {}
+    diags = []
+    resliced = 0
+    for name, rec in sorted(topology["shards"].items()):
+        spec = tuple(a if a is None else str(a)
+                     for a in rec.get("spec") or ())
+        shape = tuple(int(d) for d in rec.get("shape") or ())
+        _validate_spec(name, spec, shape, axis_sizes, diags,
+                       program="restore-plan")
+        plan[name] = spec
+        _, axis_name = _shard_axis(spec)
+        if axis_name is not None and \
+                axis_sizes.get(axis_name) != rec.get("num_shards"):
+            resliced += 1
+    if diags:
+        raise ReshardError(
+            "restore plan does not map the saved topology "
+            f"(mesh {topology.get('mesh_shape')} "
+            f"{topology.get('axis_names')}) onto the target mesh "
+            f"({list(mesh.devices.shape)} {list(mesh.axis_names)}):\n"
+            + "\n".join(d.format() for d in diags))
+    from paddle_tpu import profiler as _profiler
+    _profiler.runtime_metrics.inc("reshard.plans")
+    _profiler.runtime_metrics.inc("reshard.vars", resliced)
+    return plan
+
+
+def read_manifest(path):
+    """The committed manifest of checkpoint dir ``path`` (or None when
+    absent/unreadable) — the cheap format probe restore uses to pick
+    the shard path over the orbax path."""
+    from paddle_tpu.fault.checkpoint import MANIFEST_NAME
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

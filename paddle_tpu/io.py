@@ -262,8 +262,31 @@ def _write_latest(dirname, step):
     atomic_write(os.path.join(dirname, "latest"), str(int(step)))
 
 
+def snapshot_state(main_program=None, scope=None):
+    """The persistable state a checkpoint captures, as a name -> array
+    dict.  Values are the scope's live arrays (jax arrays are
+    immutable; the executor REPLACES scope entries rather than mutating
+    them), so the snapshot is a consistent point-in-time view that an
+    async writer can serialize off the step path."""
+    from paddle_tpu.framework import default_main_program
+    from paddle_tpu.scope import global_scope
+
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    state = {}
+    for var in main_program.global_block().vars.values():
+        if not is_persistable(var):
+            continue
+        v = scope.find_var(var.name)
+        if v is None or not hasattr(v, "dtype"):
+            continue
+        state[var.name] = v
+    return state
+
+
 def save_checkpoint(executor, dirname, main_program=None, step=0,
-                    scope=None, extras=None):
+                    scope=None, extras=None, mesh=None, shard_specs=None,
+                    state=None):
     """Save ALL persistable state (params + optimizer accumulators) plus
     metadata; sharded arrays are written shard-by-shard (orbax).
 
@@ -279,27 +302,26 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
     as the tensors.  EVERY host writes its own extras (names must be
     per-host unique in multi-host runs — each trainer's input-shard
     position is host-local state); a barrier then orders those writes
-    before the coordinator's manifest walk."""
+    before the coordinator's manifest walk.
+
+    ``mesh``: switches to the ELASTIC per-shard format
+    (``fault.shard_ckpt``): each var becomes one file per mesh shard
+    (written concurrently, each host its owned shards), and the
+    manifest gains a topology record so restore can re-map the
+    checkpoint onto a *different* mesh.  ``shard_specs`` (name ->
+    placement tuple, e.g. ``ZeroPlan.checkpoint_specs()``) names the
+    vars partitioned over the mesh; everything else writes replicated.
+    ``state``: a pre-snapshotted :func:`snapshot_state` dict — the
+    async-save path captures it on the step path and writes later."""
     import shutil
 
-    import orbax.checkpoint as ocp
     import jax
 
     from paddle_tpu.fault import chaos
     from paddle_tpu.fault.checkpoint import commit_checkpoint
-    from paddle_tpu.framework import default_main_program
-    from paddle_tpu.scope import global_scope
 
-    main_program = main_program or default_main_program()
-    scope = scope or global_scope()
-    state = {}
-    for var in main_program.global_block().vars.values():
-        if not is_persistable(var):
-            continue
-        v = scope.find_var(var.name)
-        if v is None or not hasattr(v, "dtype"):
-            continue
-        state[var.name] = v
+    if state is None:
+        state = snapshot_state(main_program, scope)
     os.makedirs(dirname, exist_ok=True)
     path = os.path.abspath(os.path.join(dirname, f"ckpt-{int(step)}"))
     # the temp path must be IDENTICAL on every host: orbax coordinates a
@@ -312,10 +334,20 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
         shutil.rmtree(tmp)
     chaos.fire("ckpt.save", step=step)
     from paddle_tpu.obs.trace import span as _span
+    commit_extra = None
     with _span("ckpt.write", step=int(step), vars=len(state)):
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(tmp, state, force=True)
-        ckptr.wait_until_finished()
+        if mesh is not None:
+            from paddle_tpu.fault import shard_ckpt
+            os.makedirs(tmp, exist_ok=True)
+            topology = shard_ckpt.build_topology(mesh, state,
+                                                 shard_specs)
+            shard_ckpt.write_state(tmp, state, topology, step=int(step))
+            commit_extra = {"topology": topology}
+        else:
+            import orbax.checkpoint as ocp
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(tmp, state, force=True)
+            ckptr.wait_until_finished()
         for name, blob in (extras or {}).items():
             with open(os.path.join(tmp, name), "wb") as f:
                 f.write(blob)
@@ -331,7 +363,8 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
     commit_error = None
     if jax.process_index() == 0:
         try:
-            commit_checkpoint(tmp, path, step=int(step))
+            commit_checkpoint(tmp, path, step=int(step),
+                              extra=commit_extra)
             _write_latest(dirname, step)
         except BaseException as e:
             commit_error = e
@@ -354,10 +387,20 @@ def save_checkpoint(executor, dirname, main_program=None, step=0,
 
 
 def load_checkpoint(executor, dirname, main_program=None, step=None,
-                    scope=None, shardings=None):
+                    scope=None, shardings=None, mesh=None):
     """Restore a checkpoint into the scope.  ``shardings``: optional map
     name -> jax.sharding.Sharding to restore arrays SHARDED onto a mesh
-    (TP-aware resume); unlisted arrays load replicated/host-local."""
+    (TP-aware resume); unlisted arrays load replicated/host-local.
+
+    ``mesh``: the mesh the RESTORING run trains on.  For a shard-format
+    checkpoint (manifest topology record) this is the elastic-resume
+    path: ``fault.shard_ckpt.plan_restore`` maps the saved topology
+    onto ``mesh`` — and statically verifies the plan — before any shard
+    is read or device allocated, saved shards are re-sliced onto the
+    new degree (a dp4 checkpoint restores on dp2, or dp8), and every
+    array is placed with its planned ``NamedSharding``.  The scope is
+    only mutated after EVERY var loaded cleanly — a failed restore
+    leaves no half-restored state behind."""
     import orbax.checkpoint as ocp
     import jax
 
@@ -372,6 +415,30 @@ def load_checkpoint(executor, dirname, main_program=None, step=None,
         with open(os.path.join(dirname, "latest")) as f:
             step = int(f.read().strip())
     path = os.path.abspath(os.path.join(dirname, f"ckpt-{int(step)}"))
+    from paddle_tpu.fault import shard_ckpt
+    manifest = shard_ckpt.read_manifest(path)
+    topology = (manifest or {}).get("topology")
+    if topology is not None:
+        # elastic shard format: plan (and prove) BEFORE touching data
+        plan = shard_ckpt.plan_restore(
+            topology, mesh) if mesh is not None else None
+        chaos.fire("ckpt.restore", step=int(step))
+        state = shard_ckpt.read_state(path, topology)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            placed = {}
+            for name, arr in state.items():
+                spec = plan.get(name) or ()
+                placed[name] = jax.device_put(
+                    arr, NamedSharding(mesh, P(*spec)))
+            state = placed
+        elif shardings:
+            state = {name: (jax.device_put(arr, shardings[name])
+                            if name in shardings else arr)
+                     for name, arr in state.items()}
+        for name, value in state.items():
+            scope.set_var(name, value)
+        return int(step)
     # the restore boundary: a kill here (crash mid-rollback) must leave
     # the directory restorable by the next boot — restores never mutate
     # committed checkpoints, so the drill validates exactly that

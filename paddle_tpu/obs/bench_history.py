@@ -58,6 +58,9 @@ BENCH_METRICS = {
                "tokens_per_sec_ratio": ("higher", 0.25),
                "ttft_p99_ms": ("lower", 0.75),
                "lost_requests": ("max_abs", 0.0)},
+    "elastic": {"resume_seconds": ("lower", 1.00),
+                "loss_delta_rel": ("max_abs", 1e-3),
+                "reshard_failures": ("max_abs", 0.0)},
     "train_transformer": {"tokens_per_sec_per_chip": ("higher", 0.10),
                           "mfu": ("higher", 0.05)},
 }
@@ -213,8 +216,13 @@ def summary_metrics(bench, summary):
                 "tokens_per_sec_ratio": summary["tokens_per_sec_ratio"],
                 "ttft_p99_ms": summary["ttft_p99_ms"]["continuous"],
                 "lost_requests": cont["failures"]}
+    if bench == "elastic":
+        return {"resume_seconds": summary["resume"]["restore_seconds"],
+                "loss_delta_rel": summary["loss_delta_rel"],
+                "reshard_failures": summary["reshard_failures"]}
     raise ValueError(f"no trajectory extraction for bench {bench!r} "
-                     f"(known: serving, datapipe, fleet, decode)")
+                     f"(known: serving, datapipe, fleet, decode, "
+                     f"elastic)")
 
 
 def add_record_args(parser):

@@ -26,9 +26,10 @@ from paddle_tpu.parallel.ring_attention import ring_attention
 from paddle_tpu.parallel.master import MasterService, partition_files
 from paddle_tpu.parallel.distributed import (init_parallel_env, get_rank,
                                              get_world_size, global_mesh)
+from paddle_tpu.parallel.zero import ZeroPlan, zero_plan
 
 __all__ = ["ParallelExecutor", "default_mesh", "make_mesh", "device_count",
            "set_default_mesh", "DistributeTranspiler", "DistributedSpec",
            "collective", "ring_attention", "MasterService",
            "partition_files", "init_parallel_env", "get_rank",
-           "get_world_size", "global_mesh"]
+           "get_world_size", "global_mesh", "ZeroPlan", "zero_plan"]

@@ -45,7 +45,7 @@ __all__ = ["ParallelExecutor"]
 class ParallelExecutor(Executor):
     def __init__(self, use_cuda=True, loss_name=None, main_program=None,
                  share_vars_from=None, num_threads=None, mesh=None,
-                 batch_axis=0, param_shardings=None):
+                 batch_axis=0, param_shardings=None, zero=False):
         super().__init__()
         self.mesh = mesh if mesh is not None else default_mesh()
         self.loss_name = loss_name
@@ -54,6 +54,25 @@ class ParallelExecutor(Executor):
         # [(compiled regex, PartitionSpec)] — first match wins
         self.param_shardings = [(re.compile(pat), spec)
                                 for pat, spec in (param_shardings or [])]
+        # ZeRO optimizer-state sharding: partition the accumulators over
+        # the data axis (params stay replicated).  The plan is emitted
+        # as IR-level sharding facts and PROVED by the PTA016/PTA017
+        # pass here — before anything compiles, let alone runs.  User
+        # param_shardings rules keep precedence (first match wins), so
+        # TP-ruled state never double-shards.
+        self.zero_plan = None
+        if zero:
+            from paddle_tpu.parallel.zero import zero_plan
+            axis = zero if isinstance(zero, str) else DATA_AXIS
+            program = main_program or default_main_program()
+            skip = (lambda name: any(pat.search(name) for pat, _ in
+                                     self.param_shardings)) \
+                if self.param_shardings else None
+            plan = zero_plan(program, self.mesh, axis=axis, skip=skip)
+            plan.verify()
+            self.zero_plan = plan
+            self.param_shardings += [(re.compile(pat), spec)
+                                     for pat, spec in plan.rules()]
         if share_vars_from is not None:
             pass  # scope is global; parity no-op
 

@@ -341,6 +341,57 @@ class TestShardingPropagation:
             mesh_axes={"model": 2, "data": 2})
         assert "PTA016" in {d.code for d in diags}
 
+    def test_inconsistent_optimizer_state_is_pta016(self):
+        """ZeRO discipline: moment1 sharded + moment2 replicated on one
+        adam update is a provably broken state plan."""
+        p, b = _prog()
+        b.create_parameter(shape=(8, 4), dtype="float32", name="w")
+        for name in ("g", "m1", "m2"):
+            b.create_var(name=name, shape=(8, 4), dtype="float32",
+                         is_data=True)
+        for name in ("lr", "b1p", "b2p"):
+            b.create_var(name=name, shape=(1,), dtype="float32",
+                         is_data=True)
+        b.append_op(type="adam",
+                    inputs={"Param": ["w"], "Grad": ["g"],
+                            "LearningRate": ["lr"],
+                            "Moment1": ["m1"], "Moment2": ["m2"],
+                            "Beta1Pow": ["b1p"], "Beta2Pow": ["b2p"]},
+                    outputs={"ParamOut": ["w"], "Moment1Out": ["m1"],
+                             "Moment2Out": ["m2"], "Beta1PowOut": ["b1p"],
+                             "Beta2PowOut": ["b2p"]})
+        diags = D.check_sharding(
+            p, {"m1": ("data", None), "m2": ()},
+            mesh_axes={"data": 2})
+        assert any(d.code == "PTA016" and "inconsistently" in d.message
+                   for d in diags), [d.format() for d in diags]
+
+    def test_zero_shape_state_plan_is_silent(self):
+        """The INTENDED ZeRO shape — params/grads replicated, every
+        state slot sharded the same way — must verify clean (zero
+        false positives)."""
+        p, b = _prog()
+        b.create_parameter(shape=(8, 4), dtype="float32", name="w")
+        for name in ("g", "m1", "m2"):
+            b.create_var(name=name, shape=(8, 4), dtype="float32",
+                         is_data=True)
+        for name in ("lr", "b1p", "b2p"):
+            b.create_var(name=name, shape=(1,), dtype="float32",
+                         is_data=True)
+        b.append_op(type="adam",
+                    inputs={"Param": ["w"], "Grad": ["g"],
+                            "LearningRate": ["lr"],
+                            "Moment1": ["m1"], "Moment2": ["m2"],
+                            "Beta1Pow": ["b1p"], "Beta2Pow": ["b2p"]},
+                    outputs={"ParamOut": ["w"], "Moment1Out": ["m1"],
+                             "Moment2Out": ["m2"], "Beta1PowOut": ["b1p"],
+                             "Beta2PowOut": ["b2p"]})
+        diags = D.check_sharding(
+            p, {"w": (), "g": (), "m1": ("data", None),
+                "m2": ("data", None)},
+            mesh_axes={"data": 2})
+        assert not diags, [d.format() for d in diags]
+
     def test_replicated_everything_is_silent(self):
         p, b = _prog()
         b.create_parameter(shape=(8, 4), dtype="float32", name="w")

@@ -1,0 +1,68 @@
+"""bench_elastic smoke: the kill→shrink→resume drill must complete
+with the resumed (dp2) run reaching the uninterrupted (dp4) run's final
+loss, exactly-once over the batch stream, zero reshard failures — and
+the JSON summary must keep its schema (BENCH_ELASTIC.json records the
+full acceptance run; the trajectory gate guards resume wall-time)."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+import bench_elastic  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def smoke_summary():
+    return bench_elastic.run_bench(smoke=True, kill_after=3)
+
+
+def test_summary_schema(smoke_summary):
+    assert {"workload", "smoke", "reference", "killed", "resume",
+            "loss_delta_rel", "reshard_failures",
+            "exactly_once"} <= set(smoke_summary)
+    assert {"dp_from", "dp_to", "steps",
+            "kill_after"} <= set(smoke_summary["workload"])
+    assert smoke_summary["resume"]["restore_seconds"] > 0
+
+
+def test_killed_run_really_died(smoke_summary):
+    assert smoke_summary["killed"]["exit_code"] == \
+        bench_elastic.KILL_EXIT_CODE
+
+
+def test_resume_shrinks_the_mesh(smoke_summary):
+    assert smoke_summary["reference"]["dp"] == \
+        smoke_summary["workload"]["dp_from"]
+    assert smoke_summary["resume"]["dp"] == \
+        smoke_summary["workload"]["dp_to"]
+    assert smoke_summary["resume"]["resumed_from"] == \
+        smoke_summary["workload"]["kill_after"]
+
+
+def test_exactly_once_and_loss_match(smoke_summary):
+    assert smoke_summary["exactly_once"]
+    assert smoke_summary["reshard_failures"] == 0
+    assert smoke_summary["loss_delta_rel"] < 1e-4, smoke_summary
+
+
+def test_trajectory_extraction(smoke_summary):
+    from paddle_tpu.obs import bench_history
+    metrics = bench_history.summary_metrics("elastic", smoke_summary)
+    assert set(metrics) == set(bench_history.BENCH_METRICS["elastic"])
+    assert metrics["reshard_failures"] == 0
+
+
+def test_record_and_check_gate(smoke_summary, tmp_path):
+    """record → check exits green; a degraded resume time exits 1."""
+    from paddle_tpu.obs import bench_history
+    path = str(tmp_path / "traj.json")
+    metrics = bench_history.summary_metrics("elastic", smoke_summary)
+    bench_history.record("elastic", metrics, path=path, baseline=True)
+    assert bench_history.check(path=path)["ok"]
+    worse = dict(metrics, resume_seconds=metrics["resume_seconds"] * 10,
+                 reshard_failures=1)
+    bench_history.record("elastic", worse, path=path)
+    report = bench_history.check(path=path)
+    assert not report["ok"]
