@@ -24,20 +24,16 @@ import numpy as np
 
 
 def peak_flops_per_chip():
-    """Best-effort peak (bf16) FLOP/s for the local accelerator."""
-    import jax
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "cpu").lower()
-    table = {
-        "v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12,
-        "v4": 275e12, "v3": 123e12, "v2": 45e12, "v6e": 918e12,
-    }
-    for k, v in table.items():
-        if k in kind:
-            return v
-    if "tpu" in kind or "axon" in kind:
-        return 197e12
-    return 1e12  # CPU fallback; MFU number will be meaningless but finite
+    """Best-effort peak (bf16) FLOP/s for the local accelerator.
+
+    Lives in the library now (``paddle_tpu.obs.perf`` — the live
+    ``train.mfu`` gauge and this bench must share one denominator);
+    kept here as a delegate for the sibling bench scripts.  The CPU
+    fallback value is finite but meaningless — every recorded run is
+    tagged with its ``mfu_basis`` and ``bench check`` refuses to
+    compare records across bases."""
+    from paddle_tpu.obs.perf import peak_flops_per_chip as _peak
+    return _peak()
 
 
 def measure_trials(run_once, n_trials=None):
@@ -66,7 +62,9 @@ def measure_trials(run_once, n_trials=None):
 
 
 def main():
+    import argparse
     import os
+
     model = os.environ.get("PADDLE_TPU_BENCH_MODEL", "transformer") \
         or "transformer"
     if model != "transformer":
@@ -79,6 +77,11 @@ def main():
                 f"transformer, {', '.join(modules)}")
         importlib.import_module(modules[model]).main()
         return
+    from paddle_tpu.obs import bench_history
+    parser = argparse.ArgumentParser(description="transformer training "
+                                                 "throughput bench")
+    bench_history.add_record_args(parser)
+    args, _unknown = parser.parse_known_args()
     import jax
     # optional precision override (measured per-chip; f32 already uses the
     # MXU via bf16 passes on TPU)
@@ -191,21 +194,24 @@ def main():
     tokens = batch * seq * steps  # target-side tokens, the NMT convention
     tokens_per_sec = tokens / dt
 
-    # FLOPs/token (honest accounting):
-    #  * 6*N_matmul — fwd (2N) + bwd (4N) for every parameter that is a
-    #    matmul operand.  Input embeddings are EXCLUDED (gather/scatter,
-    #    not matmul); the output projection is included.  With
-    #    src_len == trg_len, each counted (target) token pairs with one
-    #    source token, so encoder work per counted token is the full
-    #    encoder stack — 6*N over enc+dec params is exact.
-    #  * attention: 3 modules/layer (enc-self per src token, dec-self and
-    #    cross per trg token).  Each is QK^T + AV = 2 matmuls of
-    #    2*S*d_model FLOPs/token fwd; bwd is 2x fwd => 12*S*d per module.
+    # FLOPs/token: the analytical 6N-matmul + attention accounting,
+    # shared with the library (models.transformer.train_flops_per_token
+    # — the cross-check test in tests/test_perf.py holds it against the
+    # XLA cost_analysis FLOPs of the compiled step).  With src_len ==
+    # trg_len, each counted (target) token pairs with one source token,
+    # so encoder work per counted token is the full encoder stack.
+    from paddle_tpu.obs import perf as _perf
     n_params = T.param_count(hp)
     n_matmul = T.matmul_param_count(hp)
-    attn_flops = 12 * seq * hp.d_model * (3 * hp.n_layer)
-    flops_per_token = 6 * n_matmul + attn_flops
-    mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+    flops_per_token = T.train_flops_per_token(hp, seq)
+    peak, mfu_basis = _perf.peak_flops_info()
+    mfu = tokens_per_sec * flops_per_token / peak
+    # the DEVICE-side view of the same run: the live gauge derived from
+    # the compiled step's cost-analysis FLOPs, and the compile wall
+    # time this cold process paid (both guarded by `bench check`)
+    from paddle_tpu.profiler import runtime_metrics
+    measured_mfu = runtime_metrics.gauge("train.mfu")
+    compile_seconds = _perf.total_compile_seconds()
 
     print(json.dumps({
         "metric": "transformer_base_tokens_per_sec_per_chip",
@@ -215,10 +221,19 @@ def main():
     }))
     step_mss = ", ".join(f"{t / steps * 1e3:.1f}" for t in trial_dts)
     print(f"# loss={float(np.asarray(loss).reshape(()))}"
-          f" mfu={mfu:.3f} params={n_params / 1e6:.1f}M"
+          f" mfu={mfu:.3f} mfu_basis={mfu_basis}"
+          f" measured_mfu={'-' if measured_mfu is None else round(measured_mfu, 4)}"
+          f" compile_s={compile_seconds:.1f}"
+          f" params={n_params / 1e6:.1f}M"
           f" matmul_params={n_matmul / 1e6:.1f}M"
           f" step_ms_median={dt / steps * 1e3:.1f}"
           f" trials=[{step_mss}]", file=sys.stderr)
+    summary = {"tokens_per_sec_per_chip": tokens_per_sec, "mfu": mfu,
+               "measured_mfu": measured_mfu,
+               "compile_seconds": compile_seconds}
+    bench_history.record_from_args("train_transformer", summary, args,
+                                   source="bench.py",
+                                   mfu_basis=mfu_basis)
 
 
 if __name__ == "__main__":

@@ -351,6 +351,44 @@ def _check_ckpt_manifest():
                     "round-trip", failures)
 
 
+def _check_perf():
+    """Device-performance gate: a fresh compile of a zoo model must
+    yield a well-formed cost/memory record (flops, bytes, memory
+    breakdown, phase times all present), and the ``profile compile
+    --json`` schema must validate — so the MFU gauge, the profile CLI,
+    and the bench trajectory's measured_mfu row can't silently lose
+    their data source to a jax API drift."""
+    from paddle_tpu.models import compile_zoo_step
+    from paddle_tpu.obs import perf
+
+    failures = []
+    before = {r["key"] for r in perf.records()}
+    scope = compile_zoo_step("mnist")
+    fresh = [r for r in perf.records() if r["key"] not in before]
+    with_cost = [r for r in fresh if r["flops"]]
+    if not with_cost:
+        failures.append("fresh zoo compile captured no cost record "
+                        "(capture disabled or cost_analysis "
+                        "unavailable?)")
+    for r in with_cost:
+        if r["memory"] is None:
+            failures.append(f"{r['key']}: no memory_analysis breakdown")
+        if any(r["phases"].get(k) is None for k in perf.PHASE_KEYS):
+            failures.append(f"{r['key']}: incomplete compile phases")
+    if with_cost and not any(r["mfu"] for r in with_cost):
+        failures.append("no record derived a live MFU after the step")
+    failures.extend(perf.validate_report(perf.compile_report()))
+    census = perf.hbm_census(scope)
+    if not census.get("params") or not census.get("optimizer"):
+        failures.append(
+            f"hbm census failed to attribute params/optimizer state: "
+            f"{ {k: census.get(k) for k in ('params', 'optimizer')} }")
+    return _section("perf",
+                    "fresh zoo compile -> cost/memory record, "
+                    "profile-compile schema, hbm census attribution",
+                    failures)
+
+
 def _check_bench_trajectory():
     """``bench check --dry`` against the repo's BENCH_TRAJECTORY.json:
     a drifted or malformed trajectory schema fails the static gate (the
@@ -378,5 +416,6 @@ def run_selfcheck():
         _check_slo_spec(),
         _check_bench_trajectory(),
         _check_ckpt_manifest(),
+        _check_perf(),
     ]
     return {"ok": all(s["ok"] for s in sections), "sections": sections}

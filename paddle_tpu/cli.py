@@ -817,14 +817,12 @@ def _cmd_launch(args):
     return rc
 
 
-def _cmd_profile(args):
-    """One compiled training step of a built-in model under the XProf
-    trace; prints the per-IR-op device-time table (the compiled-path
-    analog of the reference's profiler tools, platform/profiler.h)."""
+def _profile_build(args):
+    """Shared model-building head of the ``profile op|step`` modes:
+    returns ``(exe, main_prog, startup, feed, cost_name)``."""
     import numpy as np
 
     import paddle_tpu as fluid
-    from paddle_tpu import profiler
 
     if args.model == "transformer":
         from paddle_tpu.models import transformer as T
@@ -858,10 +856,140 @@ def _cmd_profile(args):
         raise SystemExit(f"unknown --model {args.model!r}")
     exe = fluid.Executor()
     exe.run(startup)
-    exe.run(main_prog, feed=feed, fetch_list=[cost.name])  # compile
+    return exe, main_prog, startup, feed, cost.name
+
+
+def _fmt_bytes(n):
+    return "-" if n is None else f"{n / 1e6:.2f}MB"
+
+
+def _fmt_ms(s):
+    return "-" if s is None else f"{s * 1e3:.1f}ms"
+
+
+def _profile_zoo_compile(args):
+    """Fresh-compile a zoo model (startup + one synthetic train step)
+    so every jit key lands a cost/memory record; returns the scope (for
+    ``profile memory``'s census)."""
+    from paddle_tpu.models import ZOO_MODELS, compile_zoo_step
+
+    name = args.zoo or "mnist"
+    if name not in ZOO_MODELS:
+        raise SystemExit(f"unknown --zoo {name!r}; expected one of "
+                         f"{ZOO_MODELS}")
+    return compile_zoo_step(name)
+
+
+def _cmd_profile_compile(args):
+    """``paddle_tpu profile compile``: fresh-compile a zoo model and
+    print the per-jit-key table — XLA cost-analysis FLOPs and bytes,
+    the memory_analysis breakdown, and the trace/lower/backend phase
+    wall times the compile actually paid."""
+    import json as _json
+
+    from paddle_tpu.obs import perf
+
+    _profile_zoo_compile(args)
+    report = perf.compile_report()
+    if args.json:
+        print(_json.dumps(report, indent=2, sort_keys=True))
+        return 0
+    print(f"backend={report['backend']} "
+          f"peak={report['peak_flops_per_chip']:.3g} FLOP/s "
+          f"({report['mfu_basis']})")
+    print(f"{'key':<10}{'GFLOPs':>10}{'bytes':>12}{'arg':>10}"
+          f"{'out':>10}{'temp':>10}{'trace':>9}{'lower':>9}"
+          f"{'compile':>9}  label")
+    for r in report["records"]:
+        mem = r["memory"] or {}
+        ph = r["phases"]
+        flops = "-" if r["flops"] is None else f"{r['flops'] / 1e9:.3f}"
+        print(f"{r['key']:<10}{flops:>10}"
+              f"{_fmt_bytes(r['bytes_accessed']):>12}"
+              f"{_fmt_bytes(mem.get('argument_bytes')):>10}"
+              f"{_fmt_bytes(mem.get('output_bytes')):>10}"
+              f"{_fmt_bytes(mem.get('temp_bytes')):>10}"
+              f"{_fmt_ms(ph['trace_seconds']):>9}"
+              f"{_fmt_ms(ph['lower_seconds']):>9}"
+              f"{_fmt_ms(ph['backend_seconds']):>9}  {r['label']}")
+    return 0
+
+
+def _cmd_profile_memory(args):
+    """``paddle_tpu profile memory``: the HBM census — live device
+    bytes attributed to params / optimizer state / KV slots / prefetch
+    / other, plus the high watermark and (when the backend or
+    PADDLE_TPU_HBM_LIMIT_BYTES declares a limit) the headroom."""
+    import json as _json
+
+    from paddle_tpu.obs import perf
+
+    scope = _profile_zoo_compile(args)
+    census = perf.hbm_census(scope)
+    if args.json:
+        print(_json.dumps(census, indent=2, sort_keys=True))
+        return 0
+    for key in ("params", "optimizer", "kv_cache", "prefetch", "other",
+                "total", "high_watermark", "limit", "headroom"):
+        if key in census:
+            print(f"hbm.{key:<16}{census[key]:>14} bytes")
+    return 0
+
+
+def _cmd_profile_step(args):
+    """``paddle_tpu profile step``: N measured steps with the per-step
+    breakdown armed (feed / dispatch / device-wait / fetch series) —
+    composed with the jax.profiler plumbing via ``--trace-dir`` for an
+    XProf/Perfetto device timeline of the same window — plus the live
+    MFU the window sustained."""
+    from paddle_tpu import profiler
+    from paddle_tpu.obs import perf
+
+    exe, main_prog, _startup, feed, cost_name = _profile_build(args)
+    exe.run(main_prog, feed=feed, fetch_list=[cost_name])  # compile
+    perf.enable_step_phases()
+    try:
+        if args.trace_dir:
+            profiler.start_profiler(profile_path=args.trace_dir)
+        for _ in range(args.steps):
+            exe.run(main_prog, feed=feed, fetch_list=[cost_name])
+    finally:
+        if args.trace_dir:
+            profiler.stop_profiler()
+        perf.disable_step_phases()
+    m = profiler.runtime_metrics
+    print(f"{'phase':<14}{'p50':>10}{'p95':>10}")
+    for phase in ("feed", "dispatch", "device_wait", "fetch"):
+        p = m.percentiles(f"perf.step.{phase}_seconds", qs=(50, 95))
+        print(f"{phase:<14}{_fmt_ms(p['p50']):>10}"
+              f"{_fmt_ms(p['p95']):>10}")
+    mfu = m.gauge("train.mfu")
+    basis = perf.peak_flops_info()[1]
+    if mfu is not None:
+        print(f"train.mfu={mfu:.4f} ({basis})")
+    if args.trace_dir:
+        print(f"device trace written under {args.trace_dir} "
+              f"(TensorBoard/XProf or Perfetto)")
+    return 0
+
+
+def _cmd_profile(args):
+    """The ``paddle_tpu profile`` family: ``op`` (default) prints the
+    per-IR-op device-time table of a compiled training step; ``compile``
+    the per-jit-key cost/memory/phase table; ``memory`` the HBM census;
+    ``step`` the N-step feed/dispatch/device-wait/fetch breakdown."""
+    if args.action == "compile":
+        return _cmd_profile_compile(args)
+    if args.action == "memory":
+        return _cmd_profile_memory(args)
+    if args.action == "step":
+        return _cmd_profile_step(args)
+    from paddle_tpu import profiler
+    exe, main_prog, _startup, feed, cost_name = _profile_build(args)
+    exe.run(main_prog, feed=feed, fetch_list=[cost_name])  # compile
     with profiler.compiled_profiler(sorted_key=args.sorted_by):
         for _ in range(args.steps):
-            exe.run(main_prog, feed=feed, fetch_list=[cost.name])
+            exe.run(main_prog, feed=feed, fetch_list=[cost_name])
     return 0
 
 
@@ -1149,10 +1277,28 @@ def main(argv=None):
                    help="machine-readable section report")
     p.set_defaults(fn=_cmd_selfcheck)
 
-    p = sub.add_parser("profile", help="per-op device-time table of one "
-                                       "compiled training step")
+    p = sub.add_parser("profile",
+                       help="device-performance profiling family: "
+                            "per-op device time (op), per-jit-key XLA "
+                            "cost/memory + compile phases (compile), "
+                            "HBM census (memory), N-step "
+                            "feed/dispatch/device-wait/fetch breakdown "
+                            "(step)")
+    p.add_argument("action", nargs="?", default="op",
+                   choices=["op", "compile", "memory", "step"],
+                   help="op = per-IR-op device-time table (default); "
+                        "compile = per-jit-key FLOPs/bytes/memory "
+                        "breakdown + trace/lower/compile phase times; "
+                        "memory = live-buffer HBM census by collection; "
+                        "step = per-step phase breakdown (+ --trace-dir "
+                        "for the XProf device timeline)")
     p.add_argument("--model", default="transformer",
-                   choices=["transformer", "resnet"])
+                   choices=["transformer", "resnet"],
+                   help="built-in model for op/step modes")
+    p.add_argument("--zoo", default="mnist",
+                   help="zoo model for compile/memory modes "
+                        "(mnist|resnet|vgg|transformer|seq2seq|"
+                        "stacked_lstm|gen_lm)")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=64,
                    help="sequence length (transformer) or image side "
@@ -1162,6 +1308,11 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=3)
     p.add_argument("--sorted-by", default="total",
                    choices=["total", "calls"])
+    p.add_argument("--trace-dir", default=None,
+                   help="with step: also capture a jax.profiler trace "
+                        "of the measured window here")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report (compile/memory)")
     p.set_defaults(fn=_cmd_profile)
 
     p = sub.add_parser("launch", help="spawn a local N-process cluster")

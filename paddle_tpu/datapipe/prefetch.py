@@ -74,6 +74,41 @@ class DevicePrefetch(Stage):
         self._pending = collections.deque()
         self._overflow = []      # worker's in-hand items at quiesce time
         self._eof_pending = False
+        # HBM census: staged (queued + pending) device batches are the
+        # `prefetch` collection — weakref'd so a dropped stage releases
+        import weakref
+        from paddle_tpu.obs import perf as _perf
+        ref = weakref.ref(self)
+        self._hbm_token = _perf.register_hbm_provider(
+            "prefetch", lambda: (ref().device_buffers()
+                                 if ref() is not None else ()))
+        # a per-epoch rebuilt pipeline must not leak dead providers
+        weakref.finalize(self, _perf.unregister_hbm_provider,
+                         self._hbm_token)
+
+    def device_buffers(self):
+        """Flat snapshot of the DEVICE arrays currently staged in this
+        stage (queued + pending batches) — the census's `prefetch`
+        collection.  Pending batches restored by ``load_state_dict``
+        are host numpy until the next iterate re-places them; those are
+        host RAM, not HBM, so the ``devices`` attribute (jax arrays
+        only) gates what counts."""
+        batches = list(self._pending)
+        q = self._q
+        if q is not None:
+            with q.mutex:
+                batches.extend(q.queue)
+        out = []
+        for b in batches:
+            if isinstance(b, dict):
+                vals = b.values()
+            elif isinstance(b, (tuple, list)):
+                vals = b
+            else:
+                vals = (b,)
+            out.extend(v for v in vals
+                       if hasattr(v, "nbytes") and hasattr(v, "devices"))
+        return out
 
     # -- producer -------------------------------------------------------
     def _ensure_thread(self):

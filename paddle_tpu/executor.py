@@ -331,6 +331,8 @@ class Executor:
         _maybe_enable_compile_cache_from_env()
         from paddle_tpu import profiler as _profiler
         _profiler.install_jax_compile_listeners()
+        from paddle_tpu.obs import perf as _perf
+        _perf.arm_census_from_env()
 
     # ------------------------------------------------------------------
     def _cache_insert(self, sig, value):
@@ -399,8 +401,11 @@ class Executor:
         (compile lookup + XLA launch), ``executor.fetch`` (state
         write-back + host conversion) — the spans that answer "where did
         step N spend its time"."""
+        from paddle_tpu.obs import perf as _perf
+        phases = _perf.step_phases_enabled()
         feed_arrays = {}
         device = self._feed_device()
+        t_feed = time.perf_counter()
         with _span("executor.feed"):
             for name, value in feed.items():
                 var = block.var(name) if block.has_var(name) else None
@@ -431,6 +436,7 @@ class Executor:
                 scope.set_lod(name, lod)
 
             _run_reader_ops(block, scope, feed_arrays, device)
+        feed_dt = time.perf_counter() - t_feed
 
         with _span("executor.dispatch") as dsp:
             compiled = self._get_compiled(program, block, feed_arrays,
@@ -450,9 +456,12 @@ class Executor:
             fetches, new_state = compiled.fn(feed_arrays, ro_state,
                                              inout_state, key)
             dsp.set(fetches=len(fetch_names))
+        dt = time.perf_counter() - t0
         from paddle_tpu import profiler as _profiler
-        _profiler.runtime_metrics.observe("executor.step_seconds",
-                                          time.perf_counter() - t0)
+        _profiler.runtime_metrics.observe("executor.step_seconds", dt)
+        holder = getattr(compiled, "perf", None)
+        perf_record = holder["record"] if holder else None
+        _perf.census_tick(scope)
         with _span("executor.fetch"):
             if sentinel is not None:
                 # the guard runs BEFORE write-back: a NumericalFault here
@@ -465,11 +474,45 @@ class Executor:
                         fetch_names))
             if _check_nan_inf_enabled(program):
                 _check_nan_inf(fetch_names, fetches, new_state)
+            if phases:
+                # profile-step mode only: one explicit sync separates
+                # "device still computing" from host-side conversion
+                tw = time.perf_counter()
+                for v in list(fetches) + list(new_state.values()):
+                    if hasattr(v, "block_until_ready"):
+                        try:
+                            v.block_until_ready()
+                        except Exception:
+                            pass
+                t_fetch = time.perf_counter()
+                _profiler.runtime_metrics.observe(
+                    "perf.step.device_wait_seconds", t_fetch - tw)
             for n, v in new_state.items():
                 scope.set_var(n, v)
-            if return_numpy:
-                return [np.asarray(v) for v in fetches]
-            return list(fetches)
+            result = [np.asarray(v) for v in fetches] if return_numpy \
+                else list(fetches)
+            gauge = _mfu_gauge_for(program)
+            if return_numpy and perf_record is not None and gauge:
+                # live MFU over the WHOLE step (feed staging -> fetch
+                # materialization): the numpy conversion above BLOCKED
+                # on the device, so this is an honest bench-style wall
+                # time (host feed/fetch overhead included, same as the
+                # analytical MFU bench.py reports).  The
+                # return_numpy=False path hands back async arrays — its
+                # submit time would overstate MFU by the async-dispatch
+                # factor, so no gauge from it.
+                _perf.note_step(perf_record, time.perf_counter() - t_feed,
+                                gauge=gauge,
+                                devices=getattr(self, "device_count", 1))
+            if phases:
+                _profiler.runtime_metrics.observe(
+                    "perf.step.feed_seconds", feed_dt)
+                _profiler.runtime_metrics.observe(
+                    "perf.step.dispatch_seconds", dt)
+                _profiler.runtime_metrics.observe(
+                    "perf.step.fetch_seconds",
+                    time.perf_counter() - t_fetch)
+            return result
 
     # ------------------------------------------------------------------
     def _repro_payload(self, program, feed_arrays, ro_state, inout_state,
@@ -514,8 +557,16 @@ class Executor:
         (the generation decode step declares its KV-cache tensors this
         way — cache writes are intended, parameter writes still refuse).
 
-        Returns the number of signatures that were freshly compiled
-        (0 = everything was already warm)."""
+        Returns a :class:`paddle_tpu.obs.perf.WarmupReport` — an ``int``
+        equal to the number of signatures that were freshly compiled
+        (0 = everything was already warm; existing callers keep
+        working), whose ``buckets`` list carries one entry per declared
+        signature: wall seconds, fresh-compile count, and whether the
+        executable came ``"warm"`` (already in the jit LRU),
+        ``"persistent-hit"`` (loaded from the PADDLE_TPU_COMPILE_CACHE
+        dir), or ``"cold"`` (backend-compiled).  A rolling restart's
+        "warm via compile cache" claim is checkable per bucket from a
+        replica's ``/stats`` instead of inferred from global counters."""
         program = program if program is not None else default_main_program()
         specs = feed_shapes if isinstance(feed_shapes, (list, tuple)) \
             else [feed_shapes or {}]
@@ -537,6 +588,8 @@ class Executor:
         # during warmup would otherwise report 0 (or negative) compiles
         before = self._cache_inserts
         from paddle_tpu import profiler as _profiler
+        from paddle_tpu.obs.perf import WarmupReport
+        buckets = []
         with _profiler.record_latency("executor.warmup_seconds"):
             for spec in specs:
                 feed = {}
@@ -549,17 +602,31 @@ class Executor:
                     var = block.var(name) if block.has_var(name) else None
                     dtype = (var.dtype if var is not None
                              and var.dtype is not None else "float32")
-                    shape = tuple(int(d) for d in shape)
-                    if dtype == "bfloat16":
-                        feed[name] = jnp.zeros(shape, jnp.bfloat16)
-                    else:
-                        feed[name] = np.zeros(shape, np.dtype(dtype))
+                    from paddle_tpu.io import synth_feed_value
+                    feed[name] = synth_feed_value(shape, dtype)
+                ins0 = self._cache_inserts
+                hits0 = _profiler.runtime_metrics.counter(
+                    "compile_cache.hits")
+                t0 = time.perf_counter()
                 self.run(program=program, feed=feed, fetch_list=fetch_list,
                          scope=scope)
+                fresh = self._cache_inserts - ins0
+                hit = _profiler.runtime_metrics.counter(
+                    "compile_cache.hits") - hits0
+                buckets.append({
+                    "signature": {n: list(map(int, s))
+                                  for n, s in spec.items()},
+                    "compiles": fresh,
+                    "seconds": time.perf_counter() - t0,
+                    # per-bucket provenance of the executable: how a
+                    # rolling restart proves "warm via compile cache"
+                    "cache": ("warm" if fresh == 0 else
+                              "persistent-hit" if hit > 0 else "cold"),
+                })
         compiled = self._cache_inserts - before
         _profiler.runtime_metrics.inc("warmup.signatures", len(specs))
         _profiler.runtime_metrics.inc("warmup.compiles", compiled)
-        return compiled
+        return WarmupReport(compiled, buckets)
 
     # ------------------------------------------------------------------
     def run_steps(self, program=None, feed=None, fetch_list=None, steps=1,
@@ -737,6 +804,12 @@ class Executor:
                 return ys, carry
 
             fn = jax.jit(multi, donate_argnums=(3,))
+            from paddle_tpu.obs import perf as _perf
+            if _perf.capture_enabled():
+                fn = _perf.instrument_jit(
+                    fn, label=_perf.jit_label(
+                        per_step_feed or const_feed, fetch_names,
+                        tag=f"scan{steps}"))
             self._cache_insert(sig, fn)
 
         carry = dict(inout_state)
@@ -755,12 +828,28 @@ class Executor:
                 if n in out_shapes:
                     sd = out_shapes[n]
                     carry[n] = jnp.zeros(sd.shape, sd.dtype)
+        t0 = time.perf_counter()
         ys, final = fn(const_feed, per_step_feed, ro_state, carry, base_key)
         for n, v in final.items():
             scope.set_var(n, v)
-        if return_numpy:
-            return [np.asarray(v) for v in ys]
-        return list(ys)
+        result = [np.asarray(v) for v in ys] if return_numpy else list(ys)
+        from paddle_tpu.obs import perf as _perf
+        gauge = _mfu_gauge_for(program)
+        if return_numpy and gauge:
+            # MFU over the whole on-device window: XLA's cost analysis
+            # counts the scan BODY once regardless of trip count, so
+            # the captured FLOPs scale by `steps`; ONLY the numpy
+            # conversion above blocks on the device, so only this path
+            # yields an honest window wall time (async submit time
+            # would overstate MFU by orders of magnitude)
+            holder = getattr(fn, "perf", None)
+            _perf.note_step(holder["record"] if holder else None,
+                            time.perf_counter() - t0,
+                            gauge=gauge,
+                            devices=getattr(self, "device_count", 1),
+                            flops_scale=steps)
+        _perf.census_tick(scope)
+        return result
 
     # ------------------------------------------------------------------
     def run_pipeline(self, program=None, pipeline=None, fetch_list=None,
@@ -1104,10 +1193,18 @@ class Executor:
         else:
             fn = jax.jit(parts["step"],
                          donate_argnums=(2,) if donate else ())
+            from paddle_tpu.obs import perf as _perf
+            if _perf.capture_enabled():
+                # the first call AOT-compiles and captures the cost/
+                # memory record for this jit key (paddle_tpu profile
+                # compile, the live MFU gauge, the headroom check)
+                fn = _perf.instrument_jit(
+                    fn, label=_perf.jit_label(feed_arrays, fetch_names))
         compiled = _CompiledBlock(fn, parts["feed_names"],
                                   parts["ro_names"], parts["inout_names"],
                                   tuple(fetch_names), parts["uses_rng"])
         compiled.donated = donate and not parts["interpret"]
+        compiled.perf = getattr(fn, "perf", None)
         self._cache_insert(sig, compiled)
         return compiled
 
@@ -1121,6 +1218,19 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+def _mfu_gauge_for(program):
+    """Which MFU gauge a program's dispatches feed: an explicit
+    ``_mfu_gauge`` tag wins (GenPredictor tags its decode program
+    ``gen.decode_mfu``); untagged TRAINING programs land in
+    ``train.mfu``; untagged inference programs (a serving Predictor, a
+    prefill) derive none — a one-shot prefill must not overwrite the
+    training/decode gauges the fleet rollups read."""
+    tagged = getattr(program, "_mfu_gauge", None)
+    if tagged:
+        return tagged
+    return None if program._is_inference else "train.mfu"
 
 
 def _enforce_feed(name, value, var):

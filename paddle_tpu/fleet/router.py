@@ -181,6 +181,10 @@ class FleetRouter:
                         "failovers": [list(f) for f in
                                       router.failover_log],
                     }
+                    # per-replica MFU / HBM headroom from the latest
+                    # federation pass (empty before the first
+                    # /metrics?fleet=1 scrape — never blocks on one)
+                    snap["fleet_perf"] = router._scraper.last_perf()
                     if router._slo is not None:
                         snap["slo"] = router._slo.state()
                     self._reply(200, snap)

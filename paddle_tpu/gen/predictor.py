@@ -82,6 +82,28 @@ class GenPredictor:
             (self._pre_prog, self._pre_feeds, self._pre_fetch),
             (self._dec_prog, self._dec_feeds, self._dec_fetch),
             self.meta)).raise_on_errors(where="gen.GenPredictor")
+        # decode dispatches derive gen.decode_mfu (not train.mfu): the
+        # executor keys the gauge off this program attribute
+        self._dec_prog._mfu_gauge = "gen.decode_mfu"
+        # HBM census: the bucketed KV pool is its own collection —
+        # weakref'd so a dropped predictor releases cleanly
+        import weakref
+        from paddle_tpu.obs import perf as _perf
+        ref = weakref.ref(self)
+
+        def _kv_buffers():
+            p = ref()
+            if p is None:
+                return ()
+            return [v for v in (p._scope.find_var(n)
+                                for n in p.cache_vars)
+                    if v is not None and hasattr(v, "nbytes")]
+
+        self._hbm_token = _perf.register_hbm_provider("kv_cache",
+                                                      _kv_buffers)
+        # a reloaded predictor must not leave a dead provider behind
+        weakref.finalize(self, _perf.unregister_hbm_provider,
+                         self._hbm_token)
         # per-bucket constant prefill feeds (causal bias template)
         self._tri = {}
 
@@ -193,7 +215,11 @@ class GenPredictor:
         """AOT-compile BOTH signature families — one prefill signature
         per declared prompt bucket plus the (single) decode signature —
         so the first real ``/generate`` pays zero compile time.  Returns
-        the number of fresh compiles."""
+        a :class:`~paddle_tpu.obs.perf.WarmupReport` (int = fresh
+        compiles; ``buckets`` carries one per-signature entry tagged
+        ``program: prefill|decode`` with compile seconds and
+        cold/persistent-hit/warm provenance — what ``/stats`` surfaces
+        so a rolling restart's warm claim is checkable per bucket)."""
         sigs = []
         for b in self.prompt_buckets:
             if b > self.max_len:
@@ -204,17 +230,18 @@ class GenPredictor:
         S, L = self.num_slots, self.max_len
         dec_sig = {"gen_token": (S, 1), "gen_pos": (S, 1),
                    "gen_pos_onehot": (S, L), "gen_attn_mask": (S, L)}
+        from paddle_tpu.obs.perf import WarmupReport
         with self._lock:
             with self._fluid.scope_guard(self._scope):
-                compiled = self._exe.warmup(
+                pre = self._exe.warmup(
                     self._pre_prog, sigs, fetch_list=self._pre_fetch,
                     scope=self._scope)
                 # the decode step writes its (persistable) cache tensors
                 # in place — declare exactly those as intended state
                 # updates (a zero pos-onehot writes nothing, so warmup
                 # leaves the pool untouched)
-                compiled += self._exe.warmup(
+                dec = self._exe.warmup(
                     self._dec_prog, [dec_sig], fetch_list=self._dec_fetch,
                     scope=self._scope,
                     allow_state_updates=self.cache_vars)
-        return compiled
+        return WarmupReport.merge(pre, dec, labels=("prefill", "decode"))

@@ -192,6 +192,19 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     return fetch_var_names
 
 
+def synth_feed_value(shape, dtype):
+    """Zero-filled feed array for a declared signature — the ONE
+    materialization AOT warmup (``Executor.warmup``) and the synthetic
+    profile/selfcheck feeds (``models.synth_feed``) share: bfloat16
+    synthesizes as a jax array (numpy has no such dtype), everything
+    else as numpy zeros."""
+    shape = tuple(int(d) for d in shape)
+    if str(dtype) == "bfloat16":
+        import jax.numpy as jnp
+        return jnp.zeros(shape, jnp.bfloat16)
+    return np.zeros(shape, np.dtype(str(dtype)))
+
+
 def infer_feed_specs(program, feed_names):
     """Declared feed signatures of an inference program: a dict
     ``name -> {"shape": tuple (None for dynamic dims), "dtype": str,

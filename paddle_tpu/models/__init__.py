@@ -8,7 +8,7 @@ from paddle_tpu.models import (resnet, transformer, vgg, mnist,
 
 __all__ = ["resnet", "transformer", "vgg", "mnist",
            "seq2seq", "stacked_lstm", "gen_lm", "ZOO_MODELS",
-           "build_train_program"]
+           "build_train_program", "synth_feed", "compile_zoo_step"]
 
 #: zoo model names accepted by :func:`build_train_program` (and by
 #: ``paddle_tpu lint --zoo``; the lint gate in
@@ -71,3 +71,44 @@ def build_train_program(name, backward=True):
         if backward:
             fluid.optimizer.Adam(learning_rate=1e-3).minimize(cost)
     return main, startup, feeds, fetches
+
+
+def synth_feed(main_program, feed_names=None, batch=2):
+    """Synthetic (zero-filled) feed dict for a zoo main program — what
+    ``paddle_tpu profile compile|memory`` and the selfcheck ``perf``
+    section execute one step with to force a real compile without a
+    dataset.  Zeros are valid everywhere the zoo reads labels or token
+    ids (class/token 0 exists); dynamic dims synthesize as ``batch``.
+    ``feed_names=None`` falls back to the program's ``is_data`` vars
+    (models that build their own feed layers)."""
+    block = main_program.global_block()
+    if feed_names is None:
+        feed_names = [v.name for v in block.vars.values()
+                      if getattr(v, "is_data", False)]
+    from paddle_tpu.io import synth_feed_value
+
+    feed = {}
+    for name in feed_names:
+        var = block.var(name)
+        shape = tuple(batch if d is None or int(d) < 0 else int(d)
+                      for d in (var.shape or (batch,)))
+        feed[name] = synth_feed_value(shape, var.dtype or "float32")
+    return feed
+
+
+def compile_zoo_step(name, batch=2):
+    """Fresh-compile one zoo model: build, run startup, run ONE
+    synthetic train step in a fresh scope — the shared recipe
+    ``paddle_tpu profile compile|memory`` and selfcheck's ``perf``
+    section use to force a real captured compile without a dataset.
+    Returns the scope (for a following HBM census)."""
+    import paddle_tpu as fluid
+
+    main, startup, feeds, fetches = build_train_program(name)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed=synth_feed(main, feeds, batch=batch),
+                fetch_list=fetches, scope=scope)
+    return scope

@@ -374,6 +374,26 @@ def matmul_param_count(hp: ModelHyperParams = None):
     return hp.n_layer * (per_enc + per_dec) + proj
 
 
+def train_flops_per_token(hp: ModelHyperParams = None, seq=None):
+    """Analytical training FLOPs per (target) token — the 6N-matmul +
+    attention accounting ``bench.py`` derives MFU from:
+
+    * ``6 * matmul_param_count`` — fwd (2N) + bwd (4N) per matmul
+      parameter; input embeddings excluded (gather, not matmul), the
+      output projection included.
+    * attention: 3 modules/layer (enc-self, dec-self, cross), each
+      QK^T + AV = ``4*S*d`` FLOPs/token fwd, bwd 2x => ``12*S*d``.
+
+    The cross-check test (``tests/test_perf.py``) holds this against
+    the XLA ``cost_analysis()`` FLOPs of the compiled train step within
+    a declared band, so drift in the hand accounting MFU claims rest on
+    cannot land silently."""
+    hp = hp or ModelHyperParams()
+    seq = seq if seq is not None else hp.max_length
+    attn_flops = 12 * seq * hp.d_model * (3 * hp.n_layer)
+    return 6 * matmul_param_count(hp) + attn_flops
+
+
 def tp_shardings():
     """Megatron-style tensor-parallel PartitionSpec rules for the model's
     parameters (and, by substring match, their Adam moments) over a mesh

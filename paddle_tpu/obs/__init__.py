@@ -28,6 +28,15 @@ Three FLEET-level layers on top (the multi-process plane):
   ``paddle_tpu bench check`` fails on regression past per-metric
   tolerance bands.
 
+And the DEVICE-side plane:
+
+- :mod:`paddle_tpu.obs.perf` — XLA cost/memory attribution per jit key
+  (captured on every jit-cache miss), trace/lower/compile phase times,
+  a live ``train.mfu`` / ``gen.decode_mfu`` gauge, the ``hbm.*``
+  live-buffer census with collection attribution and a high watermark,
+  and the pre-run projected-footprint headroom check — surfaced by the
+  ``paddle_tpu profile compile|memory|step`` CLI family.
+
 See ``docs/observability.md`` for the span API, the trace-context
 headers, the post-mortem file format, and the metric-name registry.
 """
@@ -39,6 +48,7 @@ from paddle_tpu.obs import flight
 from paddle_tpu.obs import prom
 from paddle_tpu.obs import aggregate
 from paddle_tpu.obs import bench_history
+from paddle_tpu.obs import perf
 from paddle_tpu.obs import slo
 from paddle_tpu.obs.trace import (span, record_span, trace_context,
                                   current_trace_id, new_trace_id,
@@ -51,7 +61,7 @@ from paddle_tpu.obs.aggregate import (FleetScraper, assemble_fleet_trace,
 from paddle_tpu.obs.slo import SLOWatchdog, load_spec, validate_spec
 
 __all__ = ["trace", "flight", "prom", "aggregate", "bench_history",
-           "slo", "span", "record_span", "trace_context",
+           "perf", "slo", "span", "record_span", "trace_context",
            "current_trace_id", "new_trace_id", "chrome_trace",
            "dump_chrome_trace", "set_process_name", "snapshot_payload",
            "write_postmortem", "read_postmortem", "render_prometheus",

@@ -48,7 +48,8 @@ from paddle_tpu.obs.prom import _fmt, _labelset, render_prometheus, \
 
 __all__ = ["FleetScraper", "fetch_stats", "fetch_spans",
            "fetch_spans_many", "merged_quantile", "render_federated",
-           "assemble_fleet_trace", "CONTENT_TYPE"]
+           "replica_perf", "assemble_fleet_trace", "CONTENT_TYPE",
+           "PERF_GAUGES"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -58,6 +59,28 @@ _TOKEN_COUNTERS = ("gen.tokens",)
 # series whose per-replica summaries merge into fleet percentiles
 _MERGED_SERIES = ("serving.request_seconds", "gen.ttft_seconds",
                   "gen.intertoken_seconds", "gen.decode_step_seconds")
+# device-performance gauges federated per replica (obs.perf): each
+# replica's value rides its labelled registry block; these also feed
+# the fleet_mfu_mean / fleet_hbm_headroom_min rollups and the router's
+# /stats `fleet_perf` summary
+PERF_GAUGES = ("train.mfu", "gen.decode_mfu", "hbm.headroom_bytes",
+               "hbm.total_bytes", "hbm.high_watermark_bytes")
+
+
+def replica_perf(scrapes):
+    """Per-replica device-performance summary of a federation pass:
+    ``{addr: {"id": ..., <gauge>: value}}`` over the :data:`PERF_GAUGES`
+    a replica reports (replicas running no perf-instrumented work are
+    omitted; stale replicas never appear)."""
+    out = {}
+    for s in scrapes:
+        if not s.get("ok"):
+            continue
+        gauges = (s["stats"].get("gauges") or {})
+        vals = {g: gauges[g] for g in PERF_GAUGES if g in gauges}
+        if vals:
+            out[s["addr"]] = dict(vals, id=s.get("id") or s["addr"])
+    return out
 
 
 def _get_json(addr, path, timeout):
@@ -143,6 +166,7 @@ class FleetScraper:
         self._metrics = metrics
         self._lock = threading.Lock()
         self._prev = None  # (monotonic, {addr: (requests, tokens)})
+        self._last_perf = {}  # replica_perf() of the latest pass
 
     def _scrape_one(self, target):
         addr, replica_id = target
@@ -180,7 +204,18 @@ class FleetScraper:
                               time.perf_counter() - t0)
         self._metrics.set_gauge("fleet.replicas_stale",
                                 sum(1 for s in scrapes if not s["ok"]))
+        with self._lock:
+            self._last_perf = replica_perf(scrapes)
         return scrapes
+
+    def last_perf(self):
+        """Per-replica MFU / HBM summary of the most recent federation
+        pass (the router's ``/stats`` ``fleet_perf`` body; empty before
+        the first scrape — ``/stats`` must never block on a fleet
+        pull)."""
+        with self._lock:
+            return {addr: dict(vals)
+                    for addr, vals in self._last_perf.items()}
 
     def _rates(self, scrapes):
         """(rps, tokens_per_sec) vs the previous scrape; None on the
@@ -254,6 +289,23 @@ def render_federated(scrapes, rps=None, tokens_per_sec=None):
                    merged_quantile(scrapes, series, q),
                    f"{series} {q} merged across replicas "
                    f"(count-weighted)")
+    # device-performance rollups: fleet-mean MFU (a replica compiling
+    # or idling drags it visibly) and the TIGHTEST HBM headroom — the
+    # replica closest to OOM is the one that pages you, so min, not
+    # mean.  Per-replica exact values ride the labelled registries.
+    perf = replica_perf(scrapes)
+    mfus = [v for p in perf.values()
+            for v in [p.get("train.mfu", p.get("gen.decode_mfu"))]
+            if v is not None]
+    rollup("paddle_tpu_fleet_mfu_mean",
+           (sum(mfus) / len(mfus)) if mfus else None,
+           "mean live MFU across replicas reporting one "
+           "(train.mfu, else gen.decode_mfu)")
+    heads = [p["hbm.headroom_bytes"] for p in perf.values()
+             if p.get("hbm.headroom_bytes") is not None]
+    rollup("paddle_tpu_fleet_hbm_headroom_min_bytes",
+           min(heads) if heads else None,
+           "tightest device-memory headroom across replicas")
 
     lines.append("# HELP paddle_tpu_fleet_replica_up replica scrape "
                  "health (0 = unreachable/stale)")

@@ -184,6 +184,15 @@ class ParallelExecutor(Executor):
         jitted = jax.jit(step, in_shardings=in_shardings,
                          out_shardings=out_shardings,
                          donate_argnums=(2,) if donate else ())
+        from paddle_tpu.obs import perf as _perf
+        if _perf.capture_enabled():
+            # cost/memory capture on the sharded executable: the
+            # recorded FLOPs cover the WHOLE mesh, so note_step divides
+            # by device_count when deriving the live MFU gauge
+            jitted = _perf.instrument_jit(
+                jitted, label=_perf.jit_label(
+                    feed_arrays, fetch_names,
+                    tag=f"mesh{tuple(mesh.devices.shape)}"))
         feed_shardings = in_shardings[0]
 
         def place(a, sharding):
@@ -206,6 +215,7 @@ class ParallelExecutor(Executor):
         compiled = _CompiledBlock(fn, base.feed_names, base.ro_names,
                                   base.inout_names, tuple(fetch_names), True)
         compiled.donated = donate
+        compiled.perf = getattr(jitted, "perf", None)
         self._cache_insert(sig, compiled)
         return compiled
 

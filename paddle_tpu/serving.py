@@ -613,6 +613,10 @@ class InferenceServer:
                 1, max(int(max_batch_size), 1)) if batching else (1,)
         self._warmup_batch_sizes = tuple(warmup_batch_sizes or ())
         self._do_warmup = bool(warmup)
+        # per-bucket warmup report (compile seconds + cold/persistent-
+        # hit/warm provenance), surfaced in /stats: a rolling restart's
+        # "warm via compile cache" claim is observable per bucket
+        self._warmup_report = None
         server = self
 
         def _load():
@@ -627,7 +631,9 @@ class InferenceServer:
                         # both signature families — every prefill
                         # bucket AND the decode step — compile before
                         # /readyz flips
-                        gen_predictor.warmup()
+                        rep = gen_predictor.warmup()
+                        server._warmup_report = getattr(
+                            rep, "buckets", None)
                     server.gen_predictor = gen_predictor
                     server._gen = GenScheduler(
                         gen_predictor,
@@ -640,9 +646,10 @@ class InferenceServer:
                     chaos.fire("serving.warmup", model_dir=model_dir)
                     # batched dispatches see row-bucketed (padded)
                     # shapes; serialized ones see exact request shapes
-                    predictor.warmup(
+                    rep = predictor.warmup(
                         server._warmup_batch_sizes or (1,),
                         bucket=server._batch_conf["batching"])
+                    server._warmup_report = getattr(rep, "buckets", None)
                 if server._batch_conf["batching"]:
                     server._batcher = MicroBatcher(
                         predictor,
@@ -765,7 +772,8 @@ class InferenceServer:
                         request_timeout=server._request_timeout,
                         queue_depth=batcher.queue_depth if batcher else 0,
                         warmup_batch_sizes=list(
-                            server._warmup_batch_sizes))
+                            server._warmup_batch_sizes),
+                        warmup=server._warmup_report)
                     gen = server._gen
                     if gen is not None:
                         snap["server"]["gen"] = {
