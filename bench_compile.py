@@ -1,0 +1,197 @@
+"""Cold-start bench: trace+compile wall-time across the zoo, with the
+Program-IR optimization pipeline off vs on (``PADDLE_TPU_OPT``).
+
+The persistent XLA compile cache (PR 2) only AMORTIZES cold-start cost;
+the ``analysis/opt`` pipeline SHRINKS it — fewer traced ops (DCE of
+unfetched autodiff chains, CSE, constant folding, elementwise fusion)
+and a statically proven RNG-key plan that drops the per-op
+``fold_in`` threefry chains from the jaxpr.  This bench measures what
+that buys: per zoo model, the summed trace+lower+backend phase times of
+a COLD process's first step (captured by ``obs.perf.instrument_jit``),
+plus the steady-state step time (which must not regress — the passes
+may only remove work XLA would have DCE'd anyway).
+
+Each measurement runs in its own subprocess (fresh jax, fresh caches —
+in-process A/B flatters whichever side compiles second), alternating
+baseline/optimized order across trials, taking the per-side minimum.
+
+    python bench_compile.py --out BENCH_COMPILE.json
+    python bench_compile.py --smoke        # fast CI schema check
+    python bench_compile.py --record-trajectory default
+
+Headline metrics (recorded per ``--record-trajectory``, guarded by
+``paddle_tpu bench check``): ``reduction_second_best`` — the
+second-best per-model trace+compile reduction, i.e. "at least two zoo
+models improve by this much" (the ISSUE-15 acceptance floor is 0.15) —
+and ``step_time_ratio_worst`` (optimized/baseline steady step, must
+stay ~1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+DEFAULT_MODELS = ("mnist", "transformer", "gen_lm")
+FULL_MODELS = ("mnist", "transformer", "gen_lm", "resnet", "vgg")
+
+WORKER = r'''
+import json, os, sys, time, warnings
+warnings.filterwarnings("ignore")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu.models import build_train_program, synth_feed
+from paddle_tpu.obs import perf
+
+name = sys.argv[1]
+steady_iters = int(sys.argv[2])
+
+main, startup, feeds, fetches = build_train_program(name)
+main.random_seed = startup.random_seed = 11
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe = fluid.Executor()
+    feed = synth_feed(main, feeds)
+    # the cold start a fresh process pays: startup compile+run plus the
+    # first step's trace/lower/backend (optimization time included on
+    # the PADDLE_TPU_OPT=1 side — the pipeline must pay for itself)
+    t0 = time.perf_counter()
+    exe.run(startup)
+    exe.run(main, feed=feed, fetch_list=fetches, scope=scope)
+    cold_wall = time.perf_counter() - t0
+    phases = sum(sum(r["phases"].values()) for r in perf.records())
+    steady = []
+    for _ in range(steady_iters):
+        t0 = time.perf_counter()
+        exe.run(main, feed=feed, fetch_list=fetches, scope=scope)
+        steady.append(time.perf_counter() - t0)
+    opt_report = None
+    for prog in exe._opt_cache.values():
+        r = getattr(prog, "_opt_report", None)
+        if r is not None and not getattr(prog, "_opt_interpret", False):
+            opt_report = r.to_dict()
+print(json.dumps({
+    "cold_start_seconds": cold_wall,
+    "trace_compile_seconds": phases,
+    "steady_step_seconds": min(steady) if steady else None,
+    "opt": opt_report,
+}))
+'''
+
+
+def _measure(model, opt, steady_iters):
+    env = dict(os.environ)
+    env["PADDLE_TPU_OPT"] = "1" if opt else "0"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("PADDLE_TPU_COMPILE_CACHE", None)  # cold means cold
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(WORKER)
+        path = f.name
+    try:
+        out = subprocess.run(
+            [sys.executable, path, model, str(steady_iters)],
+            env=env, capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"bench worker failed for {model} (opt={opt}):\n"
+                f"{out.stderr[-2000:]}")
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    finally:
+        os.unlink(path)
+
+
+def run_bench(models=DEFAULT_MODELS, trials=3, steady_iters=4,
+              smoke=False):
+    if smoke:
+        models, trials, steady_iters = ("mnist",), 1, 2
+    results = {}
+    for model in models:
+        base_runs, opt_runs = [], []
+        for t in range(trials):
+            # alternate order so ambient load biases neither side
+            order = ((False, True) if t % 2 == 0 else (True, False))
+            for opt in order:
+                (opt_runs if opt else base_runs).append(
+                    _measure(model, opt, steady_iters))
+        base = min(r["cold_start_seconds"] for r in base_runs)
+        opt = min(r["cold_start_seconds"] for r in opt_runs)
+        pbase = min(r["trace_compile_seconds"] for r in base_runs)
+        popt = min(r["trace_compile_seconds"] for r in opt_runs)
+        sbase = min(r["steady_step_seconds"] for r in base_runs)
+        sopt = min(r["steady_step_seconds"] for r in opt_runs)
+        results[model] = {
+            "cold_start_seconds": {"baseline": base, "optimized": opt},
+            "captured_phase_seconds": {"baseline": pbase,
+                                       "optimized": popt},
+            "reduction": 1.0 - opt / base if base > 0 else 0.0,
+            "steady_step_ms": {"baseline": sbase * 1e3,
+                               "optimized": sopt * 1e3},
+            "step_time_ratio": sopt / sbase if sbase > 0 else 1.0,
+            "opt_report": opt_runs[-1].get("opt"),
+        }
+    reductions = sorted((r["reduction"] for r in results.values()),
+                        reverse=True)
+    summary = {
+        "bench": "compile",
+        "smoke": bool(smoke),
+        "models": results,
+        "reduction_best": reductions[0],
+        "reduction_second_best":
+            reductions[1] if len(reductions) > 1 else reductions[0],
+        "models_ge_15pct": sum(1 for r in reductions if r >= 0.15),
+        "step_time_ratio_worst": max(r["step_time_ratio"]
+                                     for r in results.values()),
+    }
+    return summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--models", default=None,
+                    help="comma list (default: mnist,transformer,gen_lm)")
+    ap.add_argument("--full", action="store_true",
+                    help="bench the larger zoo set too (resnet, vgg)")
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--steady-iters", type=int, default=4)
+    ap.add_argument("--smoke", action="store_true",
+                    help="1 trial, mnist only — CI schema check")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the JSON summary here")
+    from paddle_tpu.obs.bench_history import (add_record_args,
+                                              record_from_args)
+    add_record_args(ap)
+    args = ap.parse_args(argv)
+    models = DEFAULT_MODELS
+    if args.full:
+        models = FULL_MODELS
+    if args.models:
+        models = tuple(s for s in args.models.split(",") if s)
+    summary = run_bench(models=models, trials=args.trials,
+                        steady_iters=args.steady_iters, smoke=args.smoke)
+    print(json.dumps(summary, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2)
+            f.write("\n")
+    record_from_args("compile", summary, args, "bench_compile.py")
+    ok = summary["reduction_second_best"] >= 0.15 and \
+        summary["step_time_ratio_worst"] <= 1.10
+    if not args.smoke and not ok:
+        print("bench_compile: acceptance floor missed "
+              "(>=15% reduction on >=2 models, steady step no worse)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,16 @@ can BEFORE tracing:
 * :mod:`~paddle_tpu.analysis.distributed` — cross-program verifier for
   the families a transpile produces: collective matching, Send/Recv
   pairing, split reassembly, stage boundary agreement, sharding-spec
-  propagation, recompile hazards (PTA011–PTA019).
+  propagation, recompile hazards (PTA011–PTA019);
+* :mod:`~paddle_tpu.analysis.opmeta` — the SHARED op-metadata registry
+  (pure/effectful/stateful/sub-block classification) the lints, the
+  optimization passes, and the cost model all ride;
+* :mod:`~paddle_tpu.analysis.cost` — static per-op FLOPs/bytes cost
+  model (``@cost.rule`` functions over the typecheck shape inference);
+* :mod:`~paddle_tpu.analysis.opt` — the verify-sandwiched optimization
+  pass pipeline (``PADDLE_TPU_OPT=1``, ``paddle_tpu opt``): constant
+  folding, CSE, DCE, elementwise fusion, the donation planner, and the
+  cost-model compile-amortization gate.
 
 Entry points: ``lint_program`` (everything; ``paddle_tpu lint``),
 ``verify_program`` (structural, raising — the ``PADDLE_TPU_VERIFY=1``
@@ -35,6 +44,8 @@ from paddle_tpu.analysis.diagnostics import (DIAGNOSTIC_CODES, Diagnostic,
                                              format_diagnostics)
 from paddle_tpu.analysis import typecheck
 from paddle_tpu.analysis import distributed
+from paddle_tpu.analysis import cost
+from paddle_tpu.analysis import opmeta
 from paddle_tpu.analysis.distributed import (check_distributed_spec,
                                              check_gen_bundle,
                                              check_stage_set,
@@ -47,7 +58,8 @@ __all__ = [
     "AnalysisResult", "analyze_program", "lint_program", "verify_program",
     "verify_transpiled", "check_pipeline_carriers", "DIAGNOSTIC_CODES",
     "Diagnostic", "ProgramVerificationError", "format_diagnostics",
-    "typecheck", "distributed", "check_distributed_spec",
+    "typecheck", "distributed", "cost", "opmeta",
+    "check_distributed_spec",
     "check_gen_bundle", "check_stage_set", "check_transpiled_pair",
     "lint_gen_bundle", "lint_pair", "lint_pipeline", "verify_gen_bundle",
 ]

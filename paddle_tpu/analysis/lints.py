@@ -11,29 +11,16 @@ RNG, readers/CSP/persistence) is never called dead.
 
 from __future__ import annotations
 
-from paddle_tpu import framework
 from paddle_tpu.analysis.diagnostics import Diagnostic
 from paddle_tpu.analysis.structural import _external_reads, _sub_blocks
 
+# op effect classification lives in the SHARED registry
+# (analysis/opmeta.py) so this lint's exemptions, the opt passes'
+# removal guards, and the cost model can never drift apart — the
+# scanner test (tests/test_opmeta.py) enforces single ownership
+from paddle_tpu.analysis.opmeta import has_effects as _has_effects
+
 __all__ = ["check_graph"]
-
-# effectful op families that must never be pruned even when nothing
-# consumes their outputs (mirrors executor._SKIP_OPS + runtime channels)
-_EFFECT_OP_TYPES = frozenset({
-    "feed", "fetch", "read", "print", "assert", "save", "load",
-    "save_combine", "load_combine", "send", "recv", "go", "select",
-    "channel_send", "channel_recv", "channel_close", "increment",
-})
-
-
-def _has_effects(op, registry):
-    if op.type in _EFFECT_OP_TYPES or op.type.startswith("create_"):
-        return True
-    opdef = registry.lookup(op.type)
-    if opdef is not None and (opdef.host or opdef.stateful_outputs or
-                              opdef.uses_rng):
-        return True
-    return any(True for _ in _sub_blocks(op))
 
 
 def check_graph(program, feed_names=None, fetch_names=None):

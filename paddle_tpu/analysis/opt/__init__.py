@@ -1,0 +1,31 @@
+"""Program-IR optimization passes: the analyzer turned compiler
+mid-layer (``docs/static_analysis.md`` "Optimization passes").
+
+PR 7/9 built dataflow shape/dtype/sharding inference over the IR to
+*check* programs; this package uses the same plumbing to *transform*
+them ahead of XLA — shrinking the op count the executor traces and the
+HLO the backend compiles (the cold-start cost the persistent compile
+cache merely amortizes), and attaching statically proven facts (the
+donation plan, the RNG-key plan) the executor exploits at trace time.
+
+Every pass runs inside a **verify-sandwich**: the full analyzer
+(structure + types + lints) runs before the pipeline and after every
+pass, with the PTA codes as invariants — any diagnostic a pass
+*introduces* aborts that pass and the program reverts to its pre-pass
+form (``opt.pass_aborts``).  Correctness never rests on a pass being
+right; it rests on the sandwich.
+
+Entry points: :func:`optimize_program` (what ``Executor.run`` calls
+once per ``(program, version, fetches)`` under ``PADDLE_TPU_OPT=1``,
+and ``paddle_tpu opt`` wraps for offline inspection),
+:class:`PassPipeline` (compose your own), and the individual passes in
+:mod:`~paddle_tpu.analysis.opt.passes`.
+"""
+
+from paddle_tpu.analysis.opt.pipeline import (DEFAULT_PASSES, OptReport,
+                                              PassPipeline,
+                                              optimize_program)
+from paddle_tpu.analysis.opt import passes
+
+__all__ = ["PassPipeline", "OptReport", "optimize_program",
+           "DEFAULT_PASSES", "passes"]

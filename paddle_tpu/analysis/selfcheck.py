@@ -389,6 +389,69 @@ def _check_perf():
                     failures)
 
 
+def _check_opt():
+    """Optimization-pipeline gate: the full pipeline runs over every
+    zoo model (main AND startup), no pass is sandwich-aborted, every
+    OPTIMIZED program still lints clean (the passes must not trade
+    correctness findings for speed), the static cost report keeps its
+    schema, and a one-step executor equivalence spot-check proves the
+    optimized program computes the same fetches."""
+    import numpy as np
+
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import cost
+    from paddle_tpu.analysis.opt import optimize_program
+    from paddle_tpu.models import ZOO_MODELS, build_train_program
+
+    failures = []
+    for name in ZOO_MODELS:
+        main, startup, feeds, fetches = build_train_program(name)
+        for label, prog, fd, ft in ((name, main, feeds, fetches),
+                                    (f"{name}/startup", startup, None,
+                                     None)):
+            optimized, report = optimize_program(prog, feed_names=fd,
+                                                 fetch_names=ft)
+            for p in report.aborted_passes:
+                failures.append(f"[{label}] pass {p!r} was "
+                                f"sandwich-aborted")
+            r = analysis.lint_program(optimized, feed_names=fd,
+                                      fetch_names=ft)
+            for d in r.diagnostics:
+                failures.append(f"[{label}] optimized program: "
+                                f"{d.severity}[{d.code}]: {d.message}")
+        failures.extend(
+            f"[{name}] cost report: {p}"
+            for p in cost.validate_cost_report(
+                cost.estimate(main).to_dict()))
+
+    # equivalence spot-check (one cheap model; the zoo-wide harness is
+    # tests/test_opt_equivalence.py): same startup init, one step,
+    # fetches must agree
+    import paddle_tpu as fluid
+    main, startup, feeds, fetches = build_train_program("mnist")
+    main.random_seed = startup.random_seed = 3
+    optimized, _ = optimize_program(main, feed_names=feeds,
+                                    fetch_names=fetches)
+    from paddle_tpu.models import synth_feed
+    outs = []
+    for prog in (main, optimized):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            outs.append(exe.run(prog,
+                                feed=synth_feed(main, feeds),
+                                fetch_list=fetches, scope=scope))
+    for ft, a, b in zip(fetches, outs[0], outs[1]):
+        if not np.allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                           atol=1e-6):
+            failures.append(f"equivalence spot-check: fetch {ft!r} "
+                            f"diverged under optimization")
+    return _section("opt",
+                    "zoo-wide pipeline run, optimized-program lint, "
+                    "cost schema, equivalence spot-check", failures)
+
+
 def _check_bench_trajectory():
     """``bench check --dry`` against the repo's BENCH_TRAJECTORY.json:
     a drifted or malformed trajectory schema fails the static gate (the
@@ -414,6 +477,7 @@ def run_selfcheck():
         _check_metric_registry(),
         _check_failpoint_registry(),
         _check_slo_spec(),
+        _check_opt(),
         _check_bench_trajectory(),
         _check_ckpt_manifest(),
         _check_perf(),

@@ -653,7 +653,9 @@ _GRAD_MIRROR_OPS = tuple(
         "reduce_prod", "cross_entropy", "softmax_with_cross_entropy",
         "lookup_table", "reshape", "reshape2", "transpose",
         "transpose2", "conv2d", "pool2d", "batch_norm", "layer_norm",
-        "sequence_pool", "lstm",
+        "sequence_pool", "lstm", "write_to_array", "read_from_array",
+        "array_to_lod_tensor", "lod_tensor_to_array",
+        "reorder_lod_tensor_by_rank",
     ))
 
 
@@ -710,6 +712,44 @@ def _r_sequence_pool(op, tc):
     shape = (-1,) + tuple(x.shape[1:]) if x.shape is not None else None
     tc.set_output(op, "Out", shape=shape, dtype=x.dtype)
     tc.set_output(op, "MaxIndex", shape=shape, dtype="int32")
+
+
+# ---------------------------------------------------------------------------
+# LoD/array plumbing + recurrent ops: coverage the cost model rides
+# (shape inference is the prerequisite for bytes costing).  Row counts
+# are LoD-dependent (unknown statically, -1); trailing feature dims and
+# dtypes carry through exactly — propagation only, nothing reported.
+# ---------------------------------------------------------------------------
+
+@rule("write_to_array", "read_from_array", "array_to_lod_tensor",
+      "lod_tensor_to_array", "reorder_lod_tensor_by_rank")
+def _r_lod_array_plumbing(op, tc):
+    x = tc.input_info(op, "X")
+    shape = (-1,) + tuple(x.shape[1:]) if x.shape is not None else None
+    tc.set_output(op, "Out", shape=shape, dtype=x.dtype)
+
+
+@rule("lod_rank_table")
+def _r_lod_rank_table(op, tc):
+    # produces a rank-table object, not a tensor: nothing to propagate,
+    # but the op is KNOWN (off the warn-list) — consumers' rules treat
+    # the table input as unknown by construction
+    tc.set_output(op, "Out")
+
+
+@rule("lstm")
+def _r_lstm(op, tc):
+    x = tc.input_info(op, "Input")
+    w = tc.input_info(op, "Weight")
+    hidden = None
+    if w.shape is not None and len(w.shape) == 2 and w.shape[0] != -1:
+        hidden = w.shape[0]
+    rows = x.shape[0] if x.shape is not None else -1
+    shape = (rows, hidden) if hidden is not None else None
+    tc.set_output(op, "Hidden", shape=shape, dtype=x.dtype)
+    tc.set_output(op, "Cell", shape=shape, dtype=x.dtype)
+    tc.set_output(op, "BatchGate")
+    tc.set_output(op, "BatchCellPreAct")
 
 
 @rule("sgd", "momentum", "adam", "adamax", "adagrad", "adadelta",

@@ -59,6 +59,17 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              transpiled trainer/pserver
                                              pair; --pipeline N verifies
                                              an N-stage split
+  opt     MODEL_DIR | --zoo NAME|all         run the Program-IR
+                                             optimization pipeline
+                                             offline: per-pass
+                                             diff/stats report, cost
+                                             before/after, donation
+                                             plan, amortization-gate
+                                             verdict (what
+                                             PADDLE_TPU_OPT=1 does
+                                             in-executor); exit 1 when
+                                             any pass was sandwich-
+                                             aborted
   ckpt    inspect DIR | verify DIR           checkpoint-dir survey:
                                              committed steps, per-shard
                                              manifest status, saved mesh
@@ -739,6 +750,84 @@ def _cmd_lint(args):
     return _report_lint(results, args)
 
 
+def _cmd_opt(args):
+    """Offline run of the ``analysis/opt`` pass pipeline: optimize a
+    saved model (or zoo programs) and print the per-pass diff/stats
+    report — what ``PADDLE_TPU_OPT=1`` would do to this program inside
+    the executor, inspectable without running anything.  Exit 0 on a
+    clean run, 1 when any pass was sandwich-aborted, 2 on a bad
+    target."""
+    import json as _json
+
+    from paddle_tpu.analysis import cost
+    from paddle_tpu.analysis.opt import optimize_program
+
+    targets = []  # (label, program, feeds, fetches)
+    if args.zoo:
+        from paddle_tpu.models import ZOO_MODELS, build_train_program
+        names = ZOO_MODELS if args.zoo == "all" else [args.zoo]
+        for name in names:
+            try:
+                main, startup, feeds, fetches = build_train_program(
+                    name, backward=not args.no_backward)
+            except ValueError as e:
+                print(f"opt: {e}", file=sys.stderr)
+                return 2
+            targets.append((name, main, feeds, fetches))
+            targets.append((f"{name}/startup", startup, None, None))
+    elif args.target:
+        try:
+            program, feeds, fetches = _load_saved_program(args.target)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"opt: cannot load a program from {args.target!r}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+        targets.append((args.target, program, feeds, fetches))
+    else:
+        print("opt: need a MODEL_DIR or --zoo NAME|all",
+              file=sys.stderr)
+        return 2
+
+    passes = None
+    if args.passes:
+        passes = [s for s in args.passes.split(",") if s]
+
+    aborted = 0
+    reports = []
+    for label, program, feeds, fetches in targets:
+        try:
+            optimized, report = optimize_program(
+                program, feed_names=feeds, fetch_names=fetches,
+                passes=passes)
+        except ValueError as e:
+            print(f"opt: {e}", file=sys.stderr)
+            return 2
+        aborted += len(report.aborted_passes)
+        if args.json:
+            body = report.to_dict()
+            body["target"] = label
+            plan = getattr(optimized, "_donation_plan", None)
+            body["donation_plan"] = plan.to_dict() if plan else None
+            body["interpret"] = bool(getattr(optimized,
+                                             "_opt_interpret", False))
+            reports.append(body)
+        else:
+            print(f"== {label}")
+            print(report.format())
+            if report.flops_before is not None:
+                print(f"  cost: {report.flops_before:,} -> "
+                      f"{report.flops_after:,} static FLOPs")
+            if getattr(optimized, "_opt_interpret", False):
+                print("  amortization gate: run-once initializer — "
+                      "will interpret instead of compile")
+            plan = getattr(optimized, "_donation_plan", None)
+            if plan is not None:
+                print("  " + plan.report().splitlines()[0])
+    if args.json:
+        print(_json.dumps({"targets": reports}, indent=2))
+    return 1 if aborted else 0
+
+
 def _report_lint(results, args):
     """Shared tail of ``paddle_tpu lint``: print (or JSON-dump) a list
     of ``(label, AnalysisResult)`` and map findings to the exit code."""
@@ -1251,6 +1340,25 @@ def main(argv=None):
                    help="also print the warn-list of op types without "
                         "an inference rule")
     p.set_defaults(fn=_cmd_lint)
+
+    p = sub.add_parser("opt", help="run the Program-IR optimization "
+                                   "pipeline offline and print the "
+                                   "per-pass diff/stats report "
+                                   "(docs/static_analysis.md)")
+    p.add_argument("target", nargs="?", default=None,
+                   help="save_inference_model dir (or a __model__ json "
+                        "file) to optimize")
+    p.add_argument("--zoo", default=None,
+                   help="optimize a built-in model's forward+backward "
+                        "program instead (mnist|...|all)")
+    p.add_argument("--no-backward", action="store_true",
+                   help="with --zoo: the forward program only")
+    p.add_argument("--passes", default=None,
+                   help="comma-separated pass subset (default: the "
+                        "full pipeline)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.set_defaults(fn=_cmd_opt)
 
     p = sub.add_parser("ckpt",
                        help="survey a checkpoint directory: steps, "

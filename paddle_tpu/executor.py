@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu import framework
+from paddle_tpu.analysis import opmeta as _opmeta
 from paddle_tpu.framework import Program, default_main_program
 from paddle_tpu.obs.trace import span as _span, record_span as _record_span
 from paddle_tpu.place import CPUPlace, TPUPlace
@@ -254,14 +255,23 @@ def lower_block(block, env, rng_key, training, aux):
     from paddle_tpu import profiler as _profiler
     profiling = _profiler.op_profiling_enabled() and aux.get("interpret")
     release = aux.get("release", {}).get(block.idx)
+    rng_plan = aux.get("rng_plan")
     for i, op in enumerate(block.ops):
         if op.type in _SKIP_OPS:
             continue
         opdef = registry.resolve_lowering(op.type)
         key = None
         if rng_key is not None:
-            aux["rng_counter"] += 1
-            key = jax.random.fold_in(rng_key, aux["rng_counter"])
+            # one counter slot per op (optimization passes leave
+            # __rng_slots__ behind for ops they removed/fused, so
+            # surviving RNG consumers keep their exact key positions)
+            aux["rng_counter"] += op.attrs.get("__rng_slots__", 1)
+            if rng_plan is None or _opmeta.needs_rng_key(op, registry):
+                # under an opt-pipeline rng plan, ops statically proven
+                # key-free skip the fold_in — a traced threefry
+                # computation per op that XLA must carry through
+                # trace/lower/DCE for nothing
+                key = jax.random.fold_in(rng_key, aux["rng_counter"])
         ctx = registry.LowerContext(op, env, block, rng_key=key,
                                     training=training, aux=aux)
         if profiling:
@@ -328,6 +338,7 @@ class Executor:
         self._cache_inserts = 0  # lifetime insert count (eviction-proof)
         self._run_counter = 0
         self._verified = set()  # (id(program), version) PADDLE_TPU_VERIFY memo
+        self._opt_cache = {}    # (id, version, feeds, fetches) -> program
         _maybe_enable_compile_cache_from_env()
         from paddle_tpu import profiler as _profiler
         _profiler.install_jax_compile_listeners()
@@ -364,16 +375,52 @@ class Executor:
         fetch_list = fetch_list or []
         scope = scope if scope is not None else global_scope()
 
-        block = program.global_block()
         fetch_names = [f.name if isinstance(f, framework.Variable) else f
                        for f in fetch_list]
 
         if _env_flag("PADDLE_TPU_VERIFY"):
             self._maybe_verify(program, feed, fetch_names)
+        program = self._maybe_optimize(program, feed, fetch_names)
+        block = program.global_block()
 
         with _span("executor.run"):
             return self._run_traced(program, block, feed, fetch_names,
                                     scope, return_numpy, sentinel=sentinel)
+
+    # ------------------------------------------------------------------
+    def _maybe_optimize(self, program, feed, fetch_names):
+        """``PADDLE_TPU_OPT=1``: run the analysis/opt pass pipeline
+        over the program ONCE per ``(program, version, feeds,
+        fetches)`` before first compile — the executor then traces and
+        compiles the optimized clone.  Memoized exactly like the jit
+        cache: a cached step pays one dict lookup; mutating the program
+        (``bump_version``) re-optimizes.  The input program is never
+        mutated, and every pass is verify-sandwiched (a pass that
+        introduces any diagnostic reverts — see analysis/opt)."""
+        if not _env_flag("PADDLE_TPU_OPT"):
+            return program
+        if getattr(program, "_opt_report", None) is not None:
+            return program  # already an optimized clone (direct call)
+        key = (id(program), program._version, tuple(sorted(feed or ())),
+               tuple(fetch_names))
+        cached = self._opt_cache.get(key)
+        if cached is not None:
+            return cached
+        from paddle_tpu.analysis.opt import optimize_program
+        optimized, report = optimize_program(
+            program, feed_names=tuple(feed or ()),
+            fetch_names=tuple(fetch_names))
+        logger.debug("PADDLE_TPU_OPT: %r", report)
+        if getattr(program, "_release_memory", False):
+            # the interpret-mode early-release plan keys op indices —
+            # rebuild it against the optimized op list
+            from paddle_tpu.memory_optimization_transpiler import \
+                release_memory
+            release_memory(optimized)
+        if len(self._opt_cache) > 256:  # id()-reuse bound, not a cache
+            self._opt_cache.clear()
+        self._opt_cache[key] = optimized
+        return optimized
 
     # ------------------------------------------------------------------
     def _maybe_verify(self, program, feed, fetch_names):
@@ -652,12 +699,13 @@ class Executor:
         scope = scope if scope is not None else global_scope()
         steps = int(steps)
 
-        block = program.global_block()
         fetch_names = [f.name if isinstance(f, framework.Variable) else f
                        for f in fetch_list]
 
         if _env_flag("PADDLE_TPU_VERIFY"):
             self._maybe_verify(program, feed, fetch_names)
+        program = self._maybe_optimize(program, feed, fetch_names)
+        block = program.global_block()
 
         device = self._feed_device()
         per_step_feed = {}
@@ -1100,6 +1148,14 @@ class Executor:
         if interpret and not getattr(program, "expect_host_ops", False):
             _warn_host_op_cliff(program, block)
         interpret = interpret or _profiler.op_profiling_enabled()
+        # the opt pipeline's compile-amortization gate: a run-once
+        # initializer whose static cost proves the XLA compile can
+        # never pay for itself executes op-by-op eagerly instead
+        # (34-51% of the zoo's measured cold start; JAX PRNG is
+        # deterministic across eager and compiled, so init values are
+        # unchanged)
+        interpret = interpret or getattr(program, "_opt_interpret",
+                                         False)
 
         from paddle_tpu.lod import DynLoD, SPLITS_SUFFIX
         lod_map = {}
@@ -1144,7 +1200,12 @@ class Executor:
             env.update(inout_state)
             aux = {"rng_counter": 0, "scope": scope,
                    "lower_block": lower_block, "lod": dict(lod_map),
-                   "amp": amp, "interpret": interpret, "block": block}
+                   "amp": amp, "interpret": interpret, "block": block,
+                   # set only by the opt pipeline: ops statically
+                   # proven key-free skip their per-op fold_in
+                   "rng_plan": True
+                   if getattr(program, "_opt_rng_plan", False)
+                   else None}
             if release_map is not None:
                 stats = release_map[block.idx]["stats"]
                 stats["bytes"] = stats["vars"] = 0  # per-run measurement

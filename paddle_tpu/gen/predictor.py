@@ -106,12 +106,60 @@ class GenPredictor:
                          self._hbm_token)
         # per-bucket constant prefill feeds (causal bias template)
         self._tri = {}
+        # per-bucket static prefill FLOPs (analysis/cost): priced
+        # lazily, consumed by GenScheduler's admission budget
+        self._prefill_cost = {}
+        self._length_cost_fn = None
 
     # -- prefill -----------------------------------------------------------
     def _bucket(self, prompt_len):
         from paddle_tpu.lod import row_bucket
         b = row_bucket(prompt_len, edges=self.prompt_buckets)
         return min(b, self.max_len)
+
+    def _cost_fn(self):
+        """``flops(prompt_bucket)`` from the static cost model over the
+        BUNDLE's actual prefill program (the ISSUE-15 wiring: admission
+        weights and bucket planning price real programs, not guesses).
+        Takes the predictor lock: the fit PROBES the prefill program by
+        temporarily rewriting its feed var's length dim — that mutation
+        must never interleave with another fit or a concurrent trace."""
+        with self._lock:
+            if self._length_cost_fn is None:
+                from paddle_tpu.analysis import cost as _cost
+                probe = (self.prompt_buckets[0],
+                         max(self.prompt_buckets[-1],
+                             self.prompt_buckets[0] + 1))
+                self._length_cost_fn = _cost.row_cost_fn(
+                    self._pre_prog, batch_var=self._pre_feeds[0],
+                    dim=1, probe_rows=probe)
+            return self._length_cost_fn
+
+    def prefill_cost(self, prompt_len):
+        """Static FLOPs of prefilling a prompt of ``prompt_len`` tokens
+        (priced at its padded bucket — what the device actually runs).
+        The GenScheduler weighs admissions with this so one decode
+        iteration never stalls behind an unbounded prefill burst.
+        Cheap after the first call per bucket (one affine evaluation);
+        the underlying fit is warmed by GenScheduler construction."""
+        bucket = self._bucket(int(prompt_len))
+        hit = self._prefill_cost.get(bucket)
+        if hit is None:
+            hit = float(self._cost_fn()(bucket))
+            self._prefill_cost[bucket] = hit
+        return hit
+
+    def plan_prompt_buckets(self, observed_lengths, max_edges=4):
+        """Cost-optimal prompt buckets for an OBSERVED length
+        distribution: ``lod.select_bucket_edges`` weighted by the
+        prefill program's static FLOPs-per-bucket.  Returns a sorted
+        edge list (capped at the bundle's ``max_len``) an operator can
+        bake into the next export's ``gen_meta.json``."""
+        from paddle_tpu.lod import select_bucket_edges
+        lengths = [min(max(int(n), 1), self.max_len)
+                   for n in observed_lengths]
+        return select_bucket_edges(lengths, max_edges=max_edges,
+                                   cost_of=self._cost_fn())
 
     def _prefill_feed(self, prompt, bucket):
         from paddle_tpu.lod import pad_to_bucket

@@ -25,7 +25,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["DynLoD", "next_bucket", "row_bucket", "bucket_edges",
-           "bucket_ragged_feed", "pad_to_bucket", "SPLITS_SUFFIX"]
+           "select_bucket_edges", "bucket_ragged_feed", "pad_to_bucket",
+           "SPLITS_SUFFIX"]
 
 SPLITS_SUFFIX = "@lod0"
 
@@ -63,6 +64,65 @@ def bucket_edges(lo, hi, edges=None):
         if not out or b != out[-1]:
             out.append(b)
     return out
+
+
+def select_bucket_edges(counts, max_edges=4, cost_of=None):
+    """Cost-optimal bucket edges for an OBSERVED size distribution.
+
+    ``counts``: observed row counts / lengths (an iterable, repeats =
+    frequency).  ``cost_of(edge) -> cost`` prices one dispatch padded
+    to ``edge`` — pass :func:`paddle_tpu.analysis.cost.row_cost_fn`'s
+    result to price in static FLOPs of the actual program (the
+    ISSUE-15 wiring); default is the padded size itself.  Chooses at
+    most ``max_edges`` edges (each an observed value — padding to a
+    size nothing reaches is never optimal) minimizing the total padded
+    cost ``sum_n freq(n) * cost_of(edge(n))``, by interval dynamic
+    programming.  Returns a sorted edge list for
+    :func:`row_bucket`/:func:`bucket_edges`; sizes past the largest
+    edge still fall back to the power-of-two ladder there, so the jit
+    key stays bounded regardless."""
+    freq = {}
+    for n in counts:
+        n = max(int(n), 1)
+        freq[n] = freq.get(n, 0) + 1
+    if not freq:
+        return []
+    values = sorted(freq)
+    cost_of = cost_of or (lambda e: float(e))
+    k = min(int(max_edges), len(values))
+    # interval DP: cost(i, j) = all observations in values[i..j] pad to
+    # values[j]; best[j][e] = min total cost covering values[0..j] with
+    # e edges, the last at values[j]
+    m = len(values)
+    pad = [[0.0] * m for _ in range(m)]
+    for j in range(m):
+        c = float(cost_of(values[j]))
+        acc = 0.0
+        for i in range(j, -1, -1):
+            acc += freq[values[i]] * c
+            pad[i][j] = acc
+    INF = float("inf")
+    best = [[INF] * (k + 1) for _ in range(m)]
+    choice = [[None] * (k + 1) for _ in range(m)]
+    for j in range(m):
+        best[j][1] = pad[0][j]
+    for e in range(2, k + 1):
+        for j in range(e - 1, m):
+            for i in range(e - 2, j):
+                c = best[i][e - 1] + pad[i + 1][j]
+                if c < best[j][e]:
+                    best[j][e] = c
+                    choice[j][e] = i
+    e = min(range(1, k + 1), key=lambda e: best[m - 1][e])
+    edges = []
+    j = m - 1
+    while e >= 1:
+        edges.append(values[j])
+        prev = choice[j][e]
+        if e == 1 or prev is None:
+            break
+        j, e = prev, e - 1
+    return sorted(edges)
 
 
 def pad_to_bucket(value, bucket, axis=0):

@@ -62,6 +62,13 @@ BENCH_METRICS = {
     "elastic": {"resume_seconds": ("lower", 1.00),
                 "loss_delta_rel": ("max_abs", 1e-3),
                 "reshard_failures": ("max_abs", 0.0)},
+    # ISSUE-15 cold-start gate: the second-best per-model trace+compile
+    # reduction IS the "at least two zoo models improve >=15%"
+    # acceptance floor, and the steady step must stay ~1 (the passes
+    # may only remove work XLA would have DCE'd anyway)
+    "compile": {"reduction_best": ("higher", 0.35),
+                "reduction_second_best": ("higher", 0.35),
+                "step_time_ratio_worst": ("lower", 0.15)},
     "train_transformer": {"tokens_per_sec_per_chip": ("higher", 0.10),
                           "mfu": ("higher", 0.05),
                           # measured (cost-analysis-based) MFU from the
@@ -240,6 +247,13 @@ def summary_metrics(bench, summary):
                 "tokens_per_sec_ratio": summary["tokens_per_sec_ratio"],
                 "ttft_p99_ms": summary["ttft_p99_ms"]["continuous"],
                 "lost_requests": cont["failures"]}
+    if bench == "compile":
+        return {"reduction_best": summary["reduction_best"],
+                "reduction_second_best":
+                    summary["reduction_second_best"],
+                "models_ge_15pct": summary["models_ge_15pct"],
+                "step_time_ratio_worst":
+                    summary["step_time_ratio_worst"]}
     if bench == "elastic":
         return {"resume_seconds": summary["resume"]["restore_seconds"],
                 "loss_delta_rel": summary["loss_delta_rel"],
@@ -254,7 +268,7 @@ def summary_metrics(bench, summary):
         return out
     raise ValueError(f"no trajectory extraction for bench {bench!r} "
                      f"(known: serving, datapipe, fleet, decode, "
-                     f"elastic, train_transformer)")
+                     f"elastic, compile, train_transformer)")
 
 
 def add_record_args(parser):
@@ -291,12 +305,16 @@ def record_from_args(bench, summary, args, source, mfu_basis=None):
 # ---------------------------------------------------------------------------
 
 def _judge(direction, band, base, new):
-    """(ok, bound) under one tolerance band."""
+    """(ok, bound) under one tolerance band.  The relative slack is
+    ``|base| * band`` — for a NEGATIVE baseline, ``base * (1 - band)``
+    would tighten instead of loosen (a -2% compile-reduction baseline
+    must not fail an identical -2% run)."""
+    slack = abs(base) * band
     if direction == "higher":
-        bound = base * (1.0 - band)
+        bound = base - slack
         return new >= bound, bound
     if direction == "lower":
-        bound = base * (1.0 + band)
+        bound = base + slack
         return new <= bound, bound
     # max_abs: absolute ceiling above baseline
     bound = base + band

@@ -171,7 +171,12 @@ class ParallelExecutor(Executor):
             env.update(inout_state)
             aux = {"rng_counter": 0, "scope": scope,
                    "lower_block": lower_block, "mesh": mesh,
-                   "lod": dict(lod_map)}
+                   "lod": dict(lod_map),
+                   # opt-pipeline fact (see Executor._prepare): key-
+                   # free ops skip their per-op fold_in at trace time
+                   "rng_plan": True
+                   if getattr(program, "_opt_rng_plan", False)
+                   else None}
             lower_block(block, env, rng_key, training, aux)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in out_state_names if n in env}

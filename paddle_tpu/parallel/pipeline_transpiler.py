@@ -89,32 +89,17 @@ def _np_dtype(dtype):
 
 
 def _op_cost(op, block):
-    """Analytic op weight (same accounting as bench_resnet/bench.py)."""
-    try:
-        if op.type in ("conv2d", "depthwise_conv2d"):
-            filt = block.var(op.input("Filter")[0])
-            out = block.var(op.output("Output")[0])
-            co, ci, kh, kw = filt.shape
-            n, _, ho, wo = out.shape
-            return 2 * n * ho * wo * co * ci * kh * kw
-        if op.type in ("mul", "matmul"):
-            x = block.var(op.input("X")[0])
-            y = block.var(op.input("Y")[0])
-            k, n = y.shape[-2], y.shape[-1]
-            m = int(np.prod([d for d in x.shape if d and d > 0])) // max(
-                int(k), 1)
-            return 2 * m * int(k) * int(n)
-        if op.type == "scaled_dot_product_attention":
-            q = block.var(op.input("Q")[0])
-            b, h, s, d = q.shape
-            return 4 * b * h * s * s * d
-    except Exception:
-        pass
-    # sub-block ops (while/cond/DynamicRNN) are atomic: weigh them by
-    # their body so the quantile cuts see the FLOPs inside
+    """Per-op stage-balancing weight: the shared static cost model
+    (``analysis/cost.op_flops`` — the same per-op rules the optimizer
+    pipeline and GenScheduler admission ride, replacing this module's
+    former private three-op table, so the accountings can't drift).
+    Sub-block ops (while/cond/DynamicRNN) are atomic: weighed by their
+    body so the quantile cuts see the FLOPs inside."""
+    from paddle_tpu.analysis import cost as _cost
+    flops = _cost.op_flops(op, block, default=0)
     inner = sum(_op_cost(sub, blk)
                 for blk in _sub_blocks(op) for sub in blk.ops)
-    return 1 + inner
+    return 1 + flops + inner
 
 
 def _all_input_names(op, recurse=False):

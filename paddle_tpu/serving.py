@@ -579,7 +579,7 @@ class InferenceServer:
                  batching=False, max_batch_size=8, max_batch_delay=0.005,
                  batch_queue_size=128, warmup=False,
                  warmup_batch_sizes=None, gen_admission="continuous",
-                 gen_queue_size=64):
+                 gen_queue_size=64, gen_prefill_budget=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         from paddle_tpu.fault import chaos
@@ -590,7 +590,8 @@ class InferenceServer:
         self._gen = None          # GenScheduler for generation bundles
         self.gen_predictor = None
         self._gen_conf = {"admission": str(gen_admission),
-                          "queue_size": int(gen_queue_size)}
+                          "queue_size": int(gen_queue_size),
+                          "prefill_budget": gen_prefill_budget}
         self._ready = threading.Event()
         self._load_done = threading.Event()  # set on success OR failure
         self._load_error = None
@@ -638,7 +639,9 @@ class InferenceServer:
                     server._gen = GenScheduler(
                         gen_predictor,
                         queue_size=server._gen_conf["queue_size"],
-                        admission=server._gen_conf["admission"])
+                        admission=server._gen_conf["admission"],
+                        prefill_budget=server._gen_conf[
+                            "prefill_budget"])
                     server._ready.set()
                     return
                 predictor = Predictor(model_dir)

@@ -84,7 +84,11 @@ def test_gen_bundle_lints_clean(tmp_path, capsys):
 # types must either get rules or consciously raise the ceiling here
 # ---------------------------------------------------------------------------
 
-ZOO_UNCOVERED_CEILING = 13
+ZOO_UNCOVERED_CEILING = 2  # exactly {while, while_grad} — ISSUE-15
+# shrank 13 -> 2 by covering the LoD/array plumbing + lstm families
+# (shape inference is the prerequisite for the cost model's bytes
+# accounting); the two loop carriers propagate through their BODY ops'
+# rules instead
 
 #: op families frequent enough that losing their rules would blind the
 #: type checker across most of the zoo (the satellite's shrink target)
@@ -97,6 +101,12 @@ MUST_BE_COVERED = {
     "softmax_with_cross_entropy_grad", "lstm_grad",
     "sequence_pool_grad", "increment", "less_than", "sequence_pool",
     "sequence_expand", "assign_value", "max_sequence_len",
+    # ISSUE-15: the families the cost model needs (bytes costing rides
+    # their shape propagation) — they may never fall off again
+    "lstm", "write_to_array", "read_from_array", "array_to_lod_tensor",
+    "lod_tensor_to_array", "reorder_lod_tensor_by_rank",
+    "lod_rank_table", "write_to_array_grad", "array_to_lod_tensor_grad",
+    "lod_tensor_to_array_grad", "reorder_lod_tensor_by_rank_grad",
 }
 
 

@@ -1,0 +1,65 @@
+"""bench_compile smoke: the cold-start A/B harness must produce its
+schema (subprocess-isolated baseline/optimized runs), its trajectory
+extraction must round-trip through `paddle_tpu bench check`, and a
+degraded run must fail the gate."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+import bench_compile  # noqa: E402
+from paddle_tpu.obs import bench_history  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def smoke_summary():
+    return bench_compile.run_bench(smoke=True)
+
+
+def test_summary_schema(smoke_summary):
+    assert {"bench", "smoke", "models", "reduction_best",
+            "reduction_second_best", "models_ge_15pct",
+            "step_time_ratio_worst"} <= set(smoke_summary)
+    (model,) = smoke_summary["models"].values()
+    assert {"cold_start_seconds", "captured_phase_seconds",
+            "reduction", "steady_step_ms",
+            "step_time_ratio"} <= set(model)
+    assert model["cold_start_seconds"]["baseline"] > 0
+    assert model["cold_start_seconds"]["optimized"] > 0
+    assert model["step_time_ratio"] > 0
+
+
+def test_opt_report_is_carried(smoke_summary):
+    (model,) = smoke_summary["models"].values()
+    rep = model["opt_report"]
+    assert rep is not None
+    assert {p["pass"] for p in rep["passes"]} >= {
+        "constant_fold", "cse", "dce", "fuse_elementwise",
+        "donation_plan", "amortize"}
+    assert not [p for p in rep["passes"] if p["status"] == "aborted"]
+
+
+def test_trajectory_record_and_check_gate(smoke_summary, tmp_path):
+    path = str(tmp_path / "traj.json")
+    metrics = bench_history.summary_metrics("compile", smoke_summary)
+    assert set(metrics) == {"reduction_best", "reduction_second_best",
+                            "models_ge_15pct", "step_time_ratio_worst"}
+    bench_history.record("compile", metrics, path=path, baseline=True,
+                         source="test")
+    report = bench_history.check(path=path)
+    assert report["ok"], report
+    # a regressed run (compile reduction collapsed, steady step 2x)
+    bench_history.record(
+        "compile",
+        {"reduction_best": 0.0, "reduction_second_best": 0.0,
+         "models_ge_15pct": 0.0,
+         "step_time_ratio_worst":
+             metrics["step_time_ratio_worst"] * 2.0},
+        path=path, source="test-degraded")
+    report = bench_history.check(path=path)
+    assert not report["ok"]
+    regressed = {r["metric"]
+                 for r in report["benches"]["compile"]["regressions"]}
+    assert "step_time_ratio_worst" in regressed

@@ -1,0 +1,122 @@
+"""Scanner test for the shared op-metadata registry
+(paddle_tpu/analysis/opmeta.py): the pure/effectful/stateful/sub-block
+classification has ONE owner — if the dead-op lint, the optimization
+passes, or the cost model grew a private effect-op list, a pass could
+delete what a lint protects.  This test fails any module that does."""
+
+import ast
+import os
+import re
+
+import paddle_tpu
+from paddle_tpu import layers
+from paddle_tpu.analysis import lints, opmeta
+from paddle_tpu.analysis.opt import passes as opt_passes
+
+SRC_ROOT = os.path.dirname(os.path.abspath(paddle_tpu.__file__))
+
+#: markers of a home-grown effect classification: any module (other
+#: than opmeta) defining a frozenset/set literal containing BOTH
+#: "channel_send" and "save_combine" is re-growing the effect-op list
+_EFFECT_MARKERS = ("channel_send", "save_combine")
+
+
+def _iter_sources():
+    for dirpath, _, names in os.walk(SRC_ROOT):
+        for n in sorted(names):
+            if n.endswith(".py"):
+                path = os.path.join(dirpath, n)
+                with open(path) as f:
+                    yield path, f.read()
+
+
+def test_effect_op_list_has_one_owner():
+    owners = []
+    for path, text in _iter_sources():
+        if all(m in text for m in _EFFECT_MARKERS):
+            owners.append(os.path.relpath(path, SRC_ROOT))
+    assert owners == [os.path.join("analysis", "opmeta.py")], (
+        f"effect-op classification found outside the shared registry: "
+        f"{owners} — import paddle_tpu.analysis.opmeta instead of "
+        f"re-declaring the list")
+
+
+def test_consumers_bind_the_shared_predicates():
+    # the dead-op lint's exemption predicate IS the registry's
+    assert lints._has_effects is opmeta.has_effects
+    # the passes module resolves eligibility through the registry
+    src = open(opt_passes.__file__).read()
+    assert "opmeta.is_pure" in src and "opmeta.has_effects" in src
+    # fusion's allow-list is the registry's, not a local copy
+    assert "ELEMENTWISE_PURE_OPS" not in re.sub(
+        r"opmeta\.ELEMENTWISE_PURE_OPS", "", src)
+
+
+def test_classification_sanity():
+    import paddle_tpu as fluid
+    from paddle_tpu.ops import registry
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        h = layers.fc(x, 4, act="relu")
+        d = fluid.layers.dropout(h, dropout_prob=0.5)
+        cost = fluid.layers.mean(h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    block = main.global_block()
+    by_type = {}
+    for op in block.ops:
+        by_type.setdefault(op.type, op)
+
+    relu = by_type["relu"]
+    assert opmeta.is_pure(relu, block, registry)
+    assert not opmeta.needs_rng_key(relu, registry)
+    assert relu.type in opmeta.ELEMENTWISE_PURE_OPS
+
+    dropout = by_type["dropout"]
+    assert opmeta.has_effects(dropout, registry)      # RNG = effect
+    assert opmeta.needs_rng_key(dropout, registry)
+    assert dropout.type not in opmeta.ELEMENTWISE_PURE_OPS
+
+    sgd = by_type["sgd"]
+    assert opmeta.has_effects(sgd, registry)          # in-place state
+    assert opmeta.stateful_output_names(sgd, registry)
+    assert opmeta.writes_persistable(sgd, block)
+
+    # unknown op types classify conservatively
+    from paddle_tpu.framework import Operator
+    mystery = Operator(block, "never_registered",
+                       {"X": ["x"]}, {"Out": ["m"]}, {})
+    assert opmeta.needs_rng_key(mystery, registry)
+
+    # grads of RNG-free forwards never get keys; grads of RNG forwards do
+    relu_grad = Operator(block, "relu_grad", {}, {}, {})
+    assert not opmeta.needs_rng_key(relu_grad, registry)
+    dropout_grad = by_type.get("dropout_grad")
+    # (dropout registers an explicit key-free grad lowering, and it is
+    # registered — so lookup succeeds and uses_rng is False)
+    if dropout_grad is not None:
+        assert not opmeta.uses_rng(dropout_grad, registry)
+
+
+def test_sub_block_ops_classify_effectful():
+    import paddle_tpu as fluid
+    from paddle_tpu.ops import registry
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32",
+                        append_batch_size=False)
+        i = fluid.layers.zeros(shape=[1], dtype="int64")
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64",
+                                       value=3)
+        cond = fluid.layers.less_than(x=i, y=n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            fluid.layers.increment(x=i, in_place=True)
+            fluid.layers.less_than(x=i, y=n, cond=cond)
+    block = main.global_block()
+    while_op = next(op for op in block.ops if op.type == "while")
+    assert opmeta.has_sub_block(while_op)
+    assert opmeta.has_effects(while_op, registry)
+    assert opmeta.needs_rng_key(while_op, registry)  # body may use RNG

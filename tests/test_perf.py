@@ -204,6 +204,51 @@ class TestAnalyticalFlopsCrossCheck:
             f"the declared band [{lo}, {hi}]")
 
 
+class TestStaticCostModelCrossCheck:
+    """ISSUE-15: the static per-op cost model (`analysis/cost`) pinned
+    against XLA `cost_analysis()` zoo-wide, so all THREE accountings —
+    the bench formula (tested above), the cost rules, and XLA — stay
+    mutually anchored.  Measured static/XLA ratios on this backend:
+    mnist 1.01, resnet 1.46, vgg 1.25, transformer 0.74, gen_lm 0.88
+    (XLA undercounts fused backward convs; the static model undercounts
+    unknown-shape LoD chains) — the declared band catches ~2x drift of
+    either accounting on any model.  seq2seq/stacked_lstm run in
+    op-by-op interpret mode (no compiled executable, no XLA record) and
+    are covered by the estimate-level assertions in test_cost.py."""
+
+    BAND = (0.5, 1.75)
+
+    @pytest.mark.parametrize("name", [
+        "mnist", "transformer", "gen_lm",
+        pytest.param("resnet", marks=pytest.mark.slow),
+        pytest.param("vgg", marks=pytest.mark.slow),
+    ])
+    def test_static_flops_within_declared_band_of_xla(self, name):
+        from paddle_tpu.analysis import cost
+        from paddle_tpu.models import build_train_program, synth_feed
+
+        main, startup, feeds, fetches = build_train_program(name)
+        static = cost.estimate(main).total_flops
+        assert static > 0
+        before = {r["key"] for r in perf.records()}
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            exe.run(main, feed=synth_feed(main, feeds),
+                    fetch_list=fetches, scope=scope)
+        recs = [r for r in perf.records()
+                if r["key"] not in before and r["flops"]]
+        assert recs, f"{name}: no XLA cost record captured"
+        xla = max(r["flops"] for r in recs)
+        ratio = static / xla
+        lo, hi = self.BAND
+        assert lo <= ratio <= hi, (
+            f"{name}: static-cost/XLA FLOPs ratio {ratio:.3f} left the "
+            f"declared band [{lo}, {hi}] — a cost rule (or XLA's "
+            f"accounting) drifted")
+
+
 class TestHbmCensus:
     def test_scope_attribution_and_watermark(self):
         main, startup, loss = _build_fc_train(size=24)
