@@ -5,8 +5,10 @@ green?": the model zoo lints clean (single-program AND as the
 transpiled families the distributed verifier covers), every
 scanner-enforced registry — diagnostic codes, metric names, chaos
 failpoints — agrees with its documentation table, the SLO spec schema
-validates (example + any armed ``PADDLE_TPU_SLO`` file), and the bench
-trajectory's schema is intact (``bench check --dry``).  The pytest suite
+validates (example + any armed ``PADDLE_TPU_SLO`` file), the autoscaler
+policy schema validates (example + any armed ``PADDLE_TPU_AUTOSCALE``
+file), and the bench trajectory's schema is intact
+(``bench check --dry``).  The pytest suite
 enforces the same invariants test-by-test; this module re-runs them as
 a deployable command (no pytest, no tests/ checkout needed) so drift
 fails a release gate, not a 3am dashboard hunt.
@@ -281,6 +283,27 @@ def _check_slo_spec():
     return _section("slo-spec", detail, failures)
 
 
+def _check_controller_policy():
+    """The autoscaler policy schema validator runs against the
+    documented example policy AND against the operator's armed
+    ``PADDLE_TPU_AUTOSCALE`` file when set — a malformed policy fails
+    HERE, not as a disarmed controller discovered mid-incident."""
+    from paddle_tpu.fleet import controller
+
+    failures = [f"EXAMPLE_POLICY: {p}"
+                for p in controller.validate_policy(
+                    controller.EXAMPLE_POLICY)]
+    path = os.environ.get(controller.POLICY_ENV, "").strip()
+    detail = "example policy"
+    if path:
+        detail += f" + {controller.POLICY_ENV}={path}"
+        try:
+            controller.load_policy(path)
+        except (OSError, ValueError) as e:
+            failures.extend(str(e).splitlines())
+    return _section("controller-policy", detail, failures)
+
+
 def _check_ckpt_manifest():
     """Checkpoint-manifest schema gate: write a fresh SHARD-format
     checkpoint (synthetic state, no executor, no program) through the
@@ -477,6 +500,7 @@ def run_selfcheck():
         _check_metric_registry(),
         _check_failpoint_registry(),
         _check_slo_spec(),
+        _check_controller_policy(),
         _check_opt(),
         _check_bench_trajectory(),
         _check_ckpt_manifest(),

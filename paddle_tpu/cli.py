@@ -20,6 +20,12 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              the replica in a fleet)
   router  --master HOST:PORT --port P        health-aware fleet router
                                              (or --replicas a,b,c)
+  controller --master H:P --model DIR        router + closed-loop
+                                             autoscaler: warm-standby
+                                             scale-up, idle drain,
+                                             admission backpressure
+                                             (--policy POLICY.json or
+                                             PADDLE_TPU_AUTOSCALE)
   stats   --addr HOST:PORT                   runtime metrics snapshot of
                                              a serving replica (/stats);
                                              --local for this process;
@@ -274,6 +280,50 @@ def _cmd_router(args):
         router.serve_forever()
     except KeyboardInterrupt:
         pass
+    return 0
+
+
+def _cmd_controller(args):
+    """Serve the fleet router WITH the closed control loop in-process:
+    a FleetController senses SLO pressure / scraper rollups and scales
+    a warm standby pool of replicas built from --model (pre-warmed
+    through PADDLE_TPU_COMPILE_CACHE when set)."""
+    import itertools
+
+    from paddle_tpu.fault import GracefulShutdown
+    from paddle_tpu.fleet import FleetController, FleetReplica, \
+        FleetRouter
+    if args.compile_cache:
+        # before any standby's Executor exists, so warms hit the cache
+        os.environ["PADDLE_TPU_COMPILE_CACHE"] = args.compile_cache
+    router = FleetRouter(master_addr=args.master,
+                         host=args.host, port=args.port,
+                         default_deadline=args.default_deadline,
+                         poll_interval=args.poll_interval,
+                         slo_spec=args.slo or None)
+    router.start_background()
+    seq = itertools.count()
+
+    def factory():
+        return FleetReplica(args.model, args.master,
+                            replica_id=f"auto-{os.getpid()}-{next(seq)}",
+                            lease_ttl=args.lease_ttl, warmup=True)
+
+    controller = FleetController(router, policy=args.policy or None,
+                                 standby_factory=factory)
+    warmed = controller.prewarm(raise_on_failure=False)
+    controller.start()
+    print(f"fleet controller on {router.addr[0]}:{router.addr[1]} "
+          f"(master {args.master}; policy "
+          f"{controller.policy.source or 'defaults'}; "
+          f"{warmed} standby(s) warm)", flush=True)
+    try:
+        with GracefulShutdown() as stop:
+            stop.wait()
+    except KeyboardInterrupt:
+        pass
+    controller.shutdown(drain_owned=True)
+    router.shutdown()
     return 0
 
 
@@ -1216,6 +1266,39 @@ def main(argv=None):
                         "counters + post-mortem on sustained breach; "
                         "default: PADDLE_TPU_SLO when set)")
     p.set_defaults(fn=_cmd_router)
+
+    p = sub.add_parser("controller",
+                       help="fleet router + closed-loop autoscaler "
+                            "(warm-standby scale-up, idle drain, "
+                            "admission-control backpressure)")
+    p.add_argument("--master", required=True,
+                   help="HOST:PORT of the fleet master (replica "
+                        "discovery AND standby enrollment)")
+    p.add_argument("--model", required=True,
+                   help="save_inference_model dir standbys serve")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8868)
+    p.add_argument("--policy", default=None, metavar="POLICY.json",
+                   help="autoscaler policy (default: "
+                        "PADDLE_TPU_AUTOSCALE when set, else the "
+                        "documented defaults; `paddle_tpu selfcheck` "
+                        "validates the schema)")
+    p.add_argument("--slo", default=None, metavar="SPEC.json",
+                   help="SLO spec the controller steers by (default: "
+                        "PADDLE_TPU_SLO when set)")
+    p.add_argument("--default-deadline", type=float, default=30.0,
+                   help="end-to-end budget seconds for requests without "
+                        "an X-Deadline-Ms header")
+    p.add_argument("--poll-interval", type=float, default=0.25,
+                   help="master discovery poll interval seconds")
+    p.add_argument("--lease-ttl", type=float, default=5.0,
+                   help="fleet lease TTL seconds for promoted standbys")
+    p.add_argument("--compile-cache", default=None,
+                   help="persistent XLA compilation cache dir "
+                        "(PADDLE_TPU_COMPILE_CACHE): standby warms "
+                        "reuse compiled executables — scale-up is a "
+                        "lease registration, not a compile")
+    p.set_defaults(fn=_cmd_controller)
 
     p = sub.add_parser("stats", help="fetch a serving replica's /stats "
                                      "metrics snapshot")

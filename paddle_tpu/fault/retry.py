@@ -14,7 +14,7 @@ import random
 import time
 
 __all__ = ["RetryPolicy", "RetryError", "retrying", "DEFAULT_RPC_POLICY",
-           "parse_hostport", "parse_deadline_ms"]
+           "parse_hostport", "parse_deadline_ms", "parse_retry_after"]
 
 
 def parse_hostport(addr):
@@ -43,6 +43,28 @@ def parse_deadline_ms(value):
     if not math.isfinite(budget):
         raise ValueError(f"non-finite deadline {value!r}")
     return budget
+
+
+def parse_retry_after(value):
+    """Seconds from a ``Retry-After`` header value, or None when
+    absent/unparseable.  Only the delta-seconds form is supported (the
+    fleet's own sheds emit it; HTTP-date senders fall back to the
+    default backoff) and negative/non-finite values are rejected as
+    None — a malformed hint must degrade to the policy's own backoff,
+    never produce a negative sleep."""
+    import math
+    if not isinstance(value, str):
+        return None
+    value = value.strip()
+    if not value:
+        return None
+    try:
+        secs = float(value)
+    except ValueError:
+        return None
+    if not math.isfinite(secs) or secs < 0:
+        return None
+    return secs
 
 
 class RetryError(RuntimeError):
@@ -106,6 +128,19 @@ class RetryPolicy:
             delay *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
         return max(0.0, delay)
 
+    def hinted_delay(self, hint):
+        """Sleep for a server-supplied ``Retry-After`` hint: the hint
+        capped at ``max_delay``, under the policy's own jitter mode —
+        with full jitter the N clients a shedding server just bounced
+        drain back spread over the hinted window instead of returning
+        in one synchronized wave."""
+        base = min(max(0.0, float(hint)), self.max_delay)
+        if self.jitter == "full":
+            return random.uniform(0.0, base)
+        if self.jitter:
+            base *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
+        return max(0.0, base)
+
     def call(self, fn, *args, on_retry=None, deadline=None, **kwargs):
         """Run ``fn(*args, **kwargs)``, retrying on ``self.retryable``.
 
@@ -129,15 +164,32 @@ class RetryPolicy:
                 if attempt >= self.max_attempts:
                     raise RetryError(
                         f"gave up after {attempt} attempts: {e}", e) from e
-                delay = self.backoff(attempt)
-                if deadline is not None:
-                    remaining = deadline - (time.monotonic() - start)
-                    if delay > remaining:
-                        raise RetryError(
-                            f"deadline {deadline}s exceeded after "
-                            f"{attempt} attempts ({remaining:.3f}s "
-                            f"remaining < next backoff {delay:.3f}s): "
-                            f"{e}", e) from e
+                hint = getattr(e, "retry_after", None)
+                if hint is not None:
+                    # server-paced backoff: sleep the Retry-After hint
+                    # (jittered, capped) clamped to the remaining budget
+                    # — a shedding server's hint should never make us
+                    # abandon a request the deadline still allows
+                    delay = self.hinted_delay(hint)
+                    if deadline is not None:
+                        remaining = deadline - (time.monotonic() - start)
+                        if remaining <= 0.01:
+                            raise RetryError(
+                                f"deadline {deadline}s exceeded after "
+                                f"{attempt} attempts (Retry-After "
+                                f"{hint}s hinted, {remaining:.3f}s "
+                                f"remaining): {e}", e) from e
+                        delay = min(delay, max(0.0, remaining - 0.005))
+                else:
+                    delay = self.backoff(attempt)
+                    if deadline is not None:
+                        remaining = deadline - (time.monotonic() - start)
+                        if delay > remaining:
+                            raise RetryError(
+                                f"deadline {deadline}s exceeded after "
+                                f"{attempt} attempts ({remaining:.3f}s "
+                                f"remaining < next backoff {delay:.3f}s): "
+                                f"{e}", e) from e
                 if on_retry is not None:
                     on_retry(attempt, e, delay)
                 time.sleep(delay)

@@ -20,14 +20,26 @@ machinery at inference:
   request traceable across replicas.
 - the client-side alternative: ``ServingClient(master=...)`` (or a
   list of addresses) balances and fails over without a router hop.
+- :class:`~paddle_tpu.fleet.controller.FleetController` — the closed
+  control loop: SLO pressure and scraper rollups in, scale-up from a
+  warm-standby pool / idle drain / admission-control backpressure
+  (429 + Retry-After via the router's degradation ladder) out.
+- :class:`~paddle_tpu.fleet.traffic.TrafficReplay` — the load side:
+  open-loop traffic replay (diurnal ramps, flash crowds, heavy-tailed
+  prompt mixes) that drills the control loop under chaos.
 
 See ``docs/serving_fleet.md`` for topology, failover semantics, the
-rolling-restart runbook, and the chaos drills.
+rolling-restart runbook, the autoscaling/backpressure runbook, and
+the chaos drills.
 """
 
 from __future__ import annotations
 
+from paddle_tpu.fleet.controller import ControllerPolicy, \
+    FleetController, load_policy
 from paddle_tpu.fleet.replica import FleetReplica
 from paddle_tpu.fleet.router import FleetRouter
+from paddle_tpu.fleet.traffic import TrafficReplay
 
-__all__ = ["FleetReplica", "FleetRouter"]
+__all__ = ["FleetReplica", "FleetRouter", "FleetController",
+           "ControllerPolicy", "load_policy", "TrafficReplay"]

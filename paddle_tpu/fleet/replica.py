@@ -75,21 +75,27 @@ class FleetReplica:
         self._epoch = None
 
     # -- lifecycle ---------------------------------------------------------
-    def start(self, ready_timeout=300.0):
-        """Serve, wait for readiness (load + warmup), THEN register.
+    def warm(self, ready_timeout=300.0):
+        """Serve and wait for readiness (load + warmup) WITHOUT
+        registering: the warm-standby half of :meth:`start`.
+
+        A warmed replica has paid its model load and AOT warmup — with
+        ``PADDLE_TPU_COMPILE_CACHE`` set, through the persistent
+        compile cache — but takes no traffic: the router never
+        discovers it until :meth:`enroll` registers the lease.  This is
+        the fleet controller's standby pool shape: scale-up becomes a
+        registration (milliseconds), not a compile (minutes).
 
         Also labels this process's timeline row for merged fleet traces
         (``obs.trace.set_process_name``; first caller wins, so an
-        operator-chosen name is never overwritten).
-
-        Registration is deliberately last: the router must never
-        discover a replica whose `/readyz` would still say 503 — a
-        rolling-restart replacement enters the table only once it can
-        serve at full speed.  Raises if the model load failed — and a
-        failed start tears down what it already built (listener, master
-        connection), so the caller is not left with a leaked port it
-        has no handle to drain."""
+        operator-chosen name is never overwritten).  Raises if the
+        model load failed — and a failed warm tears down what it
+        already built (listener, master connection), so the caller is
+        not left with a leaked port it has no handle to drain.
+        Idempotent once warmed."""
         from paddle_tpu.obs import trace as _trace
+        if self._serve_thread is not None:
+            return self
         _trace.set_process_name(f"replica:{self.replica_id}")
         self._serve_thread = self.server.start_background()
         try:
@@ -97,6 +103,34 @@ class FleetReplica:
                 raise TimeoutError(
                     f"replica {self.replica_id} not ready in "
                     f"{ready_timeout}s")
+        except BaseException:
+            self._stop.set()
+            try:
+                self.server.shutdown()
+            except Exception:
+                pass
+            try:
+                self._master.close()
+            except Exception:
+                pass
+            raise
+        return self
+
+    def enroll(self):
+        """Register a WARMED replica with the master and start the
+        heartbeat thread — the promotion half of :meth:`start`, and the
+        fleet controller's scale-up primitive.  Registration is
+        deliberately after readiness: the router must never discover a
+        replica whose `/readyz` would still say 503.  Raises
+        ``RuntimeError`` when called before :meth:`warm`; idempotent
+        once enrolled."""
+        if self._serve_thread is None:
+            raise RuntimeError(
+                f"replica {self.replica_id} not warmed: call warm() "
+                f"before enroll()")
+        if self._hb_thread is not None:
+            return self
+        try:
             self._register()
         except BaseException:
             self._stop.set()
@@ -113,6 +147,13 @@ class FleetReplica:
             target=self._beat_loop, daemon=True,
             name=f"fleet-hb-{self.replica_id}")
         self._hb_thread.start()
+        return self
+
+    def start(self, ready_timeout=300.0):
+        """Serve, wait for readiness (load + warmup), THEN register:
+        :meth:`warm` + :meth:`enroll`."""
+        self.warm(ready_timeout)
+        self.enroll()
         return self
 
     def _register(self):

@@ -119,6 +119,15 @@ class FleetRouter:
                      else _slo.watchdog_from_env())
         if self._slo is not None:
             self._slo.start()
+        # graceful-degradation ladder (admission control): level 0
+        # admits everything; a controller raises the level to shed a
+        # growing fraction of arrivals at the door with 429 +
+        # Retry-After INSTEAD of queueing them into a deadline timeout
+        self._admission_lock = threading.Lock()
+        self._admission = {"level": 0, "shed_fraction": 0.0,
+                           "retry_after_s": 1.0, "reason": "",
+                           "since_unix": time.time()}
+        self._admission_acc = 0.0  # Bresenham-style shed accumulator
         _trace.set_process_name("router")
         router = self
 
@@ -128,10 +137,13 @@ class FleetRouter:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _reply_raw(self, code, body, content_type):
+            def _reply_raw(self, code, body, content_type,
+                           extra_headers=None):
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 rid = getattr(self, "_request_id", None)
                 if rid:
                     self.send_header("X-Request-Id", rid)
@@ -180,6 +192,7 @@ class FleetRouter:
                         "replicas": router.table(),
                         "failovers": [list(f) for f in
                                       router.failover_log],
+                        "admission": router.admission_state(),
                     }
                     # per-replica MFU / HBM headroom from the latest
                     # federation pass (empty before the first
@@ -254,6 +267,13 @@ class FleetRouter:
                     return
                 if budget is None:
                     budget = router._default_deadline
+                # admission control runs BEFORE any routing work: a
+                # shed request costs the fleet one header parse, not a
+                # queued attempt that burns its own deadline
+                shed = router.admit(budget)
+                if shed is not None:
+                    self._reply_raw(*shed)
+                    return
                 if self.path == "/generate":
                     # streamed generation: chunks are forwarded to the
                     # caller AS the replica produces them — time-to-
@@ -261,9 +281,9 @@ class FleetRouter:
                     router.route_stream(self, raw, self._request_id,
                                         budget)
                     return
-                code, body, ctype = router.route(
+                code, body, ctype, headers = router.route(
                     self.path, raw, self._request_id, budget)
-                self._reply_raw(code, body, ctype)
+                self._reply_raw(code, body, ctype, headers)
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.addr = self._server.server_address
@@ -351,7 +371,18 @@ class FleetRouter:
         # would pin ALL low-concurrency traffic to the smallest address
         import random
         random.shuffle(pool)
-        pool.sort(key=lambda e: e[0])
+        # equal-outstanding ties break toward the replica with the most
+        # HBM headroom in the latest federation pass (cost-model
+        # placement: the replica closest to OOM is the worst home for
+        # new work); replicas without scrape evidence sort neutral
+        perf = self._scraper.last_perf()
+
+        def load_key(e):
+            o, a = e
+            head = (perf.get(a) or {}).get("hbm.headroom_bytes")
+            return (o, 0.0 if head is None else -float(head))
+
+        pool.sort(key=load_key)
         return pool[0][1]
 
     def _mark_down(self, addr):
@@ -395,11 +426,117 @@ class FleetRouter:
             timeout=self._scrape_timeout))
         return _aggregate.assemble_fleet_trace(sources)
 
+    # -- admission control (graceful-degradation ladder) -------------------
+    def set_admission(self, level, shed_fraction, retry_after_s=1.0,
+                      reason=""):
+        """Set the degradation rung: shed ``shed_fraction`` of incoming
+        POSTs at the door with ``429`` + ``Retry-After:
+        retry_after_s`` (clamped per request to the caller's own
+        ``X-Deadline-Ms`` budget).  Level 0 / fraction 0 admits
+        everything.  Called by the fleet controller as SLO pressure
+        builds and recedes; ``reason`` lands in ``/stats`` so an
+        operator can see WHY the fleet is shedding."""
+        from paddle_tpu import profiler as _profiler
+        level = max(0, int(level))
+        shed_fraction = min(1.0, max(0.0, float(shed_fraction)))
+        with self._admission_lock:
+            changed = level != self._admission["level"]
+            self._admission = {
+                "level": level,
+                "shed_fraction": shed_fraction,
+                "retry_after_s": max(0.0, float(retry_after_s)),
+                "reason": str(reason),
+                "since_unix": (time.time() if changed
+                               else self._admission["since_unix"]),
+            }
+            if changed:
+                self._admission_acc = 0.0
+        _profiler.runtime_metrics.set_gauge("fleet.admission_level",
+                                            level)
+
+    def admission_state(self):
+        """The current rung (the ``/stats`` ``router.admission`` body)."""
+        with self._admission_lock:
+            return dict(self._admission)
+
+    def admit(self, budget):
+        """Admission decision for ONE arriving request: None to admit,
+        or a ready-to-send ``(429, body, content_type, headers)`` shed.
+        Sheds are spread evenly through the arrival stream (error-
+        accumulator, not random draws: a 25% shed rung bounces exactly
+        every 4th request, so a short probe burst can never be
+        all-unlucky), and the ``Retry-After`` hint is clamped to the
+        caller's remaining deadline budget — a hint the caller cannot
+        possibly wait out is just a slower timeout."""
+        with self._admission_lock:
+            frac = self._admission["shed_fraction"]
+            if frac <= 0.0:
+                return None
+            self._admission_acc += frac
+            if self._admission_acc < 1.0:
+                return None
+            self._admission_acc -= 1.0
+            level = self._admission["level"]
+            reason = self._admission["reason"]
+            hint = self._admission["retry_after_s"]
+        from paddle_tpu import profiler as _profiler
+        _profiler.runtime_metrics.inc("fleet.admission_shed")
+        retry_after = hint if budget is None \
+            else max(0.0, min(hint, float(budget)))
+        body = json.dumps({
+            "error": {"type": "admission_shed",
+                      "message": f"fleet shedding at degradation level "
+                                 f"{level}" + (f": {reason}" if reason
+                                               else "")},
+            "retryable": True,
+            "degrade_level": level,
+            "retry_after_s": retry_after,
+        }).encode()
+        return 429, body, "application/json", \
+            {"Retry-After": f"{retry_after:.3f}"}
+
+    def _shed_hint(self, deadline_at):
+        """Retry-After for a router-GENERATED shed (503/504): the
+        admission ladder's current pacing hint, clamped to the caller's
+        remaining budget when any is left (a caller whose budget is
+        gone gets the unclamped hint for its NEXT request)."""
+        with self._admission_lock:
+            hint = self._admission["retry_after_s"] or 1.0
+        remaining = deadline_at - time.monotonic()
+        if remaining > 0:
+            hint = min(hint, remaining)
+        return max(0.0, hint)
+
+    def _alternative_with_headroom(self, addr):
+        """True when the latest federation pass shows a DIFFERENT live
+        replica plausibly able to absorb a request the replica at
+        ``addr`` just shed with 429 — the gate on treating an upstream
+        429 as retryable-elsewhere.  Requires scrape EVIDENCE: before
+        the first pass (or when no candidate answered it) the answer is
+        False and the 429 passes through verbatim, so clients back off
+        instead of the router hammering a uniformly saturated fleet."""
+        ok = self._scraper.last_ok()
+        if not ok:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            me = self._table.get(addr)
+            my_out = (me["outstanding"] if me is not None
+                      else float("inf"))
+            for a, e in self._table.items():
+                if a == addr or e["down_until"] > now or a not in ok:
+                    continue
+                if e["outstanding"] <= my_out:
+                    return True
+        return False
+
     # -- request path ------------------------------------------------------
     def route(self, path, raw, request_id, budget):
-        """Forward one request; returns ``(status, body, content_type)``.
-        Every terminal failure the router *generates* is a structured
-        retryable error — the client's own policy decides what to do."""
+        """Forward one request; returns ``(status, body, content_type,
+        extra_headers)``.  Every terminal failure the router
+        *generates* is a structured retryable error with a
+        ``Retry-After`` pacing hint — the client's own policy decides
+        what to do."""
         from paddle_tpu import profiler as _profiler
         from paddle_tpu.fault.retry import RetryError
         deadline_at = time.monotonic() + budget
@@ -426,7 +563,7 @@ class FleetRouter:
             with _trace.trace_context(request_id), \
                     _span("fleet.request", request_id=request_id,
                           path=path):
-                status, body, ctype = self._retry.call(
+                status, body, ctype, headers = self._retry.call(
                     attempt, on_retry=on_retry, deadline=budget)
             if status == 200:
                 _profiler.runtime_metrics.inc("fleet.requests_ok")
@@ -436,21 +573,26 @@ class FleetRouter:
                     _profiler.runtime_metrics.inc("fleet.failovers")
                     self.failover_log.append(
                         (request_id, *tried))
-            return status, body, ctype
+            return status, body, ctype, headers
         except _DeadlineExhausted as e:
             _profiler.runtime_metrics.inc("fleet.shed")
-            return self._shed(504, "deadline_exceeded", str(e), tried)
+            return self._shed(504, "deadline_exceeded", str(e), tried,
+                              retry_after=self._shed_hint(deadline_at))
         except RetryError as e:
             e.history = list(tried)
             _profiler.runtime_metrics.inc("fleet.shed")
             if isinstance(e.last, _NoReplicas):
-                return self._shed(503, "no_replicas", str(e.last), tried)
+                return self._shed(
+                    503, "no_replicas", str(e.last), tried,
+                    retry_after=self._shed_hint(deadline_at))
             return self._shed(503, "upstream_unavailable",
                               f"all failover attempts failed: {e.last}",
-                              tried)
+                              tried,
+                              retry_after=self._shed_hint(deadline_at))
         except _NoReplicas as e:
             _profiler.runtime_metrics.inc("fleet.shed")
-            return self._shed(503, "no_replicas", str(e), tried)
+            return self._shed(503, "no_replicas", str(e), tried,
+                              retry_after=self._shed_hint(deadline_at))
         finally:
             _profiler.runtime_metrics.observe(
                 "fleet.request_seconds", time.perf_counter() - t0)
@@ -511,26 +653,30 @@ class FleetRouter:
             return
         except _DeadlineExhausted as e:
             _profiler.runtime_metrics.inc("fleet.shed")
-            code, body, ctype = self._shed(504, "deadline_exceeded",
-                                           str(e), tried)
+            code, body, ctype, headers = self._shed(
+                504, "deadline_exceeded", str(e), tried,
+                retry_after=self._shed_hint(deadline_at))
         except RetryError as e:
             e.history = list(tried)
             _profiler.runtime_metrics.inc("fleet.shed")
             if isinstance(e.last, _NoReplicas):
-                code, body, ctype = self._shed(503, "no_replicas",
-                                               str(e.last), tried)
+                code, body, ctype, headers = self._shed(
+                    503, "no_replicas", str(e.last), tried,
+                    retry_after=self._shed_hint(deadline_at))
             else:
-                code, body, ctype = self._shed(
+                code, body, ctype, headers = self._shed(
                     503, "upstream_unavailable",
-                    f"all failover attempts failed: {e.last}", tried)
+                    f"all failover attempts failed: {e.last}", tried,
+                    retry_after=self._shed_hint(deadline_at))
         except _NoReplicas as e:
             _profiler.runtime_metrics.inc("fleet.shed")
-            code, body, ctype = self._shed(503, "no_replicas", str(e),
-                                           tried)
+            code, body, ctype, headers = self._shed(
+                503, "no_replicas", str(e), tried,
+                retry_after=self._shed_hint(deadline_at))
         finally:
             _profiler.runtime_metrics.observe(
                 "fleet.request_seconds", time.perf_counter() - t0)
-        handler._reply_raw(code, body, ctype)
+        handler._reply_raw(code, body, ctype, headers)
 
     def _forward_stream(self, addr, handler, raw, request_id, remaining):
         """One streamed attempt; returns ``"ok"`` when the relay ran to
@@ -574,18 +720,34 @@ class FleetRouter:
                         f"replica {addr} unreachable: {e}") from e
             if resp.status != 200:
                 body = resp.read()
+                from paddle_tpu.fault.retry import parse_retry_after
+                hint_raw = resp.getheader("Retry-After")
                 if resp.will_close:
                     self._drop_conn(addr)
                 try:
                     parsed = json.loads(body)
                 except ValueError:
-                    parsed = {"retryable": resp.status in (502, 503, 504)}
+                    parsed = {"retryable":
+                              resp.status in (429, 502, 503, 504)}
                 if parsed.get("retryable"):
+                    if resp.status == 429 and \
+                            not self._alternative_with_headroom(addr):
+                        # no sibling with scraped headroom: the 429 +
+                        # Retry-After passes through verbatim
+                        handler._reply_raw(
+                            resp.status, body, "application/json",
+                            {"Retry-After": hint_raw} if hint_raw
+                            else None)
+                        return "passthrough"
                     err = parsed.get("error") or {}
-                    raise _Transient(
+                    exc = _Transient(
                         f"replica {addr} replied {resp.status} "
                         f"{err.get('type', 'retryable')}: "
                         f"{err.get('message', '')}")
+                    hint = parse_retry_after(hint_raw)
+                    if hint is not None:
+                        exc.retry_after = hint
+                    raise exc
                 handler._reply_raw(resp.status, body, "application/json")
                 return "passthrough"
             # the replica holds its 200 until the first token exists,
@@ -679,11 +841,16 @@ class FleetRouter:
             handler.close_connection = True
 
     @staticmethod
-    def _shed(code, etype, message, tried):
-        body = json.dumps({"error": {"type": etype, "message": message},
-                           "retryable": True,
-                           "replicas_tried": list(tried)}).encode()
-        return code, body, "application/json"
+    def _shed(code, etype, message, tried, retry_after=None):
+        obj = {"error": {"type": etype, "message": message},
+               "retryable": True,
+               "replicas_tried": list(tried)}
+        headers = None
+        if retry_after is not None:
+            obj["retry_after_s"] = retry_after
+            headers = {"Retry-After": f"{retry_after:.3f}"}
+        return code, json.dumps(obj).encode(), "application/json", \
+            headers
 
     def _pooled_conn(self, addr, timeout):
         """(reused, conn): this handler thread's keep-alive connection
@@ -766,21 +933,37 @@ class FleetRouter:
                     entry["outstanding"] = max(
                         0, entry["outstanding"] - 1)
         if status == 200:
-            return status, body, "application/json"
+            return status, body, "application/json", None
+        from paddle_tpu.fault.retry import parse_retry_after
+        hint_raw = resp.getheader("Retry-After")
         try:
             parsed = json.loads(body)
         except ValueError:
-            parsed = {"retryable": status in (502, 503, 504)}
+            parsed = {"retryable": status in (429, 502, 503, 504)}
         if parsed.get("retryable"):
+            if status == 429 and \
+                    not self._alternative_with_headroom(addr):
+                # saturated replica, no sibling with scraped headroom:
+                # pass the 429 + Retry-After through VERBATIM so the
+                # client backs off instead of the router burning its
+                # budget hammering a uniformly saturated fleet
+                return status, body, "application/json", \
+                    ({"Retry-After": hint_raw} if hint_raw else None)
             err = parsed.get("error") or {}
-            raise _Transient(
+            exc = _Transient(
                 f"replica {addr} replied {status} "
                 f"{err.get('type', 'retryable')}: "
                 f"{err.get('message', '')}")
+            hint = parse_retry_after(hint_raw)
+            if hint is not None:
+                # the retry policy paces the failover by the replica's
+                # own hint instead of its default backoff
+                exc.retry_after = hint
+            raise exc
         # permanent upstream error (400 bad feed, 500 model bug): the
         # caller must see it unchanged — failing over would just repeat
         # the same error on a healthy replica
-        return status, body, "application/json"
+        return status, body, "application/json", None
 
     # -- lifecycle ---------------------------------------------------------
     def start_background(self):

@@ -167,6 +167,7 @@ class FleetScraper:
         self._lock = threading.Lock()
         self._prev = None  # (monotonic, {addr: (requests, tokens)})
         self._last_perf = {}  # replica_perf() of the latest pass
+        self._last_ok = set()  # addrs that answered the latest pass
 
     def _scrape_one(self, target):
         addr, replica_id = target
@@ -206,6 +207,7 @@ class FleetScraper:
                                 sum(1 for s in scrapes if not s["ok"]))
         with self._lock:
             self._last_perf = replica_perf(scrapes)
+            self._last_ok = {s["addr"] for s in scrapes if s["ok"]}
         return scrapes
 
     def last_perf(self):
@@ -216,6 +218,19 @@ class FleetScraper:
         with self._lock:
             return {addr: dict(vals)
                     for addr, vals in self._last_perf.items()}
+
+    def last_ok(self):
+        """Addresses that answered the most recent federation pass —
+        the router's *scrape evidence* when deciding whether a sibling
+        replica plausibly has headroom (empty before the first scrape:
+        no evidence, make no headroom claims)."""
+        with self._lock:
+            return set(self._last_ok)
+
+    def rates(self, scrapes):
+        """Public face of the rollup rates: ``(rps, tokens_per_sec)``
+        vs the previous pass — the controller's demand signal."""
+        return self._rates(scrapes)
 
     def _rates(self, scrapes):
         """(rps, tokens_per_sec) vs the previous scrape; None on the

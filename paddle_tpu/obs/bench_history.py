@@ -69,6 +69,14 @@ BENCH_METRICS = {
     "compile": {"reduction_best": ("higher", 0.35),
                 "reduction_second_best": ("higher", 0.35),
                 "step_time_ratio_worst": ("lower", 0.15)},
+    # ISSUE-16 autoscale gate: the controller fleet's p99 under the 5×
+    # step, at least as many scale-ups as baseline (the loop must keep
+    # acting), and the two zero-always invariants — no lost accepted
+    # requests in the kill drill, no shed without a Retry-After hint
+    "autoscale": {"p99_controller_ms": ("lower", 0.75),
+                  "scale_ups": ("higher", 0.50),
+                  "lost_accepted": ("max_abs", 0.0),
+                  "sheds_without_retry_after": ("max_abs", 0.0)},
     "train_transformer": {"tokens_per_sec_per_chip": ("higher", 0.10),
                           "mfu": ("higher", 0.05),
                           # measured (cost-analysis-based) MFU from the
@@ -258,6 +266,14 @@ def summary_metrics(bench, summary):
         return {"resume_seconds": summary["resume"]["restore_seconds"],
                 "loss_delta_rel": summary["loss_delta_rel"],
                 "reshard_failures": summary["reshard_failures"]}
+    if bench == "autoscale":
+        ctrl = summary["modes"]["controller"]
+        return {"p99_controller_ms": ctrl["p99_ms"],
+                "scale_ups": ctrl["scale_ups"],
+                "lost_accepted":
+                    summary["kill_drill"]["traffic"]["lost_accepted"],
+                "sheds_without_retry_after":
+                    summary["sheds_without_retry_after"]}
     if bench == "train_transformer":
         out = {"tokens_per_sec_per_chip":
                summary["tokens_per_sec_per_chip"],
@@ -268,7 +284,7 @@ def summary_metrics(bench, summary):
         return out
     raise ValueError(f"no trajectory extraction for bench {bench!r} "
                      f"(known: serving, datapipe, fleet, decode, "
-                     f"elastic, compile, train_transformer)")
+                     f"elastic, compile, train_transformer, autoscale)")
 
 
 def add_record_args(parser):

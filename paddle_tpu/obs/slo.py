@@ -233,6 +233,7 @@ class SLOWatchdog:
         self.breach_log = collections.deque(maxlen=log_size)
         self.breaches_total = 0
         self.evaluations = 0
+        self._last_values = []        # per-objective verdicts, last pass
         self._thread = None
         self._stop = threading.Event()
 
@@ -264,10 +265,16 @@ class SLOWatchdog:
         elapsed = (now - prev[0]) if prev else None
         breaches = []
         breaching = 0
+        last_values = []
         for o in self.spec.objectives:
             self._metrics.inc("slo.evaluations")
             verdict = self._judge(o, prev, counters, elapsed)
             name = o["name"]
+            last_values.append({
+                "objective": name, "kind": o["kind"],
+                "value": None if verdict is None else verdict[0],
+                "threshold": None if verdict is None else verdict[1],
+                "breached": bool(verdict and verdict[2])})
             dump = False
             with self._lock:
                 self.evaluations += 1
@@ -307,8 +314,20 @@ class SLOWatchdog:
                     extra={"slo_breach": breach,
                            "breach_log": log_tail,
                            "spec": self.spec.to_dict()})
+        with self._lock:
+            self._last_values = last_values
         self._metrics.set_gauge("slo.breaching", breaching)
         return breaches
+
+    def last_values(self):
+        """Per-objective verdicts of the most recent :meth:`evaluate`
+        pass: ``[{objective, kind, value, threshold, breached}]``
+        (``value``/``threshold`` None when that window had nothing to
+        judge).  This is the fleet controller's PRESSURE signal — it
+        acts on value-vs-threshold *margins* before a breach, not only
+        on the binary breach log."""
+        with self._lock:
+            return [dict(v) for v in self._last_values]
 
     def _judge(self, o, prev, counters, elapsed):
         """(value, threshold, breached) for one objective, or None when

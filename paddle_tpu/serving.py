@@ -1174,6 +1174,21 @@ class InferenceServer:
         self._server.server_close()
 
 
+def _history_with_hints(history, hints):
+    """The per-attempt base-URL trail with ``retry-after=<s>s``
+    annotations appended to the attempts whose replies carried a
+    ``Retry-After`` hint — RetryError.history is the forensic record of
+    a failed failover chain, and *who told us to back off, by how much*
+    is part of it.  Attempts without a hint stay plain base strings
+    (tests and failover bookkeeping compare those verbatim)."""
+    out = []
+    for i, base in enumerate(history):
+        hint = hints.get(i)
+        out.append(base if hint is None
+                   else f"{base} retry-after={hint:g}s")
+    return out
+
+
 class ServingClient:
     """Retrying client for :class:`InferenceServer` — optionally a
     client-side load balancer over a replica fleet.
@@ -1304,10 +1319,12 @@ class ServingClient:
         # attempt (idempotency key + the trace the failover chain shares)
         rid = _trace.current_trace_id() or _trace.new_trace_id()
         history = []
+        hints = {}      # attempt index -> Retry-After seconds
         deadline_at = None if self._deadline is None \
             else time.monotonic() + self._deadline
 
         def attempt():
+            from paddle_tpu.fault.retry import parse_retry_after
             base = self._pick_base(history)
             history.append(base)
             headers = {"Content-Type": "application/json",
@@ -1332,12 +1349,21 @@ class ServingClient:
                     body = json.loads(e.read())
                 except ValueError:
                     body = {"error": {"type": "http", "message": str(e)},
-                            "retryable": e.code in (502, 503, 504)}
+                            "retryable": e.code in (429, 502, 503, 504)}
                 err = body.get("error") or {}
                 if body.get("retryable"):
-                    raise _TransientServingError(
+                    exc = _TransientServingError(
                         f"{err.get('type', 'http')}: "
-                        f"{err.get('message', str(e))}") from e
+                        f"{err.get('message', str(e))}")
+                    hint = parse_retry_after(
+                        e.headers.get("Retry-After")
+                        if e.headers is not None else None)
+                    if hint is not None:
+                        # server-paced: the retry policy sleeps this
+                        # instead of its own backoff
+                        exc.retry_after = hint
+                        hints[len(history) - 1] = hint
+                    raise exc from e
                 raise ServingError(err.get("type", "http"),
                                    err.get("message", str(e)),
                                    retryable=False) from e
@@ -1353,7 +1379,7 @@ class ServingClient:
             # deadline=None falls back to the policy's own budget
             return self._retry.call(attempt, deadline=self._deadline)
         except RetryError as e:
-            e.history = list(history)
+            e.history = _history_with_hints(history, hints)
             raise
 
     def predict(self, feeds):
@@ -1388,10 +1414,12 @@ class ServingClient:
             payload["eos_id"] = int(eos_id)
         body = json.dumps(payload).encode()
         history = []
+        hints = {}      # attempt index -> Retry-After seconds
         deadline_at = None if self._deadline is None \
             else time.monotonic() + self._deadline
 
         def attempt():
+            from paddle_tpu.fault.retry import parse_retry_after
             base = self._pick_base(history)
             history.append(base)
             host, port = parse_hostport(base[len("http://"):])
@@ -1411,16 +1439,22 @@ class ServingClient:
                 raise ConnectionError(str(e)) from e
             if resp.status != 200:
                 data = resp.read()
+                hint = parse_retry_after(resp.getheader("Retry-After"))
                 conn.close()
                 try:
                     parsed = json.loads(data)
                 except ValueError:
-                    parsed = {"retryable": resp.status in (502, 503, 504)}
+                    parsed = {"retryable":
+                              resp.status in (429, 502, 503, 504)}
                 err = parsed.get("error") or {}
                 if parsed.get("retryable"):
-                    raise _TransientServingError(
+                    exc = _TransientServingError(
                         f"{err.get('type', 'http')}: "
                         f"{err.get('message', resp.status)}")
+                    if hint is not None:
+                        exc.retry_after = hint
+                        hints[len(history) - 1] = hint
+                    raise exc
                 raise ServingError(err.get("type", "http"),
                                    err.get("message", str(resp.status)),
                                    retryable=False)
@@ -1433,7 +1467,7 @@ class ServingClient:
             else:
                 conn, resp = attempt()
         except RetryError as e:
-            e.history = list(history)
+            e.history = _history_with_hints(history, hints)
             raise
 
         def events():

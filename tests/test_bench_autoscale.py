@@ -1,0 +1,78 @@
+"""bench_autoscale smoke: the closed-loop drill must shed ONLY with a
+``Retry-After`` hint, the mid-ramp kill drill must lose zero accepted
+requests, and standby prewarm must ride the persistent compile cache
+(hits move, misses stay flat).  The full A/B acceptance — controller
+fleet holds the p99 SLO under the 5x step while the fixed fleet
+breaches — runs at the CLI's longer defaults and is marked slow."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+import bench_autoscale  # noqa: E402
+from paddle_tpu.obs import bench_history  # noqa: E402
+
+_SMOKE = dict(duration=2.5, service_ms=25.0, base_rps=4.0,
+              peak_rps=20.0, p99_slo_ms=300.0, seed=7)
+
+
+@pytest.fixture(scope="module")
+def smoke_summary():
+    return bench_autoscale.run_bench(**_SMOKE)
+
+
+def test_summary_schema(smoke_summary):
+    assert {"modes", "kill_drill",
+            "sheds_without_retry_after"} <= set(smoke_summary)
+    for mode in ("fixed", "controller"):
+        run = smoke_summary["modes"][mode]
+        assert {"p99_ms", "held_slo", "scale_ups", "traffic",
+                "standby_compile_cache", "replicas_start",
+                "replicas_end"} <= set(run)
+        assert run["traffic"]["outcomes"]["ok"] > 0
+    assert smoke_summary["modes"]["fixed"]["mode"] == "fixed"
+    assert smoke_summary["modes"]["controller"]["mode"] == "controller"
+
+
+def test_every_shed_carries_retry_after(smoke_summary):
+    assert smoke_summary["sheds_without_retry_after"] == 0, smoke_summary
+
+
+def test_kill_drill_loses_zero_accepted(smoke_summary):
+    drill = smoke_summary["kill_drill"]
+    assert drill["killed"], drill              # the failpoint fired
+    assert drill["traffic"]["lost_accepted"] == 0, drill["traffic"]
+
+
+def test_standby_prewarm_rides_compile_cache(smoke_summary):
+    cache = smoke_summary["modes"]["controller"]["standby_compile_cache"]
+    # the fixed pass populated the shared persistent cache; warming the
+    # standby pool must replay it, never recompile
+    assert cache["misses_delta"] == 0, cache
+    assert cache["hits_delta"] >= 1, cache
+
+
+def test_bench_history_extraction(smoke_summary):
+    metrics = bench_history.summary_metrics("autoscale", smoke_summary)
+    assert set(metrics) == {"p99_controller_ms", "scale_ups",
+                            "lost_accepted", "sheds_without_retry_after"}
+    assert metrics["lost_accepted"] == 0
+    assert metrics["sheds_without_retry_after"] == 0
+
+
+@pytest.mark.slow
+def test_controller_holds_slo_while_fixed_breaches():
+    # CLI defaults: 8s replay, 40ms device time, 5 -> 25 rps step
+    # against a single fixed replica (sleep-modeled capacity well
+    # under the peak) vs the controller fleet (max 3 replicas from
+    # the warm-standby pool)
+    summary = bench_autoscale.run_bench()
+    fixed = summary["modes"]["fixed"]
+    ctrl = summary["modes"]["controller"]
+    assert not fixed["held_slo"], fixed
+    assert ctrl["held_slo"], ctrl
+    assert ctrl["scale_ups"] >= 1, ctrl
+    assert summary["sheds_without_retry_after"] == 0
+    assert summary["kill_drill"]["traffic"]["lost_accepted"] == 0

@@ -1,0 +1,154 @@
+"""Traffic-replay harness units (fleet.traffic): rate patterns, the
+seeded heavy-tail prompt mix, open-loop dispatch with an inflight cap,
+outcome classification (ok / shed / deadline / error / dropped), and
+the summary arithmetic the autoscale bench gates on.  All in-process —
+``send`` is a plain function, no HTTP."""
+
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.fleet import TrafficReplay
+from paddle_tpu.fleet.traffic import (diurnal, flash_crowd,
+                                      heavy_tail_lengths, step)
+from paddle_tpu.profiler import RuntimeMetrics
+
+
+class TestPatterns:
+    def test_step(self):
+        r = step(2.0, 10.0, at=5.0)
+        assert r(0.0) == 2.0
+        assert r(4.99) == 2.0
+        assert r(5.0) == 10.0
+        assert r(100.0) == 10.0
+
+    def test_step_with_duration_reverts(self):
+        r = step(2.0, 10.0, at=5.0, duration=3.0)
+        assert r(6.0) == 10.0
+        assert r(8.0) == 2.0
+
+    def test_diurnal_trough_and_peak(self):
+        r = diurnal(1.0, 9.0, period=60.0)
+        assert r(0.0) == pytest.approx(1.0)
+        assert r(30.0) == pytest.approx(9.0)
+        assert r(60.0) == pytest.approx(1.0)
+        assert 1.0 < r(10.0) < 9.0
+
+    def test_flash_crowd_rise_and_decay(self):
+        r = flash_crowd(1.0, 21.0, at=2.0, rise=0.5, fall=1.0)
+        assert r(1.0) == 1.0
+        assert r(2.25) == pytest.approx(11.0)   # mid-rise
+        peak = r(2.5)
+        assert peak == pytest.approx(21.0)
+        assert 1.0 < r(4.0) < peak              # decaying
+        assert r(30.0) == pytest.approx(1.0, abs=0.01)
+
+    def test_heavy_tail_lengths(self):
+        a = heavy_tail_lengths(500, seed=3, median=32, cap=512)
+        b = heavy_tail_lengths(500, seed=3, median=32, cap=512)
+        assert a == b                           # seeded
+        assert a != heavy_tail_lengths(500, seed=4, median=32, cap=512)
+        assert all(1 <= n <= 512 for n in a)
+        s = sorted(a)
+        med = s[len(s) // 2]
+        assert 16 <= med <= 64                  # near the target median
+        assert s[-1] > 4 * med                  # the heavy tail exists
+
+
+def _replay(send, pattern, duration, **kw):
+    m = kw.pop("metrics", RuntimeMetrics())
+    replay = TrafficReplay(send, pattern, duration, metrics=m, **kw)
+    return replay.run(), m
+
+
+class TestReplay:
+    def test_classification_and_hint_split(self):
+        # deterministic outcome script keyed by arrival index
+        script = [
+            {"status": 200},
+            {"status": 429, "retry_after": "0.5"},
+            {"status": 429, "retry_after": None},
+            {"status": 503, "retry_after": "1.0"},
+            {"status": 504},
+            {"status": 500},
+            "raise",
+        ]
+
+        def send(i):
+            entry = script[i % len(script)]
+            if entry == "raise":
+                raise ConnectionError("boom")
+            return entry
+
+        summary, m = _replay(send, lambda t: 200.0, 0.5, seed=1)
+        n = summary["attempted"]
+        assert n > 20
+        out = summary["outcomes"]
+        assert out["ok"] == m.counter("traffic.ok") > 0
+        assert out["shed"] == m.counter("traffic.shed") > 0
+        assert out["deadline"] == m.counter("traffic.deadline_exceeded") > 0
+        assert out["error"] == m.counter("traffic.errors") > 0
+        assert summary["shed_with_hint"] + summary["shed_without_hint"] \
+            == out["shed"]
+        assert summary["shed_without_hint"] > 0   # the None-hint 429s
+        assert summary["lost_accepted"] == out["error"] + out["deadline"]
+        assert m.counter("traffic.sent") == n   # every arrival metered
+
+    def test_same_seed_same_schedule(self):
+        def send(i):
+            return {"status": 200}
+
+        s1, _ = _replay(send, step(50.0, 200.0, at=0.25), 0.5, seed=9)
+        s2, _ = _replay(send, step(50.0, 200.0, at=0.25), 0.5, seed=9)
+        s3, _ = _replay(send, step(50.0, 200.0, at=0.25), 0.5, seed=10)
+        assert s1["attempted"] == s2["attempted"]
+        assert s1["attempted"] != s3["attempted"]
+
+    def test_inflight_cap_counts_dropped(self):
+        release = threading.Event()
+
+        def send(i):
+            release.wait(timeout=10.0)
+            return {"status": 200}
+
+        m = RuntimeMetrics()
+        replay = TrafficReplay(send, lambda t: 100.0, 0.3, seed=2,
+                               max_inflight=2, metrics=m)
+        done = {}
+
+        def run():
+            done["summary"] = replay.run()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(0.4)
+        release.set()
+        t.join(timeout=10.0)
+        summary = done["summary"]
+        assert summary["outcomes"]["dropped"] > 0
+        assert summary["outcomes"]["dropped"] == m.counter("traffic.dropped")
+        assert summary["outcomes"]["ok"] <= 2 + summary["attempted"]
+        # dropped arrivals were still offered load
+        assert m.counter("traffic.sent") == summary["attempted"]
+
+    def test_zero_rate_stretch_sends_nothing(self):
+        sent = []
+
+        def send(i):
+            sent.append(i)
+            return {"status": 200}
+
+        summary, m = _replay(send, lambda t: 0.0, 0.3, seed=0)
+        assert summary["attempted"] == 0
+        assert sent == []
+        assert m.counter("traffic.sent") == 0
+
+    def test_latency_percentiles_over_ok_only(self):
+        def send(i):
+            time.sleep(0.01)
+            return {"status": 200}
+
+        summary, _ = _replay(send, lambda t: 50.0, 0.4, seed=5)
+        assert summary["latency_ms"]["p50"] >= 10.0
+        assert summary["latency_ms"]["p99"] >= summary["latency_ms"]["p50"]
