@@ -1,0 +1,207 @@
+"""Paged-KV decode benchmark: REAL decode-step compute (no modeled
+sleeps) for the same generation model exported two ways — the dense
+per-slot ``[num_slots, max_len, H*D]`` cache pool vs the paged
+``[num_pages, page_len, H*D]`` pool behind a per-slot page table.
+
+Both predictors hold the pool at a fixed mean prefix occupancy
+(default 25% of ``max_len``, chosen to land exactly on a declared page
+bucket) and run the same single-token decode iteration.  The dense
+step reads every slot's full ``max_len`` rows regardless of occupancy;
+the paged step feeds the page table sliced to the covering page bucket,
+so its reads scale with the live prefix.  Two numbers fall out:
+
+* ``speedup`` — median dense step wall time / median paged step wall
+  time (target: >= 1.5x at 25% occupancy);
+* ``bytes_ratio`` — decode-executable bytes accessed, paged / dense,
+  from XLA ``cost_analysis()`` via the compile capture
+  (``paddle_tpu.obs.perf.records``), with the static analyzer's
+  ``cost.estimate`` as fallback when the backend reports no bytes
+  (target: <= 0.5x).
+
+    JAX_PLATFORMS=cpu python bench_paged.py --out BENCH_PAGED.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import tempfile
+import time
+
+import numpy as np
+
+
+def _hp():
+    from paddle_tpu.models import gen_lm
+    hp = gen_lm.GenConfig()
+    hp.vocab_size, hp.d_model, hp.d_ffn = 64, 128, 128
+    hp.n_head, hp.d_head, hp.n_layer = 8, 16, 4
+    hp.max_len = 512
+    return hp
+
+
+def _export(dirname, hp, num_slots, paged):
+    from paddle_tpu.models import gen_lm
+    gen_lm.export_gen_model(dirname, hp, num_slots=num_slots,
+                            paged=paged)
+    return dirname
+
+
+def _seed_slots(pred, prompt_len, rng):
+    """Drop every slot at ``prompt_len`` live rows with synthetic K/V
+    (decode numerics are irrelevant to step timing; skipping real
+    prefill keeps the bench on the decode path only)."""
+    hd = int(pred._dec_prog.global_block()
+             .var(pred.cache_vars[0]).shape[-1])
+    kv = [rng.standard_normal((1, prompt_len, hd)).astype(np.float32)
+          for _ in range(len(pred.cache_vars))]
+    for slot in range(pred.num_slots):
+        if pred.paged:
+            pred.alloc_slot_pages(slot, pred.pages_needed(prompt_len))
+        pred.write_slot(slot, kv, prompt_len)
+
+
+def _step_args(pred, prompt_len):
+    """Fixed-occupancy single-token decode feed (positions do not
+    advance between timed steps, so every step reads the same page
+    bucket / mask)."""
+    S, L = pred.num_slots, pred.max_len
+    tokens = np.ones(S, np.int32)
+    positions = np.full(S, prompt_len, np.int32)
+    if pred.paged:
+        return dict(tokens=tokens, positions=positions,
+                    lens=np.full(S, prompt_len + 1, np.int32))
+    onehot = np.zeros((S, L), np.float32)
+    onehot[:, prompt_len] = 1.0
+    mask = np.zeros((S, L), np.float32)
+    mask[:, :prompt_len + 1] = 1.0
+    return dict(tokens=tokens, positions=positions,
+                pos_onehot=onehot, attn_mask=mask)
+
+
+def _time_decode(pred, prompt_len, steps, warm=3):
+    args = _step_args(pred, prompt_len)
+    for _ in range(warm):
+        pred.decode_step(**args)
+    samples = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        logits = pred.decode_step(**args)
+        np.asarray(logits)
+        samples.append(time.perf_counter() - t0)
+    return 1e3 * statistics.median(samples)
+
+
+def _decode_bytes_xla(marker):
+    """bytes-accessed of the captured decode executable whose jit label
+    carries ``marker`` (a decode-only feed name); None when the backend
+    reported no cost analysis."""
+    from paddle_tpu.obs import perf
+    for r in reversed(perf.records()):
+        if marker in r["label"]:
+            return r["bytes_accessed"]
+    return None
+
+
+def _decode_bytes_static(pred, pages_fed=None):
+    """Static-analyzer fallback: ``cost.estimate`` over the decode
+    program, with the page-table feed pinned to the fed bucket so the
+    paged estimate prices what the step actually read."""
+    from paddle_tpu.analysis import cost
+    prog = pred._dec_prog
+    if pages_fed is None:
+        return cost.estimate(prog).total_bytes
+    var = prog.global_block().var("gen_page_table")
+    saved = var.shape
+    try:
+        var.shape = (saved[0], int(pages_fed))
+        return cost.estimate(prog).total_bytes
+    finally:
+        var.shape = saved
+
+
+def run_bench(args):
+    from paddle_tpu.gen import GenPredictor
+    from paddle_tpu.lod import row_bucket
+
+    hp = _hp()
+    # live rows land EXACTLY on a page bucket: lens = prompt_len + 1
+    prompt_len = int(hp.max_len * args.occupancy) - 1
+    rng = np.random.default_rng(7)
+    out = {}
+    for mode in ("paged", "dense"):
+        with tempfile.TemporaryDirectory() as tmp:
+            _export(tmp, hp, args.slots, paged=(mode == "paged"))
+            pred = GenPredictor(tmp)
+            _seed_slots(pred, prompt_len, rng)
+            ms = _time_decode(pred, prompt_len, args.steps)
+            entry = {"decode_step_ms": ms}
+            if mode == "paged":
+                need = -(-(prompt_len + 1) // pred.page_len)
+                entry["pages_fed"] = int(min(
+                    row_bucket(need, edges=pred.page_buckets),
+                    pred.pages_per_slot))
+                entry["page_len"] = pred.page_len
+                entry["bytes_xla"] = _decode_bytes_xla("gen_page_table")
+                entry["bytes_static"] = _decode_bytes_static(
+                    pred, entry["pages_fed"])
+            else:
+                entry["bytes_xla"] = _decode_bytes_xla("gen_attn_mask")
+                entry["bytes_static"] = _decode_bytes_static(pred)
+            out[mode] = entry
+
+    use_xla = (out["paged"]["bytes_xla"] is not None and
+               out["dense"]["bytes_xla"] is not None and
+               out["dense"]["bytes_xla"] > 0)
+    src = "bytes_xla" if use_xla else "bytes_static"
+    summary = {
+        "model": {"d_model": hp.d_model, "n_head": hp.n_head,
+                  "d_head": hp.d_head, "n_layer": hp.n_layer,
+                  "max_len": hp.max_len},
+        "num_slots": args.slots,
+        "occupancy_pct": round(100.0 * (prompt_len + 1) / hp.max_len, 1),
+        "steps": args.steps,
+        "paged": out["paged"],
+        "dense": out["dense"],
+        "speedup": out["dense"]["decode_step_ms"] /
+        out["paged"]["decode_step_ms"],
+        "bytes_source": "xla" if use_xla else "static",
+        "bytes_ratio": out["paged"][src] / out["dense"][src],
+    }
+    return summary
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--slots", type=int, default=16)
+    parser.add_argument("--steps", type=int, default=30,
+                        help="timed decode iterations per mode")
+    parser.add_argument("--occupancy", type=float, default=0.25,
+                        help="mean live prefix as a fraction of max_len")
+    parser.add_argument("--out", default=None, metavar="PATH",
+                        help="write the summary JSON here")
+    from paddle_tpu.obs import bench_history
+    bench_history.add_record_args(parser)
+    args = parser.parse_args(argv)
+
+    summary = run_bench(args)
+    print(json.dumps(summary, indent=2))
+    print(f"\ndecode step: dense "
+          f"{summary['dense']['decode_step_ms']:.3f} ms, paged "
+          f"{summary['paged']['decode_step_ms']:.3f} ms "
+          f"-> speedup {summary['speedup']:.2f}x at "
+          f"{summary['occupancy_pct']}% occupancy")
+    print(f"decode bytes ({summary['bytes_source']}): ratio "
+          f"{summary['bytes_ratio']:.3f}x")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2)
+            f.write("\n")
+    bench_history.record_from_args("paged", summary, args,
+                                   source="bench_paged.py")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -415,6 +415,33 @@ def _c_sdpa(op, info):
     return 4 * b * h * s * s * d, io_bytes(op, info)
 
 
+@rule("paged_attention")
+def _c_paged_attention(op, info):
+    """Paged decode attention prices the pages ACTUALLY addressed by
+    the step's page-table feed ([S, P] -> S*P*page_len token rows of
+    K and V), not the full pool — the whole point of the layout; a
+    full-pool ``io_bytes`` would price every bucket identically and
+    hide the occupancy win from ``row_cost_fn``/``gen.decode_mfu``."""
+    q = info(op.input("Q")[0]) if op.input("Q") else _UNKNOWN
+    kc = info(op.input("KCache")[0]) if op.input("KCache") else _UNKNOWN
+    pt = info(op.input("PageTable")[0]) if op.input("PageTable") \
+        else _UNKNOWN
+    if q.shape is None or kc.shape is None or pt.shape is None or \
+            len(kc.shape) != 3 or len(pt.shape) != 2:
+        return None
+    hd, pl, p = kc.shape[-1], kc.shape[1], pt.shape[1]
+    if any(x < 0 for x in (hd, pl, p)):
+        return None
+    s = q.shape[0] if q.shape[0] > 0 else 1
+    t = p * pl
+    item = _DTYPE_BYTES.get(str(kc.dtype), 4)
+    flops = 4 * s * t * hd                       # QK^T + PV per head-row
+    bytes_ = (2 * s * t * hd          # K/V pages gathered
+              + 4 * s * hd            # q, k, v rows in + out
+              + 2 * s * hd) * item    # tail-page scatter write (k + v)
+    return flops, bytes_
+
+
 def _per_element(mult):
     def fn(op, info):
         n = None

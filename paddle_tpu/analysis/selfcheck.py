@@ -181,6 +181,63 @@ def _check_gen_bundle():
                     failures)
 
 
+def _check_paged_kv():
+    """Paged-KV gate: a fresh paged gen export carries complete
+    page-bucket meta, the paged decode program lints clean, and the
+    static cost model prices the decode step proportionally to the fed
+    page count — the occupancy-proportional read contract
+    ``bench_paged.py`` times."""
+    import json
+
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import cost
+    from paddle_tpu.analysis.distributed import load_saved_program
+    from paddle_tpu.models import gen_lm
+
+    failures = []
+    hp = gen_lm.GenConfig()
+    hp.vocab_size, hp.d_model, hp.d_ffn = 32, 16, 32
+    hp.n_head, hp.n_layer = 2, 1   # one layer proves the page contract
+    hp.d_head, hp.max_len = 8, 32
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_selfcheck_paged_")
+    try:
+        gen_lm.export_gen_model(tmp, hp, num_slots=2)
+        with open(os.path.join(tmp, "gen_meta.json")) as f:
+            meta = json.load(f)
+        for key in ("page_len", "num_pages", "page_buckets",
+                    "page_table_feed"):
+            if key not in meta:
+                failures.append(f"gen_meta.json missing {key!r}")
+        if not failures:
+            page_len = int(meta["page_len"])
+            pps = -(-int(meta["max_len"]) // page_len)
+            pbuckets = [int(p) for p in meta["page_buckets"]]
+            if pbuckets != sorted(set(pbuckets)):
+                failures.append("page_buckets not strictly increasing: "
+                                f"{pbuckets}")
+            if pbuckets and pbuckets[-1] != pps:
+                failures.append(f"largest page bucket {pbuckets[-1]} != "
+                                f"pages/slot {pps} (bucket escape)")
+            for label, r in analysis.lint_gen_bundle(tmp):
+                for d in r.diagnostics:
+                    failures.append(f"[{label}] {d.severity}[{d.code}]: "
+                                    f"{d.message}")
+            decode = load_saved_program(os.path.join(tmp, "decode"))
+            fn = cost.row_cost_fn(decode[0],
+                                  batch_var=meta["page_table_feed"],
+                                  dim=1, probe_rows=(1, max(pps, 2)))
+            if not fn(pps) > fn(1):
+                failures.append(
+                    "cost model does not price pages: decode flops at "
+                    f"{pps} pages ({fn(pps):.0f}) <= at 1 page "
+                    f"({fn(1):.0f})")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return _section("paged-kv",
+                    "page meta + paged decode lint + page-proportional "
+                    "cost", failures)
+
+
 # ---------------------------------------------------------------------------
 # registry scanners (the doc/code lockstep gates)
 # ---------------------------------------------------------------------------
@@ -496,6 +553,7 @@ def run_selfcheck():
         _check_zoo_distribute(),
         _check_zoo_pipeline(),
         _check_gen_bundle(),
+        _check_paged_kv(),
         _check_diagnostic_registry(),
         _check_metric_registry(),
         _check_failpoint_registry(),

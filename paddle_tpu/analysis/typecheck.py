@@ -769,3 +769,45 @@ def _r_optimizer(op, tc):
                   f"{op.type}: Param dtype {p.dtype} differs from Grad "
                   f"dtype {g.dtype}", op=op, var=op.input("Param")[0])
     tc.set_output(op, "ParamOut", shape=p.shape, dtype=p.dtype)
+
+
+@rule("paged_attention")
+def _r_paged_attention(op, tc):
+    q = tc.input_info(op, "Q")
+    kc = tc.input_info(op, "KCache")
+    vc = tc.input_info(op, "VCache")
+    for slot in ("PageTable", "Lens"):
+        inf = tc.input_info(op, slot)
+        if inf.dtype is not None and inf.dtype not in ("int32", "int64"):
+            tc.report("PTA005",
+                      f"paged_attention {slot} "
+                      f"`{op.input(slot)[0]}` must be an integer index "
+                      f"tensor, got {inf.dtype}",
+                      op=op, var=op.input(slot)[0])
+    if kc.shape is not None and vc.shape is not None and \
+            (len(kc.shape) != len(vc.shape) or
+             any(_dims_conflict(a, b)
+                 for a, b in zip(kc.shape, vc.shape))):
+        tc.report("PTA006",
+                  f"paged_attention K/V pools disagree on geometry: "
+                  f"KCache `{op.input('KCache')[0]}` {kc.shape} vs "
+                  f"VCache `{op.input('VCache')[0]}` {vc.shape}",
+                  op=op, var=op.input("KCache")[0])
+    if q.shape is not None and kc.shape is not None and \
+            q.shape[-1] > 0 and kc.shape[-1] > 0 and \
+            q.shape[-1] != kc.shape[-1]:
+        tc.report("PTA006",
+                  f"paged_attention Q `{op.input('Q')[0]}` feature dim "
+                  f"{q.shape[-1]} differs from the page pool's "
+                  f"{kc.shape[-1]} — the scatter would write misshapen "
+                  f"rows", op=op, var=op.input("Q")[0])
+    n_head = op.attr("n_head", None)
+    if n_head and q.shape is not None and q.shape[-1] > 0 and \
+            q.shape[-1] % int(n_head):
+        tc.report("PTA006",
+                  f"paged_attention feature dim {q.shape[-1]} is not "
+                  f"divisible by n_head={n_head}",
+                  op=op, var=op.input("Q")[0])
+    tc.set_output(op, "Out", shape=q.shape, dtype=q.dtype)
+    tc.set_output(op, "KCacheOut", shape=kc.shape, dtype=kc.dtype)
+    tc.set_output(op, "VCacheOut", shape=vc.shape, dtype=vc.dtype)

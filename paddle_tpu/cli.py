@@ -1460,7 +1460,8 @@ def main(argv=None):
     p = sub.add_parser("selfcheck",
                        help="one exit-coded pass over every static "
                             "gate: strict zoo lint (single- AND "
-                            "multi-program), the scanner-enforced "
+                            "multi-program), the paged-KV export gate, "
+                            "the scanner-enforced "
                             "diagnostic/metric/failpoint registries, "
                             "the SLO spec schema, and the bench-"
                             "trajectory schema (bench check --dry)")

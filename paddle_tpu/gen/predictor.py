@@ -16,8 +16,17 @@ the vLLM/Orca phase boundary:
   per-token) host-side slot writes that seed and reclaim cache rows.
 
 ``warmup`` declares BOTH signature families — every prefill bucket and
-the one decode signature — through ``Executor.warmup``, so a server
+the decode signature family — through ``Executor.warmup``, so a server
 flips ``/readyz`` with the whole generation path compiled.
+
+PAGED bundles (meta carries ``page_len``; the default export) keep the
+KV pool as ``[num_pages, page_len, H*D]`` pages addressed through a
+host-side per-slot page table.  The predictor owns the page allocator
+(:meth:`alloc_slot_pages` / :meth:`free_slot_pages`, driven by the
+scheduler's admit/evict), pads the page-table feed to a declared
+``page_buckets`` edge each step (the decode jit key is the bucket), and
+warms one decode signature per bucket.  Decode reads scale with live
+prefix pages, not ``max_len``.
 """
 
 from __future__ import annotations
@@ -58,6 +67,19 @@ class GenPredictor:
         self.cache_vars = list(self.meta["cache_vars"])
         self.prompt_buckets = [int(b) for b in self.meta["prompt_buckets"]]
         self.max_prompt_len = min(self.prompt_buckets[-1], self.max_len)
+        self.paged = "page_len" in self.meta
+        if self.paged:
+            self.page_len = int(self.meta["page_len"])
+            self.num_pages = int(self.meta["num_pages"])
+            self.page_buckets = [int(b)
+                                 for b in self.meta["page_buckets"]]
+            self.pages_per_slot = -(-self.max_len // self.page_len)
+            # host-side page allocator state (all mutated under _lock):
+            # the device only ever sees the bucketed table SLICE
+            self._page_table = np.zeros(
+                (self.num_slots, self.pages_per_slot), np.int32)
+            self._slot_pages = {}
+            self._free_list = list(range(self.num_pages))
 
         self._fluid = fluid
         self._scope = fluid.Scope()
@@ -85,8 +107,13 @@ class GenPredictor:
         # decode dispatches derive gen.decode_mfu (not train.mfu): the
         # executor keys the gauge off this program attribute
         self._dec_prog._mfu_gauge = "gen.decode_mfu"
-        # HBM census: the bucketed KV pool is its own collection —
-        # weakref'd so a dropped predictor releases cleanly
+        if self.paged:
+            dec_block = self._dec_prog.global_block()
+            self._hd = int(dec_block.var(self.cache_vars[0]).shape[-1])
+        # HBM census: the KV pool is its own collection — a paged
+        # bundle's pool (plus its host page table) reports as
+        # ``kv_pages``, the dense layout as ``kv_cache``; weakref'd so
+        # a dropped predictor releases cleanly
         import weakref
         from paddle_tpu.obs import perf as _perf
         ref = weakref.ref(self)
@@ -95,12 +122,15 @@ class GenPredictor:
             p = ref()
             if p is None:
                 return ()
-            return [v for v in (p._scope.find_var(n)
+            bufs = [v for v in (p._scope.find_var(n)
                                 for n in p.cache_vars)
                     if v is not None and hasattr(v, "nbytes")]
+            if p.paged:
+                bufs.append(p._page_table)
+            return bufs
 
-        self._hbm_token = _perf.register_hbm_provider("kv_cache",
-                                                      _kv_buffers)
+        self._hbm_token = _perf.register_hbm_provider(
+            "kv_pages" if self.paged else "kv_cache", _kv_buffers)
         # a reloaded predictor must not leave a dead provider behind
         weakref.finalize(self, _perf.unregister_hbm_provider,
                          self._hbm_token)
@@ -110,6 +140,7 @@ class GenPredictor:
         # lazily, consumed by GenScheduler's admission budget
         self._prefill_cost = {}
         self._length_cost_fn = None
+        self._page_cost_fn = None
 
     # -- prefill -----------------------------------------------------------
     def _bucket(self, prompt_len):
@@ -135,31 +166,141 @@ class GenPredictor:
                     dim=1, probe_rows=probe)
             return self._length_cost_fn
 
+    def _page_write_cost(self, prompt_len):
+        """Flop-equivalent of seeding a paged slot: every allocated
+        prompt page is written whole (k + v, per layer) — the page
+        dimension admission budgets must see on top of the prefill
+        forward."""
+        pages = -(-max(int(prompt_len), 1) // self.page_len)
+        return (2.0 * float(self.meta.get("n_layer", 1)) *
+                pages * self.page_len * self._hd)
+
     def prefill_cost(self, prompt_len):
         """Static FLOPs of prefilling a prompt of ``prompt_len`` tokens
-        (priced at its padded bucket — what the device actually runs).
-        The GenScheduler weighs admissions with this so one decode
-        iteration never stalls behind an unbounded prefill burst.
-        Cheap after the first call per bucket (one affine evaluation);
-        the underlying fit is warmed by GenScheduler construction."""
-        bucket = self._bucket(int(prompt_len))
-        hit = self._prefill_cost.get(bucket)
+        (priced at its padded bucket — what the device actually runs;
+        paged bundles add the slot's page-seeding writes, so the memo
+        key grows a page dimension).  The GenScheduler weighs
+        admissions with this so one decode iteration never stalls
+        behind an unbounded prefill burst.  Cheap after the first call
+        per (bucket, pages); the underlying fit is warmed by
+        GenScheduler construction."""
+        prompt_len = int(prompt_len)
+        bucket = self._bucket(prompt_len)
+        if self.paged:
+            pages = -(-max(prompt_len, 1) // self.page_len)
+            key = (bucket, pages)
+        else:
+            key = bucket
+        hit = self._prefill_cost.get(key)
         if hit is None:
             hit = float(self._cost_fn()(bucket))
-            self._prefill_cost[bucket] = hit
+            if self.paged:
+                hit += self._page_write_cost(prompt_len)
+            self._prefill_cost[key] = hit
         return hit
 
     def plan_prompt_buckets(self, observed_lengths, max_edges=4):
         """Cost-optimal prompt buckets for an OBSERVED length
         distribution: ``lod.select_bucket_edges`` weighted by the
-        prefill program's static FLOPs-per-bucket.  Returns a sorted
-        edge list (capped at the bundle's ``max_len``) an operator can
-        bake into the next export's ``gen_meta.json``."""
+        prefill program's static FLOPs-per-bucket (plus, for paged
+        bundles, the candidate length's page-seeding writes).  Returns
+        a sorted edge list (capped at the bundle's ``max_len``) an
+        operator can bake into the next export's ``gen_meta.json``."""
         from paddle_tpu.lod import select_bucket_edges
         lengths = [min(max(int(n), 1), self.max_len)
                    for n in observed_lengths]
+        cost_of = self._cost_fn()
+        if self.paged:
+            base = cost_of
+
+            def cost_of(n):
+                return float(base(n)) + self._page_write_cost(n)
         return select_bucket_edges(lengths, max_edges=max_edges,
-                                   cost_of=self._cost_fn())
+                                   cost_of=cost_of)
+
+    def plan_page_buckets(self, observed_lengths, max_edges=4):
+        """Cost-optimal page-count bucket edges for an OBSERVED
+        prefix-length distribution: ``lod.select_bucket_edges`` over
+        live page counts, priced by the decode program's static cost as
+        a function of the page-table width (``cost.row_cost_fn``
+        probing the bucketed dim — the paged_attention cost rule makes
+        that dimension carry the pages actually read).  Returns a
+        sorted edge list an operator can bake into the next export's
+        ``page_buckets``."""
+        if not self.paged:
+            raise ValueError("plan_page_buckets needs a paged bundle")
+        from paddle_tpu.lod import select_bucket_edges
+        counts = [min(max(-(-int(n) // self.page_len), 1),
+                      self.pages_per_slot) for n in observed_lengths]
+        with self._lock:
+            if self._page_cost_fn is None:
+                from paddle_tpu.analysis import cost as _cost
+                self._page_cost_fn = _cost.row_cost_fn(
+                    self._dec_prog, batch_var="gen_page_table", dim=1,
+                    probe_rows=(1, max(self.pages_per_slot, 2)))
+            fn = self._page_cost_fn
+        return select_bucket_edges(counts, max_edges=max_edges,
+                                   cost_of=fn)
+
+    # -- page allocator (paged bundles; driven by the scheduler) -----------
+    @property
+    def free_pages(self):
+        """Unallocated pool pages (paged bundles; 0 for dense)."""
+        if not self.paged:
+            return 0
+        with self._lock:
+            return len(self._free_list)
+
+    def pages_needed(self, prompt_len, max_new_tokens=1):
+        """Pages a request must hold to decode to its length horizon
+        WITHOUT mid-request allocation (allocation happens once, at
+        admission — growth can never fail mid-decode)."""
+        horizon = min(self.max_len,
+                      int(prompt_len) + max(int(max_new_tokens), 1))
+        return -(-max(horizon, 1) // self.page_len)
+
+    def alloc_slot_pages(self, slot, n):
+        """Assign ``n`` pool pages to ``slot`` (prefix order).  Raises
+        ``RuntimeError`` when the pool cannot cover it — callers check
+        :attr:`free_pages` first (admission backpressure)."""
+        n = max(1, min(int(n), self.pages_per_slot))
+        with self._lock:
+            if slot in self._slot_pages:
+                raise ValueError(f"slot {slot} already holds pages")
+            if len(self._free_list) < n:
+                raise RuntimeError(
+                    f"page pool exhausted: slot {slot} needs {n} "
+                    f"page(s), {len(self._free_list)} free")
+            pages = [self._free_list.pop(0) for _ in range(n)]
+            self._slot_pages[slot] = pages
+            self._page_table[slot, :] = 0
+            self._page_table[slot, :n] = pages
+            return list(pages)
+
+    def free_all_pages(self):
+        """Return EVERY slot's pages to the pool — the scheduler's
+        crash-reset path, which discards all slots wholesale; returns
+        the number of pages freed."""
+        if not self.paged:
+            return 0
+        with self._lock:
+            slots = list(self._slot_pages)
+        return sum(self.free_slot_pages(s) for s in slots)
+
+    def free_slot_pages(self, slot):
+        """Return ``slot``'s pages to the free list (idempotent);
+        returns the number freed.  The rows themselves are reclaimed
+        lazily — re-allocation seeds pages via :meth:`write_slot`
+        before any read addresses them."""
+        if not self.paged:
+            return 0
+        with self._lock:
+            pages = self._slot_pages.pop(slot, None)
+            if not pages:
+                return 0
+            self._free_list.extend(pages)
+            self._page_table[slot, :] = 0
+            return len(pages)
 
     def _prefill_feed(self, prompt, bucket):
         from paddle_tpu.lod import pad_to_bucket
@@ -208,9 +349,28 @@ class GenPredictor:
         A device-side slice update (``at[slot].set``): only the one
         seeded row crosses host->device, and the pool itself never
         round-trips — per-admission cost stays O(max_len), not
-        O(num_slots * max_len)."""
+        O(num_slots * max_len).  Paged bundles write the slot's
+        ALLOCATED pages instead (prompt rows + zero fill — re-used
+        pages carry no stale rows), so the per-admission transfer is
+        O(pages_needed * page_len)."""
         import jax.numpy as jnp
         with self._lock:
+            if self.paged:
+                pages = self._slot_pages.get(slot)
+                if pages is None:
+                    raise RuntimeError(
+                        f"write_slot({slot}) before alloc_slot_pages")
+                idx = np.asarray(pages, np.int64)
+                cap = len(pages) * self.page_len
+                for name, arr in zip(self.cache_vars, kv):
+                    rows = min(arr.shape[1], self.max_len, cap)
+                    buf = np.zeros(
+                        (len(pages), self.page_len, arr.shape[2]),
+                        arr.dtype)
+                    buf.reshape(-1, arr.shape[2])[:rows] = arr[0, :rows]
+                    cache = jnp.asarray(self._scope.find_var(name))
+                    self._scope.set_var(name, cache.at[idx].set(buf))
+                return
             for name, arr in zip(self.cache_vars, kv):
                 rows = min(arr.shape[1], self.max_len)
                 row = np.zeros((self.max_len, arr.shape[2]), arr.dtype)
@@ -221,22 +381,37 @@ class GenPredictor:
     def clear_slot(self, slot):
         """Zero a reclaimed slot's cache rows (device-side slice
         update).  Not strictly required — admission overwrites the
-        whole row — but keeps a freed slot from pinning stale request
-        data."""
+        whole row (or, paged, seeds every re-allocated page) — but
+        keeps a freed slot from pinning stale request data."""
         import jax.numpy as jnp
         with self._lock:
+            if self.paged:
+                pages = self._slot_pages.get(slot)
+                if not pages:
+                    return
+                idx = np.asarray(pages, np.int64)
+                for name in self.cache_vars:
+                    cache = jnp.asarray(self._scope.find_var(name))
+                    self._scope.set_var(name, cache.at[idx].set(0.0))
+                return
             for name in self.cache_vars:
                 cache = jnp.asarray(self._scope.find_var(name))
                 self._scope.set_var(name, cache.at[slot].set(0.0))
 
     # -- decode ------------------------------------------------------------
-    def decode_step(self, tokens, positions, pos_onehot, attn_mask):
+    def decode_step(self, tokens, positions, pos_onehot=None,
+                    attn_mask=None, lens=None):
         """One decode iteration over the whole slot pool.
 
-        ``tokens``/``positions``: int32 ``[S]`` (zeros for free slots);
-        ``pos_onehot``: f32 ``[S, L]`` write mask (all-zero rows for
-        free slots — their cache is never touched); ``attn_mask``: f32
-        ``[S, L]`` attendable-position mask.  Returns logits ``[S, V]``.
+        ``tokens``/``positions``: int32 ``[S]`` (zeros for free slots).
+        Dense bundles take ``pos_onehot``: f32 ``[S, L]`` write mask
+        (all-zero rows for free slots — their cache is never touched)
+        and ``attn_mask``: f32 ``[S, L]`` attendable-position mask.
+        Paged bundles take ``lens``: int32 ``[S]`` prefix rows
+        INCLUDING the current token (0 = free slot) — the page-table
+        feed is sliced to the smallest declared page bucket covering
+        ``max(lens)``, so the jit key is the bucket.  Returns logits
+        ``[S, V]``.
 
         The ``gen.decode.stall`` failpoint fires INSIDE the lock: a
         ``delay`` action models per-iteration device time serialized per
@@ -247,9 +422,15 @@ class GenPredictor:
         feed = {
             "gen_token": np.asarray(tokens, np.int32).reshape(S, 1),
             "gen_pos": np.asarray(positions, np.int32).reshape(S, 1),
-            "gen_pos_onehot": np.asarray(pos_onehot, np.float32),
-            "gen_attn_mask": np.asarray(attn_mask, np.float32),
         }
+        if self.paged:
+            if lens is None:
+                raise ValueError("paged decode_step needs lens")
+            feed.update(self._paged_decode_feed(
+                np.asarray(lens, np.int32).reshape(S, 1)))
+        else:
+            feed["gen_pos_onehot"] = np.asarray(pos_onehot, np.float32)
+            feed["gen_attn_mask"] = np.asarray(attn_mask, np.float32)
         with self._lock:
             chaos.fire("gen.decode.stall", slots=S)
             with self._fluid.scope_guard(self._scope):
@@ -258,12 +439,40 @@ class GenPredictor:
                                               fetch_list=self._dec_fetch)
         return np.asarray(logits)
 
+    def _paged_decode_feed(self, lens):
+        """Page-table + lens feed for one paged step: slice the table
+        to the smallest declared page bucket covering the longest live
+        prefix (clamped to ``pages_per_slot`` — ``row_bucket`` past the
+        declared ladder falls back to its power-of-two ladder, which
+        must never widen the jit key beyond the pool)."""
+        from paddle_tpu.lod import row_bucket
+        from paddle_tpu.profiler import runtime_metrics
+        live = lens[:, 0] > 0
+        need = 1
+        if live.any():
+            need = int(-(-int(lens[live, 0].max()) // self.page_len))
+        P = min(row_bucket(max(need, 1), edges=self.page_buckets),
+                self.pages_per_slot)
+        touched = int(np.sum(-(-lens[live, 0] // self.page_len)))
+        runtime_metrics.observe("gen.paged.pages_touched",
+                                float(touched))
+        if touched:
+            occupancy = (100.0 * float(lens[live, 0].sum()) /
+                         (touched * self.page_len))
+            runtime_metrics.bucket("gen.paged.page_occupancy",
+                                   int(occupancy))
+        with self._lock:
+            table = np.ascontiguousarray(self._page_table[:, :P])
+        return {"gen_page_table": table, "gen_lens": lens}
+
     # -- warmup ------------------------------------------------------------
     def warmup(self):
         """AOT-compile BOTH signature families — one prefill signature
-        per declared prompt bucket plus the (single) decode signature —
-        so the first real ``/generate`` pays zero compile time.  Returns
-        a :class:`~paddle_tpu.obs.perf.WarmupReport` (int = fresh
+        per declared prompt bucket plus the decode signature family
+        (ONE signature for dense bundles; one per declared page bucket
+        for paged bundles) — so the first real ``/generate`` pays zero
+        compile time.  Returns a
+        :class:`~paddle_tpu.obs.perf.WarmupReport` (int = fresh
         compiles; ``buckets`` carries one per-signature entry tagged
         ``program: prefill|decode`` with compile seconds and
         cold/persistent-hit/warm provenance — what ``/stats`` surfaces
@@ -276,8 +485,16 @@ class GenPredictor:
                          "gen_mask": (1, b), "gen_attn_bias": (1, 1, b, b),
                          "gen_last": (1, b)})
         S, L = self.num_slots, self.max_len
-        dec_sig = {"gen_token": (S, 1), "gen_pos": (S, 1),
-                   "gen_pos_onehot": (S, L), "gen_attn_mask": (S, L)}
+        if self.paged:
+            dec_sigs = [{"gen_token": (S, 1), "gen_pos": (S, 1),
+                         "gen_page_table": (S, int(P)),
+                         "gen_lens": (S, 1)}
+                        for P in self.page_buckets
+                        if P <= self.pages_per_slot]
+        else:
+            dec_sigs = [{"gen_token": (S, 1), "gen_pos": (S, 1),
+                         "gen_pos_onehot": (S, L),
+                         "gen_attn_mask": (S, L)}]
         from paddle_tpu.obs.perf import WarmupReport
         with self._lock:
             with self._fluid.scope_guard(self._scope):
@@ -286,10 +503,10 @@ class GenPredictor:
                     scope=self._scope)
                 # the decode step writes its (persistable) cache tensors
                 # in place — declare exactly those as intended state
-                # updates (a zero pos-onehot writes nothing, so warmup
-                # leaves the pool untouched)
+                # updates (a zero pos-onehot / zero lens feed writes
+                # nothing, so warmup leaves the pool untouched)
                 dec = self._exe.warmup(
-                    self._dec_prog, [dec_sig], fetch_list=self._dec_fetch,
-                    scope=self._scope,
+                    self._dec_prog, dec_sigs,
+                    fetch_list=self._dec_fetch, scope=self._scope,
                     allow_state_updates=self.cache_vars)
         return WarmupReport.merge(pre, dec, labels=("prefill", "decode"))

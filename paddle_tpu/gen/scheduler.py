@@ -270,6 +270,11 @@ class GenScheduler:
                 self._restarts += 1
             elif not self._closed:
                 self._failed = exc
+        # the wholesale slot reset above must also reset the page
+        # pool, or a crash strands every live allocation and the
+        # restarted loop livelocks on page-aware admission
+        if getattr(self.predictor, "paged", False):
+            self.predictor.free_all_pages()
         if restart:
             _profiler.runtime_metrics.inc("gen.scheduler_restarts")
             self._thread = self._spawn_thread()
@@ -304,6 +309,11 @@ class GenScheduler:
             _profiler.runtime_metrics.set_gauge("gen.slots_active",
                                                 len(self._slots))
             _slo_tick(self.slo_watchdog)
+        # shutdown discards the slots wholesale; return their pages so
+        # a later scheduler over the SAME predictor starts with a full
+        # pool (the test suite reuses warmed predictors this way)
+        if getattr(self.predictor, "paged", False):
+            self.predictor.free_all_pages()
         err = RuntimeError("generation scheduler shut down")
         for _, slot in active:
             slot.stream.fail(err)
@@ -349,6 +359,19 @@ class GenScheduler:
                     if refill is None:
                         refill = not self._slots
                     if not refill:
+                        return
+                if getattr(self.predictor, "paged", False):
+                    # page-aware admission: a request is only admitted
+                    # when the pool can cover its WHOLE length horizon
+                    # (allocation happens once, at admission), so decode
+                    # growth never fails mid-request; otherwise the
+                    # head-of-line request waits for an eviction to
+                    # return pages — backpressure, like the FLOPs
+                    # budget below, not an error
+                    head = self._queue[0]
+                    need = self.predictor.pages_needed(
+                        len(head.prompt), head.max_new_tokens)
+                    if need > self.predictor.free_pages:
                         return
                 if self.prefill_budget is not None and admitted_n:
                     # cost-weighted admission: stop once this pass has
@@ -403,7 +426,21 @@ class GenScheduler:
             return self._finish(stream, "eos")
         if stream.max_new_tokens <= 1 or prompt_len >= self.predictor.max_len:
             return self._finish(stream, "length")
-        self.predictor.write_slot(slot_idx, kv, prompt_len)
+        if getattr(self.predictor, "paged", False):
+            try:
+                self.predictor.alloc_slot_pages(
+                    slot_idx, self.predictor.pages_needed(
+                        prompt_len, stream.max_new_tokens))
+            except BaseException as e:
+                stream.fail(e)
+                return False
+            try:
+                self.predictor.write_slot(slot_idx, kv, prompt_len)
+            except BaseException:
+                self.predictor.free_slot_pages(slot_idx)
+                raise
+        else:
+            self.predictor.write_slot(slot_idx, kv, prompt_len)
         with self._cv:
             self._slots[slot_idx] = _Slot(stream, prompt_len, first)
         return True
@@ -415,13 +452,17 @@ class GenScheduler:
         return False
 
     def _evict(self, slot_idx, reason=None):
+        # Eviction runs only on the scheduler thread, so the slot
+        # cannot be re-admitted while this is in flight.  The slot is
+        # removed from `_slots`/returned to `_free` LAST: once
+        # `active_slots` reads 0, the slot's pages are already back in
+        # the pool — observers (and page-aware admission) never see a
+        # half-evicted slot.
         from paddle_tpu import profiler as _profiler
         with self._cv:
-            slot = self._slots.pop(slot_idx, None)
+            slot = self._slots.get(slot_idx)
             if slot is None:
                 return
-            self._free.append(slot_idx)
-        _profiler.runtime_metrics.inc("gen.evictions")
         if reason == "disconnect":
             _profiler.runtime_metrics.inc("gen.disconnects")
             self.predictor.clear_slot(slot_idx)
@@ -429,6 +470,17 @@ class GenScheduler:
             # LOCAL consumer that cancelled must not block forever on a
             # stream nobody will ever finish
             slot.stream.finish("disconnect")
+        # paged bundles: EVERY eviction (eos / length / disconnect)
+        # returns the slot's pages to the pool — the admission
+        # backpressure above turns a leak here into a livelock; for
+        # disconnects this runs AFTER clear_slot, which addresses
+        # pages through the still-live allocation
+        if getattr(self.predictor, "paged", False):
+            self.predictor.free_slot_pages(slot_idx)
+        with self._cv:
+            self._slots.pop(slot_idx, None)
+            self._free.append(slot_idx)
+        _profiler.runtime_metrics.inc("gen.evictions")
 
     def _decode_iteration(self):
         """One token for every live slot: sweep disconnects, build the
@@ -447,17 +499,28 @@ class GenScheduler:
         S, L = self.predictor.num_slots, self.predictor.max_len
         tokens = np.zeros(S, np.int32)
         positions = np.zeros(S, np.int32)
-        pos_onehot = np.zeros((S, L), np.float32)
-        attn_mask = np.zeros((S, L), np.float32)
+        paged = getattr(self.predictor, "paged", False)
+        if paged:
+            lens = np.zeros(S, np.int32)
+        else:
+            pos_onehot = np.zeros((S, L), np.float32)
+            attn_mask = np.zeros((S, L), np.float32)
         for idx, slot in live:
             tokens[idx] = slot.last_token
             positions[idx] = slot.pos
-            pos_onehot[idx, slot.pos] = 1.0
-            attn_mask[idx, :slot.pos + 1] = 1.0
+            if paged:
+                lens[idx] = slot.pos + 1
+            else:
+                pos_onehot[idx, slot.pos] = 1.0
+                attn_mask[idx, :slot.pos + 1] = 1.0
         _profiler.runtime_metrics.bucket("gen.slot_occupancy", len(live))
         t0 = time.perf_counter()
-        logits = self.predictor.decode_step(tokens, positions, pos_onehot,
-                                            attn_mask)
+        if paged:
+            logits = self.predictor.decode_step(tokens, positions,
+                                                lens=lens)
+        else:
+            logits = self.predictor.decode_step(tokens, positions,
+                                                pos_onehot, attn_mask)
         now = time.perf_counter()
         _profiler.runtime_metrics.observe("gen.decode_step_seconds",
                                           now - t0)

@@ -4,17 +4,19 @@ machine_translation/transformer, stacked_dynamic_lstm) — re-built on the
 TPU-native layers API."""
 
 from paddle_tpu.models import (resnet, transformer, vgg, mnist,
-                               seq2seq, stacked_lstm, gen_lm)
+                               seq2seq, stacked_lstm, gen_lm,
+                               gen_lm_long)
 
 __all__ = ["resnet", "transformer", "vgg", "mnist",
-           "seq2seq", "stacked_lstm", "gen_lm", "ZOO_MODELS",
+           "seq2seq", "stacked_lstm", "gen_lm", "gen_lm_long",
+           "ZOO_MODELS",
            "build_train_program", "synth_feed", "compile_zoo_step"]
 
 #: zoo model names accepted by :func:`build_train_program` (and by
 #: ``paddle_tpu lint --zoo``; the lint gate in
 #: tests/test_analysis_zoo.py iterates exactly this list)
 ZOO_MODELS = ("mnist", "resnet", "vgg", "transformer", "seq2seq",
-              "stacked_lstm", "gen_lm")
+              "stacked_lstm", "gen_lm", "gen_lm_long")
 
 
 def build_train_program(name, backward=True):
@@ -63,6 +65,16 @@ def build_train_program(name, backward=True):
             hp.n_head = hp.n_layer = 2
             hp.d_head, hp.max_len = 8, 16
             cost, feeds = gen_lm.gen_lm_train_program(2, 8, hp)
+            fetches = [cost.name]
+        elif name == "gen_lm_long":
+            # flagship long-context geometry: max_len stays at the
+            # GenLongConfig 256 (the gated axis); the rest shrinks to
+            # smoke-test scale like the base gen_lm entry
+            hp = gen_lm_long.GenLongConfig()
+            hp.vocab_size, hp.d_model, hp.d_ffn = 32, 16, 32
+            hp.n_head = hp.n_layer = 2
+            hp.d_head = 8
+            cost, feeds = gen_lm_long.gen_lm_long_train_program(2, 16, hp)
             fetches = [cost.name]
         else:
             raise ValueError(

@@ -18,6 +18,16 @@ programs, the vLLM/Orca-style entry pair:
   mask.  Every decode step has the SAME signature — admission and
   eviction never recompile.
 
+The default export uses the PAGED decode variant
+(:func:`build_paged_decode_program`): the cache pool lives as
+``[num_pages, page_len, H*D]`` fixed-size pages plus a per-slot page
+table, and each step attends only the pages covering ``[0, len)`` per
+slot — decode reads scale with live prefix length instead of the padded
+``max_len`` (ROADMAP item 3).  The page-table feed's width is bucketed
+(``page_buckets``) so the jit key stays constant per bucket; the dense
+variant remains exportable with ``paged=False`` (the equivalence
+baseline and bench comparison point).
+
 The third entry, :func:`gen_lm_train_program`, is the teacher-forced
 training graph over the same parameter names (and the model-zoo lint
 gate's view of this model).
@@ -35,9 +45,14 @@ from paddle_tpu.initializer import NumpyArrayInitializer
 from paddle_tpu.param_attr import ParamAttr
 
 __all__ = ["GenConfig", "build_prefill_program", "build_decode_program",
-           "gen_lm_train_program", "export_gen_model", "META_FILENAME"]
+           "build_paged_decode_program", "gen_lm_train_program",
+           "export_gen_model", "META_FILENAME", "PAGE_LEN_DEFAULT",
+           "paged_cache_var_names", "default_page_buckets"]
 
 META_FILENAME = "gen_meta.json"
+
+#: default KV page length (rows per page) for paged exports
+PAGE_LEN_DEFAULT = 16
 
 
 class GenConfig:
@@ -142,6 +157,29 @@ def cache_var_names(hp):
         names.append(f"genlm_cache_k_{i}")
         names.append(f"genlm_cache_v_{i}")
     return names
+
+
+def paged_cache_var_names(hp):
+    """The PAGED decode program's persistable page-pool tensor names,
+    in the same (k, v) per-layer order as :func:`cache_var_names`."""
+    names = []
+    for i in range(hp.n_layer):
+        names.append(f"genlm_paged_k_{i}")
+        names.append(f"genlm_paged_v_{i}")
+    return names
+
+
+def default_page_buckets(pages_per_slot):
+    """Power-of-two page-count bucket ladder capped at ``pages_per_slot``
+    (NOT :func:`lod.bucket_edges`, whose fallback ladder floors at 8 —
+    page counts are small integers).  ``GenPredictor.plan_page_buckets``
+    replaces this with a measured-workload ladder."""
+    edges, b = [], 1
+    while b < int(pages_per_slot):
+        edges.append(b)
+        b *= 2
+    edges.append(int(pages_per_slot))
+    return sorted(set(edges))
 
 
 # ---------------------------------------------------------------------------
@@ -258,6 +296,78 @@ def build_decode_program(hp, num_slots):
 
 
 # ---------------------------------------------------------------------------
+# paged decode: page-pool cache, page-table feed bucketed by page count
+# ---------------------------------------------------------------------------
+
+def build_paged_decode_program(hp, num_slots, page_len, num_pages):
+    """Build the PAGED single-token decode step in the CURRENT program
+    guard.
+
+    Feeds (static except the bucketed page-table width):
+      ``gen_token`` [S, 1] int32, ``gen_pos`` [S, 1] int32,
+      ``gen_page_table`` [S, P] int32 — per-slot page ids in prefix
+      order; ``P`` is DYNAMIC, padded by the predictor to a
+      ``page_buckets`` edge so the jit key is the bucket,
+      ``gen_lens`` [S, 1] int32 — rows INCLUDING the current token
+      (0 = free slot: nothing written, logits garbage, never read).
+    Persistable state: per-layer ``genlm_paged_k_i`` / ``genlm_paged_v_i``
+    [num_pages, page_len, H*D], updated in place by the
+    ``paged_attention`` op (scatter of the step's K/V row into the
+    slot's tail page, then attention over ONLY the table's pages).
+    Fetches: ``logits`` [S, V].
+    """
+    import paddle_tpu as fluid
+    from paddle_tpu.layer_helper import LayerHelper
+
+    S, PL, NP = int(num_slots), int(page_len), int(num_pages)
+    hd = hp.n_head * hp.d_head
+
+    def data(name, shape, dtype="float32"):
+        return layers.data(name=name, shape=shape, dtype=dtype,
+                           append_batch_size=False)
+
+    token = data("gen_token", [S, 1], "int32")
+    pos = data("gen_pos", [S, 1], "int32")
+    page_table = data("gen_page_table", [S, -1], "int32")
+    lens = data("gen_lens", [S, 1], "int32")
+
+    block = fluid.default_main_program().global_block()
+    caches = {}
+    for name in paged_cache_var_names(hp):
+        c = block.create_var(name=name, shape=[NP, PL, hd],
+                             dtype="float32")
+        c.persistable = True
+        c.stop_gradient = True
+        caches[name] = c
+
+    x = _embed(token, pos, hp)                         # [S, d]
+    x = layers.reshape(x, shape=[S, 1, hp.d_model])
+    for i in range(hp.n_layer):
+        q, k, v = _qkv(x, hp, i)                       # [S, 1, H*D]
+        pk = caches[f"genlm_paged_k_{i}"]
+        pv = caches[f"genlm_paged_v_{i}"]
+        helper = LayerHelper("paged_attention")
+        ctxv = helper.create_tmp_variable("float32")
+        helper.append_op(
+            type="paged_attention",
+            inputs={"Q": [q], "K": [k], "V": [v],
+                    "KCache": [pk], "VCache": [pv],
+                    "PageTable": [page_table], "Lens": [lens]},
+            outputs={"Out": [ctxv], "KCacheOut": [pk], "VCacheOut": [pv]},
+            attrs={"n_head": int(hp.n_head),
+                   "scale": float(hp.d_head) ** -0.5})
+        attn = layers.fc(ctxv, hp.d_model, num_flatten_dims=2,
+                         bias_attr=False,
+                         param_attr=_pa(f"genlm{i}_attnout.w"))
+        x = _block_tail(x, attn, hp, i)
+    x2 = layers.reshape(x, shape=[S, hp.d_model])
+    logits = layers.fc(x2, hp.vocab_size, bias_attr=False,
+                       param_attr=_pa("genlm_logits.w"))
+    feeds = ["gen_token", "gen_pos", "gen_page_table", "gen_lens"]
+    return feeds, [logits]
+
+
+# ---------------------------------------------------------------------------
 # training graph (teacher-forced) — also the model-zoo lint gate's view
 # ---------------------------------------------------------------------------
 
@@ -313,11 +423,20 @@ def _write_model(dirname, program, feed_names, fetch_vars, executor):
 
 
 def export_gen_model(dirname, hp: GenConfig = None, num_slots=8,
-                     prompt_buckets=None):
+                     prompt_buckets=None, paged=True,
+                     page_len=PAGE_LEN_DEFAULT, num_pages=None,
+                     page_buckets=None):
     """Export a generation bundle: ``<dirname>/prefill/``,
     ``<dirname>/decode/`` (each a loadable inference model over ONE
     shared parameter set) and ``<dirname>/gen_meta.json`` describing the
-    cache pool geometry.  Returns ``dirname``."""
+    cache pool geometry.  Returns ``dirname``.
+
+    ``paged=True`` (the default) exports the page-pool decode variant:
+    ``page_len`` rows per page (clamped to ``max_len``), ``num_pages``
+    pool pages (default ``num_slots * ceil(max_len / page_len)`` — every
+    slot can always grow to ``max_len``), ``page_buckets`` the declared
+    page-count jit-signature ladder.  ``paged=False`` keeps the dense
+    ``[num_slots, max_len]`` layout (the equivalence baseline)."""
     import paddle_tpu as fluid
     from paddle_tpu.lod import bucket_edges
 
@@ -325,6 +444,12 @@ def export_gen_model(dirname, hp: GenConfig = None, num_slots=8,
     num_slots = int(num_slots)
     if prompt_buckets is None:
         prompt_buckets = bucket_edges(1, hp.max_len)
+    if paged:
+        page_len = max(1, min(int(page_len), int(hp.max_len)))
+        pps = -(-int(hp.max_len) // page_len)
+        num_pages = num_slots * pps if num_pages is None else int(num_pages)
+        if page_buckets is None:
+            page_buckets = default_page_buckets(pps)
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe = fluid.Executor()
@@ -337,13 +462,23 @@ def export_gen_model(dirname, hp: GenConfig = None, num_slots=8,
 
         dec_main, dec_startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(dec_main, dec_startup):
-            dec_feeds, dec_fetches = build_decode_program(hp, num_slots)
+            if paged:
+                dec_feeds, dec_fetches = build_paged_decode_program(
+                    hp, num_slots, page_len, num_pages)
+            else:
+                dec_feeds, dec_fetches = build_decode_program(hp,
+                                                              num_slots)
         # decode shares the ALREADY-initialized parameters (its startup
         # is never run); the cache pool starts as zeros
         hd = hp.n_head * hp.d_head
-        for name in cache_var_names(hp):
-            scope.set_var(name, np.zeros((num_slots, hp.max_len, hd),
-                                         dtype="float32"))
+        if paged:
+            for name in paged_cache_var_names(hp):
+                scope.set_var(name, np.zeros((num_pages, page_len, hd),
+                                             dtype="float32"))
+        else:
+            for name in cache_var_names(hp):
+                scope.set_var(name, np.zeros((num_slots, hp.max_len, hd),
+                                             dtype="float32"))
         _write_model(os.path.join(dirname, "decode"), dec_main,
                      dec_feeds, dec_fetches, exe)
 
@@ -354,9 +489,17 @@ def export_gen_model(dirname, hp: GenConfig = None, num_slots=8,
         "vocab_size": int(hp.vocab_size),
         "n_layer": int(hp.n_layer),
         "eos_id": int(hp.eos_id),
-        "cache_vars": cache_var_names(hp),
+        "cache_vars": (paged_cache_var_names(hp) if paged
+                       else cache_var_names(hp)),
         "prompt_buckets": [int(b) for b in prompt_buckets],
     }
+    if paged:
+        meta.update({
+            "page_len": int(page_len),
+            "num_pages": int(num_pages),
+            "page_buckets": [int(b) for b in page_buckets],
+            "page_table_feed": "gen_page_table",
+        })
     with open(os.path.join(dirname, META_FILENAME), "w") as f:
         json.dump(meta, f, indent=2)
     # post-export contract (analysis/distributed.py): the bundle's

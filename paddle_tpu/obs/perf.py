@@ -430,8 +430,10 @@ OPTIMIZER_STATE_PREFIXES = (
 )
 
 #: census collections, in attribution priority order; provider-backed
-#: collections claim their buffers before the scope walk
-HBM_COLLECTIONS = ("kv_cache", "prefetch", "optimizer", "params")
+#: collections claim their buffers before the scope walk (``kv_pages``:
+#: a paged gen bundle's page pool + its host-side page tables)
+HBM_COLLECTIONS = ("kv_cache", "kv_pages", "prefetch", "optimizer",
+                   "params")
 
 _hbm_lock = threading.Lock()
 _hbm_providers = {}     # collection -> {token: callable}
@@ -543,6 +545,7 @@ def hbm_census(scope=None, metrics=None):
             census[collection] += int(nbytes)
 
     claim("kv_cache", _provider_arrays("kv_cache"))
+    claim("kv_pages", _provider_arrays("kv_pages"))
     claim("prefetch", _provider_arrays("prefetch"))
 
     if scope is None:
@@ -577,6 +580,7 @@ def hbm_census(scope=None, metrics=None):
     m.set_gauge("hbm.params_bytes", census["params"])
     m.set_gauge("hbm.optimizer_bytes", census["optimizer"])
     m.set_gauge("hbm.kv_cache_bytes", census["kv_cache"])
+    m.set_gauge("hbm.kv_pages_bytes", census["kv_pages"])
     m.set_gauge("hbm.prefetch_bytes", census["prefetch"])
     m.set_gauge("hbm.other_bytes", census["other"])
     m.set_gauge("hbm.total_bytes", census["total"])

@@ -918,3 +918,204 @@ def _fused_softmax_bwd(interpret, y, g):
 
 
 fused_softmax.defvjp(_fused_softmax_fwd, _fused_softmax_bwd)
+
+
+# ---------------------------------------------------------------------------
+# paged_attention IR op — occupancy-proportional decode reads over the gen
+# KV pool (ROADMAP item 3).  The gen cache lives as [num_pages, page_len,
+# H*D] pages plus a per-slot page table; each decode step appends the new
+# token's K/V row into its slot's tail page, then attends ONLY the pages
+# covering [0, len) — bytes read scale with live prefix length, not the
+# padded max_len.  The page-table feed is bucketed by the predictor so the
+# decode jit key stays constant per bucket.  A Pallas kernel (grid =
+# (slot, page), page picked by a scalar-prefetch table lookup, online
+# softmax across pages) serves TPUs; an XLA gather fallback shares the
+# same lowering contract and is the default off-TPU — interpret-mode
+# execution re-runs the kernel per call (unlike trace-once XLA), so tests
+# opt in via PADDLE_TPU_PAGED_INTERPRET=1 instead.
+# ---------------------------------------------------------------------------
+
+def _paged_cache_update(kc, vc, k, v, page_table, lens):
+    """Scatter this step's K/V row into each live slot's tail page.
+
+    ``lens`` [S, 1] counts rows INCLUDING the token being decoded, so the
+    write lands at position ``lens-1``; ``lens == 0`` marks a free slot
+    and maps to an out-of-range page that ``mode="drop"`` discards —
+    zero-filled warmup feeds therefore write nothing.
+    """
+    NP, PL, _ = kc.shape
+    last = lens[:, 0] - 1
+    idx = jnp.clip(last, 0)
+    page = jnp.take_along_axis(page_table, (idx // PL)[:, None], axis=1)[:, 0]
+    page = jnp.where(last >= 0, page, NP)
+    row = idx % PL
+    kc = kc.at[page, row].set(k.reshape(k.shape[0], -1), mode="drop")
+    vc = vc.at[page, row].set(v.reshape(v.shape[0], -1), mode="drop")
+    return kc, vc
+
+
+def _xla_paged_attention(q, kc, vc, page_table, lens, n_head, scale):
+    """Gather-based fallback: same contract as the kernel.  Reads only
+    the ``P`` table-listed pages per slot ([S, P*PL] keys instead of the
+    dense pool's [S, max_len]) — still occupancy-proportional, just
+    without the VMEM-resident online softmax."""
+    S, P = page_table.shape
+    NP, PL, HD = kc.shape
+    H = n_head
+    D = HD // H
+    T = P * PL
+    kg = kc[page_table].reshape(S, T, H, D)
+    vg = vc[page_table].reshape(S, T, H, D)
+    qh = q.reshape(S, H, D).astype(jnp.float32)
+    sc = jnp.einsum("shd,sthd->sht", qh, kg.astype(jnp.float32),
+                    preferred_element_type=jnp.float32) * scale
+    col = jax.lax.broadcasted_iota(jnp.int32, (S, 1, T), 2)
+    sc = jnp.where(col < lens[:, :, None], sc, NEG_INF)
+    probs = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum("sht,sthd->shd", probs, vg.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(q.shape).astype(q.dtype)
+
+
+def _paged_decode_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, page_len, scale):
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _M_INIT)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)            # [H, D]
+    k = k_ref[0].astype(jnp.float32)            # [PL, H, D]
+    v = v_ref[0].astype(jnp.float32)
+    sc = jax.lax.dot_general(                   # [H, PL]: batch H, contract D
+        q, k, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32) * scale
+    valid = lens_ref[s, 0] - p * page_len
+    col = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+    sc = jnp.where(col < valid, sc, NEG_INF)
+
+    m_prev = m_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    e = jnp.exp(sc - m_new)
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(e, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(                   # [H, D]: batch H, contract PL
+        e, v, (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = m_new
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _finish():
+        # a free slot (lens == 0) masks every page: l stays 0, the guard
+        # yields finite garbage the scheduler never reads
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _pallas_paged_attention(q, kc, vc, page_table, lens, n_head, scale,
+                            interpret=False):
+    S, P = page_table.shape
+    NP, PL, HD = kc.shape
+    H = n_head
+    D = HD // H
+    if H * D != HD:
+        return None
+    if not interpret and (D % 128 or PL % 8):
+        return None  # lane/sublane tiling gate
+    q4 = q.reshape(S, H, D)
+    kc4 = kc.reshape(NP, PL, H, D)
+    vc4 = vc.reshape(NP, PL, H, D)
+    kernel = functools.partial(_paged_decode_kernel, page_len=PL,
+                               scale=scale)
+    try:
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(S, P),
+                in_specs=[
+                    pl.BlockSpec((1, H, D),
+                                 lambda s, p, pt, ln: (s, 0, 0)),
+                    pl.BlockSpec((1, PL, H, D),
+                                 lambda s, p, pt, ln: (pt[s, p], 0, 0, 0)),
+                    pl.BlockSpec((1, PL, H, D),
+                                 lambda s, p, pt, ln: (pt[s, p], 0, 0, 0)),
+                ],
+                out_specs=pl.BlockSpec((1, H, D),
+                                       lambda s, p, pt, ln: (s, 0, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((H, D), jnp.float32),
+                    pltpu.VMEM((H, 1), jnp.float32),
+                    pltpu.VMEM((H, 1), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+            interpret=interpret,
+        )(page_table, lens, q4, kc4, vc4)
+    except Exception:  # pragma: no cover - lowering limits
+        return None
+    return out.reshape(q.shape)
+
+
+def _paged_kernel_enabled(interpret):
+    if not _HAS_PALLAS:
+        return False
+    if not interpret:
+        return True
+    import os
+    return os.environ.get("PADDLE_TPU_PAGED_INTERPRET", "0") == "1"
+
+
+def _infer_paged_attn(op, block):
+    q = block.var(op.input("Q")[0])
+    out = block.var(op.output("Out")[0])
+    if q.shape is None:
+        raise ShapeInferenceSkip()
+    out.shape = tuple(q.shape)
+    out.dtype = q.dtype
+    # KCacheOut/VCacheOut alias the persistable cache vars (in-place
+    # update idiom) — their shapes are already declared
+
+
+@register_op("paged_attention", infer_shape=_infer_paged_attn,
+             no_gradient=True,
+             stateful_outputs=("KCacheOut", "VCacheOut"))
+def paged_attention_lower(ctx: LowerContext):
+    """Q/K/V: [S, 1, H*D] this step's projections; KCache/VCache:
+    [num_pages, page_len, H*D] persistable pool; PageTable: [S, P] int32
+    (P = the step's page bucket); Lens: [S, 1] int32 rows INCLUDING the
+    current token (0 = free slot).  Out: [S, 1, H*D]; KCacheOut/
+    VCacheOut name the cache vars themselves (in-place update).
+
+    attrs: n_head (int), scale (float).
+    """
+    q = ctx.input("Q")
+    k = ctx.input("K")
+    v = ctx.input("V")
+    kc = ctx.input("KCache")
+    vc = ctx.input("VCache")
+    pt = ctx.input("PageTable")
+    lens = ctx.input("Lens")
+    n_head = int(ctx.attr("n_head", 1))
+    scale = float(ctx.attr("scale", 1.0))
+    kc, vc = _paged_cache_update(kc, vc, k, v, pt, lens)
+    out = None
+    interpret = _use_interpret()
+    if _paged_kernel_enabled(interpret):
+        out = _pallas_paged_attention(q, kc, vc, pt, lens, n_head, scale,
+                                      interpret=interpret)
+    if out is None:
+        # same coverage contract as attention.fused_softmax_fallback:
+        # fires at trace time, once per compiled signature, whenever a
+        # decode bucket lowered without the Pallas kernel
+        from paddle_tpu.profiler import runtime_metrics
+        runtime_metrics.inc("gen.paged.fallback")
+        out = _xla_paged_attention(q, kc, vc, pt, lens, n_head, scale)
+    ctx.set_output("Out", out)
+    ctx.set_output("KCacheOut", kc)
+    ctx.set_output("VCacheOut", vc)

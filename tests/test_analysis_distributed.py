@@ -200,6 +200,81 @@ def test_negative_case_triggers_code(code):
     assert hit.var or hit.op_type or hit.program, hit.format()
 
 
+def _paged_family(num_slots=2, max_len=16, page_len=4, num_pages=8,
+                  page_buckets=(1, 2, 4), feed_pt=True, pt_rows=None,
+                  cache_shape=None):
+    """Hand-built PAGED prefill/decode pair + meta: pools are
+    ``[num_pages, page_len, hd]`` and decode feeds a dynamic-width
+    page table (the one sanctioned dynamic decode dim)."""
+    pre, pb = _prog()
+    pb.create_var(name="ids", shape=(1, -1), dtype="int32", is_data=True)
+    pb.create_var(name="logits", shape=(1, 16), dtype="float32")
+    pb.create_var(name="k0", shape=(1, -1, 4), dtype="float32")
+    pb.create_var(name="v0", shape=(1, -1, 4), dtype="float32")
+    dec, db = _prog()
+    db.create_var(name="tok", shape=(num_slots, 1), dtype="int32",
+                  is_data=True)
+    feeds = ["tok"]
+    if feed_pt:
+        db.create_var(name="gen_page_table",
+                      shape=(pt_rows or num_slots, -1),
+                      dtype="int32", is_data=True)
+        feeds.append("gen_page_table")
+    for name in ("cache_k_0", "cache_v_0"):
+        c = db.create_var(name=name,
+                          shape=cache_shape or (num_pages, page_len, 4),
+                          dtype="float32")
+        c.persistable = True
+    db.create_var(name="logits", shape=(num_slots, 16), dtype="float32")
+    meta = {"num_slots": num_slots, "max_len": max_len,
+            "cache_vars": ["cache_k_0", "cache_v_0"],
+            "prompt_buckets": [8],
+            "page_len": page_len, "num_pages": num_pages,
+            "page_buckets": list(page_buckets),
+            "page_table_feed": "gen_page_table"}
+    return ((pre, ["ids"], ["logits", "k0", "v0"]),
+            (dec, feeds, ["logits"]), meta)
+
+
+class TestPagedBundleDiagnostics:
+    """The page-bucket family of the gen-bundle verifier: PTA018
+    recompile hazards and PTA019 drift for the paged layout."""
+
+    def _result(self, **kw):
+        return analysis.AnalysisResult(
+            D.check_gen_bundle(*_paged_family(**kw)))
+
+    def test_clean_paged_family_is_silent(self):
+        r = self._result()
+        assert "PTA018" not in r.codes() and "PTA019" not in r.codes(), \
+            r.format()
+
+    def test_missing_page_buckets_is_pta018(self):
+        assert "PTA018" in self._result(page_buckets=()).codes()
+
+    def test_page_bucket_escape_is_pta018(self):
+        # largest bucket covers 2 pages of the 4 a full slot needs:
+        # long prefixes escape the declared ladder and compile fresh
+        assert "PTA018" in self._result(page_buckets=(1, 2)).codes()
+
+    def test_unreachable_page_bucket_is_pta018(self):
+        assert "PTA018" in self._result(
+            page_buckets=(1, 2, 4, 8)).codes()
+
+    def test_missing_page_table_feed_is_pta019(self):
+        assert "PTA019" in self._result(feed_pt=False).codes()
+
+    def test_page_table_leading_dim_drift_is_pta019(self):
+        assert "PTA019" in self._result(pt_rows=3).codes()
+
+    def test_pool_smaller_than_one_slot_is_pta019(self):
+        assert "PTA019" in self._result(num_pages=2).codes()
+
+    def test_pool_geometry_drift_is_pta019(self):
+        assert "PTA019" in self._result(
+            cache_shape=(8, 2, 4)).codes()
+
+
 # ---------------------------------------------------------------------------
 # acceptance drills
 # ---------------------------------------------------------------------------

@@ -450,3 +450,72 @@ class TestFusedSoftmaxGradPrecision:
                                    rtol=1e-2, atol=2e-6)
         # and both sit at the true-f32 answer within quantization
         assert np.max(np.abs(dx_kernel - dx_true)) < 2e-5
+
+
+class TestPagedAttention:
+    """Paged decode attention: the Pallas kernel (interpret mode) and
+    the XLA gather fallback share one lowering contract — same inputs,
+    same masked-softmax semantics over table-listed pages — so they
+    must agree with each other AND with a slot-by-slot dense reference
+    to float32 round-off (mirrors TestFusedSoftmaxGradPrecision's
+    kernel-vs-fallback discipline)."""
+
+    S, H, D, PL, P, NP = 4, 2, 8, 8, 3, 16
+
+    def _case(self, seed=11):
+        rng = np.random.RandomState(seed)
+        S, H, D, PL, P, NP = (self.S, self.H, self.D, self.PL,
+                              self.P, self.NP)
+        q = jnp.asarray(rng.randn(S, H * D).astype("float32") * 0.4)
+        kc = jnp.asarray(rng.randn(NP, PL, H * D).astype("float32") * 0.4)
+        vc = jnp.asarray(rng.randn(NP, PL, H * D).astype("float32") * 0.4)
+        pt = jnp.asarray(
+            rng.permutation(NP)[:S * P].reshape(S, P).astype("int32"))
+        # live prefixes spanning page boundaries, one-row, and a DEAD
+        # slot (lens 0) — the kernel's zero-denominator guard
+        lens = jnp.asarray(np.array([[20], [8], [1], [0]], "int32"))
+        return q, kc, vc, pt, lens
+
+    def _reference(self, q, kc, vc, pt, lens):
+        S, H, D = self.S, self.H, self.D
+        scale = float(D) ** -0.5
+        out = np.zeros((S, H * D), "float32")
+        for s in range(S):
+            n = int(lens[s, 0])
+            if n == 0:
+                continue
+            rows_k = np.asarray(kc)[np.asarray(pt)[s]].reshape(-1, H, D)
+            rows_v = np.asarray(vc)[np.asarray(pt)[s]].reshape(-1, H, D)
+            qs = np.asarray(q)[s].reshape(H, D)
+            for h in range(H):
+                sc = rows_k[:n, h] @ qs[h] * scale
+                p = np.exp(sc - sc.max())
+                p /= p.sum()
+                out[s, h * D:(h + 1) * D] = p @ rows_v[:n, h]
+        return out
+
+    def test_fallback_matches_dense_reference(self):
+        from paddle_tpu.ops import attention_ops as A
+        q, kc, vc, pt, lens = self._case()
+        got = np.asarray(A._xla_paged_attention(
+            q, kc, vc, pt, lens, self.H, float(self.D) ** -0.5))
+        want = self._reference(q, kc, vc, pt, lens)
+        live = np.asarray(lens)[:, 0] > 0
+        np.testing.assert_allclose(got[live], want[live],
+                                   rtol=1e-5, atol=1e-5)
+        # a dead slot (lens 0, fully masked) is never read back — it
+        # only has to stay finite so it cannot poison the batch
+        assert np.all(np.isfinite(got))
+
+    def test_kernel_matches_fallback(self):
+        from paddle_tpu.ops import attention_ops as A
+        q, kc, vc, pt, lens = self._case(seed=12)
+        scale = float(self.D) ** -0.5
+        kernel = A._pallas_paged_attention(q, kc, vc, pt, lens, self.H,
+                                           scale, interpret=True)
+        assert kernel is not None, "interpret kernel unexpectedly gated"
+        fallback = np.asarray(A._xla_paged_attention(
+            q, kc, vc, pt, lens, self.H, scale))
+        np.testing.assert_allclose(np.asarray(kernel), fallback,
+                                   rtol=1e-5, atol=1e-6)
+        assert np.all(np.isfinite(np.asarray(kernel)))

@@ -338,6 +338,10 @@ class TestServingGenerate:
                 server._gen.active_slots > 0:
             time.sleep(0.05)
         assert server._gen.active_slots == 0, "KV slot leaked"
+        # paged pool: eviction must also return the slot's pages to
+        # the free list, or disconnects slowly strand the pool
+        gp = server.gen_predictor
+        assert gp.free_pages == gp.num_pages, "KV pages leaked"
         assert profiler.runtime_metrics.counter(
             "gen.disconnects") == dis + 1
         # decode loop survived the closed socket
@@ -518,3 +522,135 @@ class TestCLI:
             assert out[-1].startswith("# done")
         finally:
             server.shutdown()
+
+
+class TestPagedKV:
+    """Paged KV pool: equivalence against the dense baseline (plain and
+    under PADDLE_TPU_OPT=1), page-allocator lifecycle, page reuse
+    without stale reads, bucketed zero-recompile decode, and
+    occupancy-proportional decode bytes."""
+
+    @pytest.fixture(scope="class")
+    def dense(self, tmp_path_factory):
+        d = str(tmp_path_factory.mktemp("genlm_dense") / "bundle")
+        gen_lm.export_gen_model(d, gen_lm.GenConfig(), num_slots=4,
+                                paged=False)
+        p = GenPredictor(d)
+        p.warmup()
+        return p
+
+    def test_default_export_is_paged(self, predictor):
+        assert predictor.paged
+        assert predictor.meta["page_len"] == 16
+        assert predictor.page_buckets[-1] == predictor.pages_per_slot
+
+    def test_paged_matches_dense_baseline(self, predictor, scheduler,
+                                          dense):
+        """Token-identical across the LAYOUT change, not just against
+        the re-prefill reference: dense pool and paged pool are the
+        same model."""
+        ds = GenScheduler(dense, queue_size=8)
+        try:
+            for prompt in ([5, 9, 3, 17], [2] * 20, [7] * 37):
+                got = list(scheduler.submit(prompt, max_new_tokens=6))
+                assert got == list(ds.submit(prompt, max_new_tokens=6))
+                assert got == _ref_greedy(predictor, prompt, 6)
+        finally:
+            ds.close()
+
+    def test_paged_equivalence_under_opt(self, bundle_dir, predictor,
+                                         monkeypatch):
+        """The optimization pipeline must not reorder the paged op's
+        stateful cache writes: greedy tokens stay identical under
+        PADDLE_TPU_OPT=1."""
+        monkeypatch.setenv("PADDLE_TPU_OPT", "1")
+        p = GenPredictor(bundle_dir)
+        s = GenScheduler(p, queue_size=8)
+        try:
+            for prompt in ([5, 9, 3, 17], [6] * 21):
+                got = list(s.submit(prompt, max_new_tokens=6))
+                assert got == _ref_greedy(predictor, prompt, 6)
+        finally:
+            s.close()
+
+    def test_page_allocator_lifecycle(self, bundle_dir):
+        p = GenPredictor(bundle_dir)
+        total = p.num_pages
+        n = p.pages_needed(20, 5)          # ceil(25 / 16) = 2 pages
+        assert n == 2
+        p.alloc_slot_pages(0, n)
+        assert p.free_pages == total - n
+        with pytest.raises(ValueError):    # double-alloc is a bug
+            p.alloc_slot_pages(0, 1)
+        assert p.free_slot_pages(0) == n
+        assert p.free_pages == total
+        assert p.free_slot_pages(0) == 0   # idempotent (evict paths)
+
+    def test_page_pool_exhaustion_raises_then_recovers(self, tmp_path):
+        d = str(tmp_path / "b")
+        gen_lm.export_gen_model(d, gen_lm.GenConfig(), num_slots=4,
+                                num_pages=8)
+        p = GenPredictor(d)
+        p.alloc_slot_pages(0, 4)
+        p.alloc_slot_pages(1, 4)
+        with pytest.raises(RuntimeError):
+            p.alloc_slot_pages(2, 1)
+        p.free_slot_pages(0)
+        p.alloc_slot_pages(2, 4)           # freed pages are reusable
+
+    def test_evicted_pages_are_reused_clean(self, predictor, scheduler):
+        """admit -> decode -> evict -> re-admit cycles the SAME pages
+        through different requests; a stale read would break the
+        re-prefill reference on later iterations."""
+        total = predictor.num_pages
+        long, short = [9] * 40, [8, 8, 8]
+        want_long = _ref_greedy(predictor, long, 5)
+        want_short = _ref_greedy(predictor, short, 5)
+        for _ in range(3):
+            assert list(scheduler.submit(long, max_new_tokens=5)) \
+                == want_long
+            assert list(scheduler.submit(short, max_new_tokens=5)) \
+                == want_short
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                predictor.free_pages < total:
+            time.sleep(0.02)
+        assert predictor.free_pages == total, "pages leaked"
+
+    def test_mixed_page_buckets_no_fresh_compiles(self, predictor,
+                                                  scheduler):
+        """A warmed replica serving lengths that span EVERY declared
+        page bucket must never compile: each live page count maps onto
+        a warmed bucket signature."""
+        prompts = [[7] * 5, [9] * 20, [3] * 40, [11] * 50]
+        refs = [_ref_greedy(predictor, p, 4) for p in prompts]
+        misses = profiler.runtime_metrics.counter("jit_cache.misses")
+        for prompt, ref in zip(prompts, refs):
+            assert list(scheduler.submit(prompt, max_new_tokens=4)) \
+                == ref
+        assert profiler.runtime_metrics.counter("jit_cache.misses") \
+            == misses, "paged decode compiled outside warmup"
+
+    def test_decode_bytes_scale_with_page_bucket(self, predictor,
+                                                 dense):
+        """The deterministic tier-1 form of the bench_paged.py bytes
+        acceptance: XLA cost-analysis bytes of the warmed decode
+        executables grow with the fed page bucket, and the smallest
+        bucket (25% of the pool here) reads <= 0.5x the dense decode
+        step."""
+        import re as _re
+        from paddle_tpu.obs import perf
+        paged_by_bucket, dense_bytes = {}, None
+        for r in perf.records():
+            m = _re.search(r"gen_page_table:4x(\d+)", r["label"])
+            if m and r["bytes_accessed"]:
+                paged_by_bucket[int(m.group(1))] = r["bytes_accessed"]
+            elif "gen_attn_mask" in r["label"] and r["bytes_accessed"]:
+                dense_bytes = r["bytes_accessed"]
+        if not paged_by_bucket or dense_bytes is None:
+            pytest.skip("backend reported no cost analysis")
+        assert set(predictor.page_buckets) <= set(paged_by_bucket)
+        full = paged_by_bucket[max(paged_by_bucket)]
+        small = paged_by_bucket[min(paged_by_bucket)]
+        assert small < full, "decode bytes do not scale with pages"
+        assert small <= 0.5 * dense_bytes, (small, dense_bytes)

@@ -111,6 +111,8 @@ class TestGenBundleEquivalence:
         p = GenPredictor(bundle_dir)
         logits, kv = p.prefill(prompt)
         toks = [int(np.argmax(logits))]
+        if p.paged:   # the default export: pages precede the write
+            p.alloc_slot_pages(0, p.pages_needed(len(prompt), n))
         p.write_slot(0, kv, len(prompt))
         pos = len(prompt)
         last = toks[0]
@@ -118,13 +120,18 @@ class TestGenBundleEquivalence:
         for _ in range(n - 1):
             tokens = np.zeros(S, np.int32)
             positions = np.zeros(S, np.int32)
-            onehot = np.zeros((S, L), np.float32)
-            mask = np.zeros((S, L), np.float32)
             tokens[0] = last
             positions[0] = pos
-            onehot[0, pos] = 1.0
-            mask[0, :pos + 1] = 1.0
-            step = p.decode_step(tokens, positions, onehot, mask)
+            if p.paged:
+                lens = np.zeros(S, np.int32)
+                lens[0] = pos + 1
+                step = p.decode_step(tokens, positions, lens=lens)
+            else:
+                onehot = np.zeros((S, L), np.float32)
+                mask = np.zeros((S, L), np.float32)
+                onehot[0, pos] = 1.0
+                mask[0, :pos + 1] = 1.0
+                step = p.decode_step(tokens, positions, onehot, mask)
             last = int(np.argmax(step[0]))
             toks.append(last)
             pos += 1

@@ -50,7 +50,7 @@ def test_selfcheck_sections_are_complete():
     report = sc.run_selfcheck()
     names = {s["name"] for s in report["sections"]}
     assert {"zoo-lint", "zoo-distribute", "zoo-pipeline", "gen-bundle",
-            "diagnostic-registry", "metric-registry",
+            "paged-kv", "diagnostic-registry", "metric-registry",
             "failpoint-registry", "slo-spec",
             "bench-trajectory", "perf"} <= names
 
