@@ -522,6 +522,77 @@ def _c_lookup_table_grad(op, info):
     return fwd[1] // 4, 2 * fwd[1]
 
 
+@rule("merge_selected_rows")
+def _c_merge_selected_rows(op, info):
+    x = info(op.input("X")[0]) if op.input("X") else _UNKNOWN
+    n = numel(x.shape)
+    if n is None:
+        return None
+    # sort rows + segment-sum the values: one add per element, values
+    # read once and written once (the static-shape merge keeps the full
+    # row set, so the logical [height, dim] numel is the honest bound)
+    item = _DTYPE_BYTES.get(str(x.dtype), 4)
+    return n, 2 * n * item
+
+
+@rule("get_tensor_from_selected_rows")
+def _c_get_tensor_from_selected_rows(op, info):
+    x = info(op.input("X")[0]) if op.input("X") else _UNKNOWN
+    n = numel(x.shape)
+    if n is None:
+        return None
+    # scatter-add into a zeroed [height, dim] tensor
+    item = _DTYPE_BYTES.get(str(x.dtype), 4)
+    return n, 2 * n * item
+
+
+@rule("split_ids")
+def _c_split_ids(op, info):
+    ids = info(op.input("Ids")[0]) if op.input("Ids") else _UNKNOWN
+    n = numel(ids.shape)
+    if n is None:
+        return None
+    shards = max(len(op.output("Out")), 1)
+    # one mod-compare per (id, shard) pair; padded outputs move n ids
+    # per shard
+    item = _DTYPE_BYTES.get(str(ids.dtype), 8)
+    return n * shards, (1 + shards) * n * item
+
+
+@rule("split_selected_rows")
+def _c_split_selected_rows(op, info):
+    x = info(op.input("X")[0]) if op.input("X") else _UNKNOWN
+    n = numel(x.shape)
+    if n is None:
+        return None
+    shards = max(len(op.output("Out")), 1)
+    item = _DTYPE_BYTES.get(str(x.dtype), 4)
+    return n * shards, (1 + shards) * n * item
+
+
+@rule("nce")
+def _c_nce(op, info):
+    x = info(op.input("Input")[0]) if op.input("Input") else _UNKNOWN
+    label = info(op.input("Label")[0]) if op.input("Label") else _UNKNOWN
+    if x.shape is None or len(x.shape) != 2:
+        return None
+    rows, d = x.shape
+    rows = rows if rows >= 0 else 1
+    if d < 0:
+        return None
+    num_true = (label.shape[1] if label.shape is not None and
+                len(label.shape) == 2 else 1)
+    s = num_true + int(op.attr("num_neg_samples", 10))
+    # per (row, sample): a D-dot + ~10-FLOP sigmoid/log chain
+    return rows * s * (2 * d + 10), io_bytes(op, info)
+
+
+@rule("nce_grad")
+def _c_nce_grad(op, info):
+    fwd = _c_nce(op, info)
+    return None if fwd is None else (2 * fwd[0], io_bytes(op, info))
+
+
 @rule("fill_constant", "fill", "fill_constant_batch_size_like",
       "assign_value", "uniform_random", "gaussian_random",
       "shape", "max_sequence_len", "lod_rank_table")

@@ -783,6 +783,13 @@ def _s_lookup_table(op, senv):
     senv.set_output(op, "Out", out)
 
 
+@sharding_rule("merge_selected_rows", "get_tensor_from_selected_rows")
+def _s_selected_rows_unary(op, senv):
+    # row-set transforms: the logical [height, dim] layout (and thus
+    # the placement) carries through unchanged
+    senv.set_output(op, "Out", senv.input_spec(op, "X"))
+
+
 @sharding_rule("sgd", "momentum", "adam", "adamax", "adagrad",
                "rmsprop", "decayed_adagrad", "adadelta", "ftrl")
 def _s_optimizer(op, senv):

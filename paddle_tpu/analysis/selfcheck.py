@@ -238,6 +238,59 @@ def _check_paged_kv():
                     "cost", failures)
 
 
+def _check_embedding():
+    """Sharded-embedding gate: a fresh wide_and_deep build's row-
+    sharding plan (tables + sparse optimizer moments) verifies clean
+    under PTA016/PTA017, the cost model prices the table gather's
+    bytes, and the HBM census attributes exactly the tables' bytes to
+    the ``embedding`` collection."""
+    from paddle_tpu.analysis import cost
+    from paddle_tpu.embedding import plan_sharded_tables
+    from paddle_tpu.models import build_train_program, compile_zoo_step
+
+    failures = []
+    main, _startup, _feeds, _fetches = build_train_program(
+        "wide_and_deep")
+    plan = plan_sharded_tables(main, mesh_axes={"model": 2},
+                               raise_on_error=False)
+    if not plan.tables:
+        failures.append("no is_distributed lookup tables found in "
+                        "wide_and_deep")
+    if not plan.states:
+        failures.append("no sparse optimizer accumulators joined the "
+                        "sharding plan (the moments must live with "
+                        "their rows)")
+    for d in plan.diagnostics:
+        failures.append(f"[plan] {d.severity}[{d.code}]: {d.message}")
+
+    report = cost.estimate(main)
+    gather_bytes = sum(row["bytes"] for row in report.per_op
+                       if row["op_type"] == "lookup_table")
+    if "lookup_table" in report.uncovered or gather_bytes <= 0:
+        failures.append("cost model does not price the table gather's "
+                        f"bytes (got {gather_bytes})")
+    for t in ("lookup_table_grad", "merge_selected_rows",
+              "get_tensor_from_selected_rows"):
+        if t not in cost.covered_op_types():
+            failures.append(f"sparse op {t!r} has no cost rule")
+
+    scope = compile_zoo_step("wide_and_deep", batch=4)
+    from paddle_tpu.obs.perf import hbm_census
+    census = hbm_census(scope)
+    expected = 0
+    block = main.global_block()
+    for name in plan.tables:
+        v = block.var(name)
+        expected += 4 * int(v.shape[0]) * int(v.shape[1])
+    if census.get("embedding") != expected:
+        failures.append(
+            f"census attributes {census.get('embedding')} embedding "
+            f"bytes; the plan's tables hold {expected}")
+    return _section("embedding",
+                    "sharded-table plan verification + gather cost + "
+                    "census attribution", failures)
+
+
 # ---------------------------------------------------------------------------
 # registry scanners (the doc/code lockstep gates)
 # ---------------------------------------------------------------------------
@@ -554,6 +607,7 @@ def run_selfcheck():
         _check_zoo_pipeline(),
         _check_gen_bundle(),
         _check_paged_kv(),
+        _check_embedding(),
         _check_diagnostic_registry(),
         _check_metric_registry(),
         _check_failpoint_registry(),

@@ -450,6 +450,78 @@ def _r_lookup_table(op, tc):
     tc.set_output(op, "Out", shape=shape, dtype=w.dtype)
 
 
+# -- sparse / CTR family (ops/sparse_ops.py) --------------------------------
+#
+# SelectedRows values flow through ordinary variables; their static
+# type is the LOGICAL dense shape ([height, dim]) — the same convention
+# ``lookup_table_grad``'s mirror rule applies to its SelectedRows
+# cotangent (W@GRAD gets W's [vocab, dim] shape regardless of how many
+# rows the batch touched), so the optimizer Param/Grad agreement check
+# sees through the sparse path unchanged.
+
+@rule("merge_selected_rows", "get_tensor_from_selected_rows")
+def _r_selected_rows_unary(op, tc):
+    x = tc.input_info(op, "X")
+    tc.set_output(op, "Out", shape=x.shape, dtype=x.dtype)
+
+
+@rule("split_ids")
+def _r_split_ids(op, tc):
+    ids = tc.input_info(op, "Ids")
+    if ids.dtype is not None and ids.dtype not in ("int32", "int64"):
+        tc.report("PTA005",
+                  f"split_ids Ids `{op.input('Ids')[0]}` must be "
+                  f"integer, got {ids.dtype}",
+                  op=op, var=op.input("Ids")[0])
+    n = None
+    if ids.shape is not None:
+        n = 1
+        for d in ids.shape:
+            if d is None or d < 0:
+                n = -1
+                break
+            n *= int(d)
+    for name in op.output("Out"):
+        tc.set(name, shape=None if n is None else (n, 1),
+               dtype=ids.dtype)
+
+
+@rule("split_selected_rows")
+def _r_split_selected_rows(op, tc):
+    x = tc.input_info(op, "X")
+    sections = op.attr("height_sections", []) or []
+    names = op.output("Out")
+    for i, name in enumerate(names):
+        shape = None
+        if x.shape is not None and len(x.shape) >= 2 and \
+                i < len(sections):
+            shape = (int(sections[i]),) + tuple(x.shape[1:])
+        tc.set(name, shape=shape, dtype=x.dtype)
+
+
+@rule("nce")
+def _r_nce(op, tc):
+    x = tc.input_info(op, "Input")
+    label = tc.input_info(op, "Label")
+    if label.dtype is not None and label.dtype not in ("int32", "int64"):
+        tc.report("PTA005",
+                  f"nce Label `{op.input('Label')[0]}` must be "
+                  f"integer, got {label.dtype}",
+                  op=op, var=op.input("Label")[0])
+    n = x.shape[0] if x.shape is not None else None
+    num_true = (label.shape[1] if label.shape is not None and
+                len(label.shape) == 2 else 1)
+    num_sampled = num_true + int(op.attr("num_neg_samples", 10))
+    tc.set_output(op, "Cost", shape=None if n is None else (n, 1),
+                  dtype=x.dtype)
+    for slot, dt in (("SampleLogits", x.dtype),
+                     ("SampleLabels", "int64")):
+        if op.output(slot):
+            tc.set(op.output(slot)[0],
+                   shape=None if n is None else (n, num_sampled),
+                   dtype=dt)
+
+
 @rule("fill_constant", "fill")
 def _r_fill_constant(op, tc):
     dtype = op.attr("dtype", "float32")
@@ -651,7 +723,7 @@ _GRAD_MIRROR_OPS = tuple(
         "elementwise_min", "elementwise_pow", "sum", "mean", "concat",
         "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
         "reduce_prod", "cross_entropy", "softmax_with_cross_entropy",
-        "lookup_table", "reshape", "reshape2", "transpose",
+        "lookup_table", "nce", "reshape", "reshape2", "transpose",
         "transpose2", "conv2d", "pool2d", "batch_norm", "layer_norm",
         "sequence_pool", "lstm", "write_to_array", "read_from_array",
         "array_to_lod_tensor", "lod_tensor_to_array",

@@ -26,12 +26,12 @@ from paddle_tpu.datapipe.core import Stage, PipelineStateError, stats
 from paddle_tpu.datapipe.sources import (Source, InMemorySource, FileSource,
                                          RecordIOSource)
 from paddle_tpu.datapipe.stages import (Shuffle, ParallelMap, Batch,
-                                        default_collate)
+                                        ShardIds, default_collate)
 from paddle_tpu.datapipe.prefetch import DevicePrefetch
 
 __all__ = [
     "Stage", "PipelineStateError", "stats",
     "Source", "InMemorySource", "FileSource", "RecordIOSource",
-    "Shuffle", "ParallelMap", "Batch", "default_collate",
+    "Shuffle", "ParallelMap", "Batch", "ShardIds", "default_collate",
     "DevicePrefetch",
 ]

@@ -171,6 +171,13 @@ class Stage:
                      collate=collate, pad_to_bucket=pad_to_bucket,
                      bucket_edges=bucket_edges, name=name)
 
+    def shard_ids(self, field, vocab_size, num_shards, shard_index=None,
+                  owner_field=None, name=None):
+        from paddle_tpu.datapipe.stages import ShardIds
+        return ShardIds(self, field, vocab_size, num_shards,
+                        shard_index=shard_index, owner_field=owner_field,
+                        name=name)
+
     def prefetch(self, depth=2, device=None, name=None):
         from paddle_tpu.datapipe.prefetch import DevicePrefetch
         return DevicePrefetch(self, depth=depth, device=device, name=name)

@@ -14,7 +14,8 @@ import numpy as np
 
 from paddle_tpu.datapipe.core import Stage, _Raised
 
-__all__ = ["Shuffle", "ParallelMap", "Batch", "default_collate"]
+__all__ = ["Shuffle", "ParallelMap", "Batch", "ShardIds",
+           "default_collate"]
 
 
 class Shuffle(Stage):
@@ -296,3 +297,74 @@ class Batch(Stage):
 
     def _reset_local(self):
         self._partial = []
+
+
+class ShardIds(Stage):
+    """Route embedding ids to their owning table shard.
+
+    The sharded-table contract (``paddle_tpu.embedding.tables``): a
+    table ``P(axis, None)``-sharded over ``num_shards`` devices holds
+    contiguous vocab *blocks*, so shard ``k`` owns ids
+    ``[k*V/N, (k+1)*V/N)``.  This stage stamps each sample with the
+    owner of every id in ``field`` (an ``int32`` array of the same
+    shape, stored under ``owner_field``, default ``<field>_owner``) —
+    the datapipe-side half of the reference's pserver prefetch routing,
+    computed where it is cheap (host, per-sample) instead of in the
+    step.  With ``shard_index`` given, the stage also tracks the
+    fraction of ids NOT owned locally
+    (``datapipe.<stage>.remote_frac`` gauge) — the cross-shard gather
+    traffic an operator watches when re-bucketing ids.
+
+    Stateless (a pure per-sample map), so resume is exact for free.
+    Dict samples get a new key; tuple/list samples get the owner array
+    appended.
+    """
+
+    kind = "shard_ids"
+
+    def __init__(self, upstream, field, vocab_size, num_shards,
+                 shard_index=None, owner_field=None, name=None):
+        super().__init__(upstream, name or "shard_ids")
+        from paddle_tpu.embedding import rows_per_shard
+        self.field = field
+        self.vocab_size = int(vocab_size)
+        self.num_shards = int(num_shards)
+        # validates divisibility eagerly — the same constraint PTA016
+        # enforces on the table's PartitionSpec
+        self._rows_per_shard = rows_per_shard(self.vocab_size,
+                                              self.num_shards)
+        self.shard_index = shard_index
+        self.owner_field = owner_field or f"{field}_owner"
+
+    def _route(self, sample):
+        from paddle_tpu.profiler import runtime_metrics
+        ids = np.asarray(sample[self.field])
+        if (ids < 0).any() or (ids >= self.vocab_size).any():
+            raise ValueError(
+                f"{self.name}: ids in {self.field!r} fall outside "
+                f"[0, {self.vocab_size}) — a sharded gather would "
+                f"silently drop them")
+        owner = (ids // self._rows_per_shard).astype(np.int32)
+        if self.shard_index is not None and owner.size:
+            remote = float(np.mean(owner != self.shard_index))
+            runtime_metrics.set_gauge(
+                self._metrics + ".remote_frac", remote)
+        if isinstance(sample, dict):
+            out = dict(sample)
+            out[self.owner_field] = owner
+        else:
+            out = type(sample)(list(sample) + [owner])
+        self._count()
+        return out
+
+    def _iterate(self):
+        up = iter(self._upstream)
+        try:
+            while True:
+                try:
+                    sample = self._pull(up)
+                except StopIteration:
+                    break
+                yield self._route(sample)
+        finally:
+            up.close()

@@ -1,0 +1,24 @@
+"""Mesh-sharded embedding tables — the CTR/recommendation workload.
+
+PaddlePaddle's defining production scenario is recommendation models
+whose embedding tables exceed one host's memory; the reference serves
+it with SelectedRows grads + pserver-distributed tables (PAPER.md
+§runtime-objects, §distributed).  This package is the TPU-native
+replacement: row-sharded tables proven by the PTA016/PTA017 pass
+(``sharded_table``), one shared row-ownership geometry for the
+datapipe router / collectives / checkpoint reshard (``tables``), and
+HBM census attribution of table bytes (``obs/perf.py``'s
+``embedding`` collection).
+"""
+
+from paddle_tpu.embedding.tables import (
+    register_table, registered_tables, is_table, table_meta,
+    rows_per_shard, owner_of, local_row)
+from paddle_tpu.embedding.sharded_table import (
+    ShardedTablePlan, plan_sharded_tables, sharded_gather,
+    sharded_scatter_add)
+
+__all__ = ["register_table", "registered_tables", "is_table",
+           "table_meta", "rows_per_shard", "owner_of", "local_row",
+           "ShardedTablePlan", "plan_sharded_tables", "sharded_gather",
+           "sharded_scatter_add"]

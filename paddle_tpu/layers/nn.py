@@ -73,6 +73,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     helper = LayerHelper("embedding", param_attr=param_attr)
     w = helper.create_parameter(helper.param_attr, shape=list(size),
                                 dtype=dtype, is_bias=False)
+    from paddle_tpu.embedding import register_table
+    register_table(w.name, vocab=size[0], dim=size[1])
     tmp = helper.create_tmp_variable(dtype)
     padding_idx = -1 if padding_idx is None else \
         (padding_idx if padding_idx >= 0 else size[0] + padding_idx)

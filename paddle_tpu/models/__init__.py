@@ -5,18 +5,18 @@ TPU-native layers API."""
 
 from paddle_tpu.models import (resnet, transformer, vgg, mnist,
                                seq2seq, stacked_lstm, gen_lm,
-                               gen_lm_long)
+                               gen_lm_long, wide_and_deep)
 
 __all__ = ["resnet", "transformer", "vgg", "mnist",
            "seq2seq", "stacked_lstm", "gen_lm", "gen_lm_long",
-           "ZOO_MODELS",
+           "wide_and_deep", "ZOO_MODELS",
            "build_train_program", "synth_feed", "compile_zoo_step"]
 
 #: zoo model names accepted by :func:`build_train_program` (and by
 #: ``paddle_tpu lint --zoo``; the lint gate in
 #: tests/test_analysis_zoo.py iterates exactly this list)
 ZOO_MODELS = ("mnist", "resnet", "vgg", "transformer", "seq2seq",
-              "stacked_lstm", "gen_lm", "gen_lm_long")
+              "stacked_lstm", "gen_lm", "gen_lm_long", "wide_and_deep")
 
 
 def build_train_program(name, backward=True):
@@ -66,6 +66,11 @@ def build_train_program(name, backward=True):
             hp.d_head, hp.max_len = 8, 16
             cost, feeds = gen_lm.gen_lm_train_program(2, 8, hp)
             fetches = [cost.name]
+        elif name == "wide_and_deep":
+            cost, acc, feeds = wide_and_deep.wide_and_deep_train_program(
+                4, vocab_size=16, num_slots=2, emb_dim=4, dense_dim=4,
+                hidden=8)
+            fetches = [cost.name, acc.name]
         elif name == "gen_lm_long":
             # flagship long-context geometry: max_len stays at the
             # GenLongConfig 256 (the gated axis); the rest shrinks to

@@ -1,0 +1,71 @@
+"""Wide & Deep CTR model (Cheng et al. 2016) — the sharded-embedding
+flagship.
+
+PaddlePaddle's defining production workload: sparse id features hit
+embedding tables too big for one host, so both tables are built with
+``is_distributed=True`` — ``embedding.plan_sharded_tables`` (or the
+``DistributeTranspiler`` sparse branch) then shards their vocab dim
+over the mesh, and ``is_sparse=True`` makes the backward emit
+SelectedRows so the optimizer touches only the rows a batch
+referenced.
+
+Geometry notes for the zoo gates: the default ``vocab_size`` stays
+divisible by the selfcheck distribute drill's 2 shards AND the bench's
+dp4 mesh, and all leading param dims are even so ``shard_params=True``
+transpiles cleanly.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.layers as layers
+
+#: one shared default geometry for the zoo entry, selfcheck's
+#: distribute drill, and bench_embedding's smoke mode
+DEFAULT_VOCAB = 64
+
+
+def wide_and_deep_train_program(batch_size, vocab_size=DEFAULT_VOCAB,
+                                num_slots=4, emb_dim=8, dense_dim=8,
+                                hidden=16):
+    """CTR click prediction: ``num_slots`` sparse id features + a dense
+    feature vector -> P(click).  Returns ``(avg_cost, acc,
+    feed_names)`` like every zoo builder.
+
+    * **deep**: per-slot ``emb_dim`` embeddings (the sharded table),
+      concatenated with the dense features, through two relu FCs;
+    * **wide**: a second ``[vocab, 1]`` table — the linear
+      cross-feature term — sum-pooled over slots;
+    * head: wide + deep concatenated into a 2-way softmax vs the
+      click label.
+    """
+    slot_ids = layers.data(name="slot_ids",
+                           shape=[batch_size, num_slots, 1],
+                           dtype="int64", append_batch_size=False)
+    dense = layers.data(name="dense", shape=[batch_size, dense_dim],
+                        dtype="float32", append_batch_size=False)
+    label = layers.data(name="label", shape=[batch_size, 1],
+                        dtype="int64", append_batch_size=False)
+
+    # deep side: the big table — sharded over the mesh, sparse grads
+    deep_emb = layers.embedding(
+        slot_ids, size=[vocab_size, emb_dim], is_sparse=True,
+        is_distributed=True, param_attr="wide_deep_emb")
+    deep_in = layers.reshape(deep_emb,
+                             [batch_size, num_slots * emb_dim])
+    deep = layers.concat([deep_in, dense], axis=1)
+    deep = layers.fc(deep, hidden, act="relu")
+    deep = layers.fc(deep, hidden, act="relu")
+
+    # wide side: per-id linear weights, same sharded-table treatment
+    wide_emb = layers.embedding(
+        slot_ids, size=[vocab_size, 1], is_sparse=True,
+        is_distributed=True, param_attr="wide_lr_w")
+    wide = layers.reshape(wide_emb, [batch_size, num_slots])
+    wide = layers.reduce_sum(wide, dim=1, keep_dim=True)
+
+    joint = layers.concat([wide, deep], axis=1)
+    predict = layers.fc(joint, 2, act="softmax")
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(x=cost)
+    acc = layers.accuracy(input=predict, label=label)
+    return avg_cost, acc, ["slot_ids", "dense", "label"]

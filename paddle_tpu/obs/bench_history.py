@@ -65,6 +65,16 @@ BENCH_METRICS = {
     "elastic": {"resume_seconds": ("lower", 1.00),
                 "loss_delta_rel": ("max_abs", 1e-3),
                 "reshard_failures": ("max_abs", 0.0)},
+    # ISSUE-18 sharded-embedding gate: per-device table bytes must stay
+    # ~1/N of replicated (the memory-scaling claim), the dp4->dp2
+    # shrink drill must restore the sharded table + sparse moments
+    # within the acceptance loss tolerance, and the sparse update must
+    # keep scaling with touched rows, not vocab (a 4x vocab may not
+    # move the step time past noise)
+    "embedding": {"table_bytes_ratio": ("lower", 0.10),
+                  "loss_delta_rel": ("max_abs", 1e-6),
+                  "reshard_failures": ("max_abs", 0.0),
+                  "step_time_vocab_ratio": ("lower", 0.75)},
     # ISSUE-15 cold-start gate: the second-best per-model trace+compile
     # reduction IS the "at least two zoo models improve >=15%"
     # acceptance floor, and the steady step must stay ~1 (the passes
@@ -273,6 +283,12 @@ def summary_metrics(bench, summary):
         return {"resume_seconds": summary["resume"]["restore_seconds"],
                 "loss_delta_rel": summary["loss_delta_rel"],
                 "reshard_failures": summary["reshard_failures"]}
+    if bench == "embedding":
+        return {"table_bytes_ratio": summary["table_bytes_ratio"],
+                "loss_delta_rel": summary["loss_delta_rel"],
+                "reshard_failures": summary["reshard_failures"],
+                "step_time_vocab_ratio":
+                    summary["sparse_scaling"]["step_time_vocab_ratio"]}
     if bench == "autoscale":
         ctrl = summary["modes"]["controller"]
         return {"p99_controller_ms": ctrl["p99_ms"],
@@ -291,7 +307,8 @@ def summary_metrics(bench, summary):
         return out
     raise ValueError(f"no trajectory extraction for bench {bench!r} "
                      f"(known: serving, datapipe, fleet, decode, paged, "
-                     f"elastic, compile, train_transformer, autoscale)")
+                     f"elastic, embedding, compile, train_transformer, "
+                     f"autoscale)")
 
 
 def add_record_args(parser):

@@ -432,8 +432,8 @@ OPTIMIZER_STATE_PREFIXES = (
 #: census collections, in attribution priority order; provider-backed
 #: collections claim their buffers before the scope walk (``kv_pages``:
 #: a paged gen bundle's page pool + its host-side page tables)
-HBM_COLLECTIONS = ("kv_cache", "kv_pages", "prefetch", "optimizer",
-                   "params")
+HBM_COLLECTIONS = ("kv_cache", "kv_pages", "prefetch", "embedding",
+                   "optimizer", "params")
 
 _hbm_lock = threading.Lock()
 _hbm_providers = {}     # collection -> {token: callable}
@@ -547,19 +547,29 @@ def hbm_census(scope=None, metrics=None):
     claim("kv_cache", _provider_arrays("kv_cache"))
     claim("kv_pages", _provider_arrays("kv_pages"))
     claim("prefetch", _provider_arrays("prefetch"))
+    claim("embedding", _provider_arrays("embedding"))
 
     if scope is None:
         from paddle_tpu.scope import global_scope
         scope = global_scope()
-    opt_arrays, param_arrays = [], []
+    # embedding tables are params by structure but their own memory
+    # story (the axis the CTR workload scales along) — attribute them
+    # by the table registry, ahead of the params split
+    from paddle_tpu.embedding import is_table as _is_table
+    emb_arrays, opt_arrays, param_arrays = [], [], []
     s = scope
     while s is not None:
         for name, v in s.items():
             if not hasattr(v, "nbytes") or not hasattr(v, "dtype"):
                 continue  # readers, lod metadata, host objects
-            (opt_arrays if _is_optimizer_state(name)
-             else param_arrays).append(v)
+            if _is_table(name):
+                emb_arrays.append(v)
+            elif _is_optimizer_state(name):
+                opt_arrays.append(v)
+            else:
+                param_arrays.append(v)
         s = s.parent
+    claim("embedding", emb_arrays)
     claim("optimizer", opt_arrays)
     claim("params", param_arrays)
 
@@ -582,6 +592,7 @@ def hbm_census(scope=None, metrics=None):
     m.set_gauge("hbm.kv_cache_bytes", census["kv_cache"])
     m.set_gauge("hbm.kv_pages_bytes", census["kv_pages"])
     m.set_gauge("hbm.prefetch_bytes", census["prefetch"])
+    m.set_gauge("hbm.embedding_bytes", census["embedding"])
     m.set_gauge("hbm.other_bytes", census["other"])
     m.set_gauge("hbm.total_bytes", census["total"])
     m.set_gauge("hbm.high_watermark_bytes", census["high_watermark"])

@@ -168,6 +168,30 @@ def split_ids_lower(ctx):
         ctx.outputs[name] = jnp.where(mask, ids, -1).reshape(-1, 1)
 
 
+@register_op("merge_selected_rows", no_gradient=True,
+             selected_rows_inputs=("X",))
+def merge_selected_rows_lower(ctx):
+    """Combine duplicate row ids by summation (reference
+    merge_selected_rows_op.cc) — the canonical pre-step before a sparse
+    optimizer applies a SelectedRows grad, so each touched row is
+    updated once.  Dense inputs pass through unchanged (the reference
+    kernel asserts SelectedRows; here a dense tensor is already
+    'merged')."""
+    x = ctx.input("X")
+    ctx.set_output("Out", x.merge_duplicates() if is_selected_rows(x)
+                   else x)
+
+
+@register_op("get_tensor_from_selected_rows", no_gradient=True,
+             selected_rows_inputs=("X",))
+def get_tensor_from_selected_rows_lower(ctx):
+    """Densify a SelectedRows into its [height, dim] tensor (reference
+    get_tensor_from_selected_rows_op.cc) — the scatter-add that turns
+    routed sparse rows back into a table-shaped tensor."""
+    x = ctx.input("X")
+    ctx.set_output("Out", x.to_dense() if is_selected_rows(x) else x)
+
+
 @register_op("split_selected_rows", no_gradient=True,
              selected_rows_inputs=("X",))
 def split_selected_rows_lower(ctx):

@@ -107,6 +107,12 @@ MUST_BE_COVERED = {
     "lod_tensor_to_array", "reorder_lod_tensor_by_rank",
     "lod_rank_table", "write_to_array_grad", "array_to_lod_tensor_grad",
     "lod_tensor_to_array_grad", "reorder_lod_tensor_by_rank_grad",
+    # ISSUE-18: the sparse/CTR family behind the sharded-embedding
+    # workload — lookup_table_grad's SelectedRows cotangent plus the
+    # row-set transform ops must stay typed so the sparse optimizer
+    # path and its cost pricing never go blind
+    "merge_selected_rows", "get_tensor_from_selected_rows",
+    "split_ids", "split_selected_rows", "nce", "nce_grad",
 }
 
 

@@ -50,8 +50,8 @@ def test_selfcheck_sections_are_complete():
     report = sc.run_selfcheck()
     names = {s["name"] for s in report["sections"]}
     assert {"zoo-lint", "zoo-distribute", "zoo-pipeline", "gen-bundle",
-            "paged-kv", "diagnostic-registry", "metric-registry",
-            "failpoint-registry", "slo-spec",
+            "paged-kv", "embedding", "diagnostic-registry",
+            "metric-registry", "failpoint-registry", "slo-spec",
             "bench-trajectory", "perf"} <= names
 
 
