@@ -44,7 +44,6 @@ from paddle_tpu import regularizer
 from paddle_tpu import clip
 from paddle_tpu import metrics
 from paddle_tpu import evaluator
-from paddle_tpu import debuger
 from paddle_tpu import profiler
 from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu import io
@@ -64,3 +63,12 @@ from paddle_tpu import analysis
 __version__ = "0.1.0"
 
 Tensor = Variable  # convenience alias
+
+
+def __getattr__(name):
+    # deprecated modules import (and warn) only on first touch, so a
+    # plain `import paddle_tpu` stays warning-free
+    if name == "debuger":
+        import importlib
+        return importlib.import_module("paddle_tpu.debuger")
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

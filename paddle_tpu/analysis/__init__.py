@@ -25,7 +25,10 @@ can BEFORE tracing:
 * :mod:`~paddle_tpu.analysis.opt` — the verify-sandwiched optimization
   pass pipeline (``PADDLE_TPU_OPT=1``, ``paddle_tpu opt``): constant
   folding, CSE, DCE, elementwise fusion, the donation planner, and the
-  cost-model compile-amortization gate.
+  cost-model compile-amortization gate;
+* :mod:`~paddle_tpu.analysis.visualize` — GraphViz DOT rendering of a
+  Program (blocks as clusters, donation/creation-site annotations;
+  ``paddle_tpu lint --dot out.dot``) and pseudo-code pretty printing.
 
 Entry points: ``lint_program`` (everything; ``paddle_tpu lint``),
 ``verify_program`` (structural, raising — the ``PADDLE_TPU_VERIFY=1``
@@ -46,6 +49,7 @@ from paddle_tpu.analysis import typecheck
 from paddle_tpu.analysis import distributed
 from paddle_tpu.analysis import cost
 from paddle_tpu.analysis import opmeta
+from paddle_tpu.analysis import visualize
 from paddle_tpu.analysis.distributed import (check_distributed_spec,
                                              check_gen_bundle,
                                              check_stage_set,
@@ -58,7 +62,7 @@ __all__ = [
     "AnalysisResult", "analyze_program", "lint_program", "verify_program",
     "verify_transpiled", "check_pipeline_carriers", "DIAGNOSTIC_CODES",
     "Diagnostic", "ProgramVerificationError", "format_diagnostics",
-    "typecheck", "distributed", "cost", "opmeta",
+    "typecheck", "distributed", "cost", "opmeta", "visualize",
     "check_distributed_spec",
     "check_gen_bundle", "check_stage_set", "check_transpiled_pair",
     "lint_gen_bundle", "lint_pair", "lint_pipeline", "verify_gen_bundle",

@@ -585,6 +585,55 @@ def _check_opt():
                     "cost schema, equivalence spot-check", failures)
 
 
+def _check_ledger():
+    """Run-ledger gate: a fresh ledger round-trips rows through its
+    schema validators and atomic segment rotation, the resume cursor
+    rewinds exactly, the documented example drift spec validates (and a
+    broken one fails), and a malformed row is refused — the persistence
+    layer every divergence hunt reads must not drift silently."""
+    from paddle_tpu.obs import ledger
+
+    failures = []
+    failures.extend(f"EXAMPLE_DRIFT_SPEC: {p}"
+                    for p in ledger.validate_spec(
+                        ledger.EXAMPLE_DRIFT_SPEC))
+    if not ledger.validate_spec({"version": 1, "rules": []}):
+        failures.append("validate_spec accepted an empty rules list")
+    if not ledger.validate_row({"step": -1, "time_unix": 0.0}):
+        failures.append("validate_row accepted a negative step")
+    if not ledger.validate_row({"step": 0, "time_unix": 1.0,
+                                "bogus": 2}):
+        failures.append("validate_row accepted an unknown field")
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_selfcheck_ledger_")
+    try:
+        led = ledger.RunLedger(os.path.join(tmp, "run"), rotate_rows=4,
+                               flush_every=1, install=False)
+        for _ in range(10):
+            led.note_step(fetch_names=("loss",), fetches=([0.5],))
+        cursor = led.state_dict()
+        for _ in range(3):
+            led.note_step(fetch_names=("loss",), fetches=([0.5],))
+        led.load_state_dict(cursor)
+        led.close()
+        rows = ledger.read_rows(os.path.join(tmp, "run"))
+        if len(rows) != 10:
+            failures.append(f"rotation/rewind round-trip kept "
+                            f"{len(rows)} rows, want 10")
+        if [r["step"] for r in rows] != list(range(10)):
+            failures.append("rewound ledger lost step monotonicity: "
+                            f"{[r['step'] for r in rows]}")
+        segs = [n for n in os.listdir(os.path.join(tmp, "run"))
+                if n.startswith("seg-")]
+        if len(segs) < 2:
+            failures.append(f"rotate_rows=4 over 10 rows produced "
+                            f"{len(segs)} segment(s), want >= 2")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return _section("ledger",
+                    "row/spec schema validators, rotation + resume-"
+                    "cursor round-trip", failures)
+
+
 def _check_bench_trajectory():
     """``bench check --dry`` against the repo's BENCH_TRAJECTORY.json:
     a drifted or malformed trajectory schema fails the static gate (the
@@ -614,6 +663,7 @@ def run_selfcheck():
         _check_slo_spec(),
         _check_controller_policy(),
         _check_opt(),
+        _check_ledger(),
         _check_bench_trajectory(),
         _check_ckpt_manifest(),
         _check_perf(),

@@ -46,11 +46,21 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              baseline per-metric
                                              tolerance bands; exit 1
                                              on regression
-  replay  BUNDLE.pkl                         re-execute a sentinel-
+  replay  BUNDLE.pkl [--localize]            re-execute a sentinel-
                                              quarantined step on CPU and
                                              report whether the numerical
                                              fault reproduces (exit 0 =
-                                             reproduced, 1 = clean)
+                                             reproduced, 1 = clean);
+                                             --localize probes every op
+                                             and names the FIRST one
+                                             producing a non-finite
+                                             output, with its Python
+                                             creation site + stat trail
+  runs    tail|show DIR | compare A B        run-ledger readers: tail
+                                             the last step rows of a
+                                             ledger dir (-n N), digest
+                                             a whole run, or compare
+                                             two runs field by field
   lint    MODEL_DIR | --zoo NAME|all         static-analyze a program:
                                              def-before-use, shape/dtype
                                              inference, dead ops, donation
@@ -64,7 +74,9 @@ Usage: ``python -m paddle_tpu <command> ...``
                                              unit; --pair T P lints a
                                              transpiled trainer/pserver
                                              pair; --pipeline N verifies
-                                             an N-stage split
+                                             an N-stage split; --dot OUT
+                                             renders the program as a
+                                             GraphViz graph
   opt     MODEL_DIR | --zoo NAME|all         run the Program-IR
                                              optimization pipeline
                                              offline: per-pass
@@ -644,7 +656,10 @@ def _cmd_replay(args):
     """Re-execute a quarantined training step from its repro bundle
     (``fault.Sentinel`` quarantine output) under the CPU platform — the
     offline debugging loop for a numerical fault seen on the chip.
-    Exit code 0 when the non-finite/spike reproduces, 1 when the step
+    ``--localize`` re-executes op by op with per-op tensor-stat probes
+    and names the FIRST op whose output went non-finite, with its
+    Python creation site and the stat trail of the ops before it.
+    Exit code 0 when the fault reproduces/localizes, 1 when the step
     replays clean, 2 on a malformed bundle."""
     import json as _json
 
@@ -661,9 +676,13 @@ def _cmd_replay(args):
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass  # backend already initialized (in-process use): keep it
-        from paddle_tpu.fault.sentinel import replay_bundle
         try:
-            report = replay_bundle(args.bundle)
+            if args.localize:
+                from paddle_tpu.obs.numerics import localize_bundle
+                report = localize_bundle(args.bundle)
+            else:
+                from paddle_tpu.fault.sentinel import replay_bundle
+                report = replay_bundle(args.bundle)
         except (OSError, ValueError, KeyError) as e:
             print(f"replay: cannot load bundle {args.bundle!r}: {e}",
                   file=sys.stderr)
@@ -673,6 +692,8 @@ def _cmd_replay(args):
             os.environ.pop("JAX_PLATFORMS", None)
         else:
             os.environ["JAX_PLATFORMS"] = prev_platform
+    if args.localize:
+        return _report_localize(report, json_out=args.json)
     if args.json:
         print(_json.dumps(report, indent=2, sort_keys=True))
     elif report["reproduced"]:
@@ -684,6 +705,108 @@ def _cmd_replay(args):
         print(f"step {report['step']}: replayed CLEAN — the fault did "
               f"not reproduce on CPU (suspect hardware/nondeterminism)")
     return 0 if report["reproduced"] else 1
+
+
+def _report_localize(report, json_out=False):
+    """Print a ``numerics.localize_bundle`` report; exit 0 = localized,
+    1 = every op produced finite outputs."""
+    import json as _json
+
+    if json_out:
+        print(_json.dumps(report, indent=2, sort_keys=True))
+        return 0 if report["localized"] else 1
+    if not report["localized"]:
+        print(f"step {report['step']}: all {report['ops_probed']} op "
+              f"execution(s) produced finite outputs — nothing to "
+              f"localize (suspect hardware/nondeterminism)")
+        return 1
+    bad = report["first_bad_op"]
+    site = bad.get("creation_site")
+    where = f"{site[0]}:{site[1]}" if site else "(unknown site)"
+    tag = " [chaos-injected]" if report["injected"] else ""
+    print(f"step {report['step']}: first non-finite output at op "
+          f"#{bad['index']} `{bad['type']}` created at {where}{tag}")
+    for name, stats in (bad.get("outputs") or {}).items():
+        print(f"  out {name}: {stats}")
+    for name, stats in (bad.get("inputs") or {}).items():
+        print(f"  in  {name}: {stats}")
+    trail = bad.get("trail") or []
+    if trail:
+        print(f"  trail (last {len(trail)} op(s) before the fault):")
+        for row in trail:
+            outs = ", ".join(row.get("outputs", {}))
+            print(f"    #{row['index']} {row['type']} -> {outs}")
+    return 0
+
+
+def _fmt_cell(value):
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value:.6g}"
+    return str(value)
+
+
+def _cmd_runs(args):
+    """Read-side of the run ledger (``obs.ledger``): ``tail`` prints
+    the last N step rows of a ledger directory, ``show`` a whole-run
+    digest (row/segment counts, per-field first/last/min/max),
+    ``compare`` two runs side by side with last-value deltas.  Pure
+    file readers — no executor, no device, usable while the training
+    process is still appending.  Exit 2 on an unreadable ledger."""
+    import json as _json
+
+    from paddle_tpu.obs import ledger as _ledger
+
+    try:
+        if args.action == "tail":
+            rows = _ledger.tail_rows(args.dir, n=args.n)
+            if args.json:
+                print(_json.dumps(rows, indent=2, sort_keys=True))
+                return 0
+            fields = [f for f in _ledger.ROW_FIELDS
+                      if any(r.get(f) is not None for r in rows)]
+            header = ["step", "time_unix"] + fields
+            print("  ".join(header))
+            for r in rows:
+                print("  ".join(_fmt_cell(r.get(k)) for k in header))
+            return 0
+        if args.action == "show":
+            body = _ledger.summarize(args.dir)
+            if args.json:
+                print(_json.dumps(body, indent=2, sort_keys=True))
+                return 0
+            print(f"{body['dir']}: {body['rows']} row(s) in "
+                  f"{body['segments']} segment(s), steps "
+                  f"{body['first_step']}..{body['last_step']}")
+            for field, s in sorted(body["fields"].items()):
+                print(f"  {field}: first={_fmt_cell(s['first'])} "
+                      f"last={_fmt_cell(s['last'])} "
+                      f"min={_fmt_cell(s['min'])} "
+                      f"max={_fmt_cell(s['max'])} "
+                      f"({s['samples']} sample(s))")
+            return 0
+        # compare
+        if not args.dir_b:
+            print("runs compare: need two ledger directories",
+                  file=sys.stderr)
+            return 2
+        body = _ledger.compare(args.dir, args.dir_b)
+        if args.json:
+            print(_json.dumps(body, indent=2, sort_keys=True))
+            return 0
+        print(f"A: {body['a']['dir']} ({body['a']['rows']} row(s), "
+              f"last step {body['a']['last_step']})")
+        print(f"B: {body['b']['dir']} ({body['b']['rows']} row(s), "
+              f"last step {body['b']['last_step']})")
+        for field, d in sorted(body["deltas"].items()):
+            print(f"  {field}: A last={_fmt_cell(d['a_last'])}  "
+                  f"B last={_fmt_cell(d['b_last'])}  "
+                  f"delta={_fmt_cell(d['delta_last'])}")
+        return 0
+    except ValueError as e:
+        print(f"runs: {e}", file=sys.stderr)
+        return 2
 
 
 def _load_saved_program(target):
@@ -737,6 +860,10 @@ def _cmd_lint(args):
                   f"{args.target!r}: {e}", file=sys.stderr)
             return 2
     if results is not None:
+        if args.dot:
+            print("lint: --dot renders exactly one main program "
+                  "(not a --pair / gen-bundle family)", file=sys.stderr)
+            return 2
         return _report_lint(results, args)
 
     targets = []  # (label, program, feed_names, fetch_names)
@@ -777,6 +904,18 @@ def _cmd_lint(args):
         targets = [(lbl, p, fd,
                     ft if lbl.endswith("/startup") else fetch_override)
                    for lbl, p, fd, ft in targets]
+
+    if args.dot:
+        mains = [(lbl, p) for lbl, p, _, _ in targets
+                 if not lbl.endswith("/startup")]
+        if len(mains) != 1:
+            print(f"lint: --dot renders exactly one main program, got "
+                  f"{len(mains)} (use one MODEL_DIR or --zoo NAME, not "
+                  f"--zoo all)", file=sys.stderr)
+            return 2
+        from paddle_tpu.analysis.visualize import program_dot
+        program_dot(mains[0][1], path=args.dot)
+        print(f"wrote {args.dot} ({mains[0][0]})")
 
     results = []
     for label, program, feeds, fetches in targets:
@@ -1382,9 +1521,28 @@ def main(argv=None):
                                       "reproduced)")
     p.add_argument("bundle", help="pickled repro bundle from the "
                                   "sentinel's quarantine dir")
+    p.add_argument("--localize", action="store_true",
+                   help="re-execute op by op with per-op tensor-stat "
+                        "probes and name the FIRST op producing a "
+                        "non-finite output (creation site + stat "
+                        "trail); exit 0 = localized, 1 = clean")
     p.add_argument("--json", action="store_true",
                    help="machine-readable report instead of prose")
     p.set_defaults(fn=_cmd_replay)
+
+    p = sub.add_parser("runs",
+                       help="run-ledger readers (obs.ledger JSONL "
+                            "step series): tail the last rows, digest "
+                            "a whole run, or compare two runs")
+    p.add_argument("action", choices=["tail", "show", "compare"])
+    p.add_argument("dir", help="ledger directory (RunLedger dirname)")
+    p.add_argument("dir_b", nargs="?", default=None,
+                   help="second ledger directory (compare only)")
+    p.add_argument("-n", type=int, default=10,
+                   help="with tail: number of rows (default 10)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.set_defaults(fn=_cmd_runs)
 
     p = sub.add_parser("lint", help="static-analyze a program IR "
                                     "(PTA*** diagnostics; "
@@ -1417,6 +1575,11 @@ def main(argv=None):
                         "model's declared fetch targets)")
     p.add_argument("--strict", action="store_true",
                    help="exit 1 on warnings too, not just errors")
+    p.add_argument("--dot", default=None, metavar="OUT",
+                   help="also render the (single) main program as a "
+                        "GraphViz .dot graph here: blocks as clusters, "
+                        "gradients/donation annotated, op creation "
+                        "sites as tooltips")
     p.add_argument("--json", action="store_true",
                    help="machine-readable diagnostics")
     p.add_argument("--verbose", action="store_true",
@@ -1463,7 +1626,8 @@ def main(argv=None):
                             "multi-program), the paged-KV export gate, "
                             "the scanner-enforced "
                             "diagnostic/metric/failpoint registries, "
-                            "the SLO spec schema, and the bench-"
+                            "the SLO spec schema, the run-ledger "
+                            "schema round-trip, and the bench-"
                             "trajectory schema (bench check --dry)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable section report")

@@ -1,88 +1,24 @@
-"""Program debugging / visualization (reference
-``python/paddle/fluid/debuger.py`` + ``graphviz.py`` + ``net_drawer.py``).
+"""Deprecated alias for :mod:`paddle_tpu.analysis.visualize`.
 
-``draw_block_graphviz`` emits GraphViz .dot text (ops as boxes, vars as
-ellipses, grads highlighted) — render with any dot tool; no binary needed
-to produce the file.  ``pprint_program_codes`` renders the program as
-pseudo-code like the reference's protobuf pretty printer.
+The reference repo shipped its visualizers under this (typo'd) path;
+the real implementation now lives in ``paddle_tpu.analysis.visualize``
+alongside the other static-analysis passes.  Importing this module
+keeps working but warns once — update imports to::
+
+    from paddle_tpu.analysis.visualize import draw_block_graphviz
 """
 
 from __future__ import annotations
 
+import warnings
+
+from paddle_tpu.analysis.visualize import (  # noqa: F401
+    draw_block_graphviz, pprint_block_codes, pprint_program_codes,
+    program_dot)
+
 __all__ = ["draw_block_graphviz", "pprint_program_codes",
-           "pprint_block_codes"]
+           "pprint_block_codes", "program_dot"]
 
-from paddle_tpu.ops.registry import GRAD_SUFFIX
-
-
-def _var_label(block, name):
-    try:
-        v = block.var(name)
-        shape = "x".join(str(d) for d in (v.shape or ())) or "?"
-        return f"{name}\\n{v.dtype}[{shape}]"
-    except KeyError:
-        return name
-
-
-def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
-    """Write a .dot graph of one block (reference ``debuger.py``
-    draw_block_graphviz).  Returns the dot source text."""
-    highlights = set(highlights or ())
-    lines = ["digraph G {", "  rankdir=TB;"]
-    seen_vars = set()
-
-    def var_node(name):
-        nid = f"var_{name}".replace(".", "_").replace("@", "_AT_")
-        if name not in seen_vars:
-            seen_vars.add(name)
-            color = "orange" if name.endswith(GRAD_SUFFIX) else \
-                ("red" if name in highlights else "lightblue")
-            lines.append(
-                f'  "{nid}" [label="{_var_label(block, name)}", '
-                f'shape=ellipse, style=filled, fillcolor={color}];')
-        return nid
-
-    for i, op in enumerate(block.ops):
-        op_id = f"op_{i}_{op.type}"
-        lines.append(f'  "{op_id}" [label="{op.type}", shape=box, '
-                     f'style=filled, fillcolor=palegreen];')
-        for n in op.input_arg_names:
-            if n:
-                lines.append(f'  "{var_node(n)}" -> "{op_id}";')
-        for n in op.output_arg_names:
-            if n:
-                lines.append(f'  "{op_id}" -> "{var_node(n)}";')
-    lines.append("}")
-    dot = "\n".join(lines)
-    if path:
-        with open(path, "w") as f:
-            f.write(dot)
-    return dot
-
-
-def pprint_block_codes(block, show_backward=True):
-    """Pseudo-code rendering of one block (reference ``debuger.py``
-    pprint_block_codes)."""
-    out = []
-    for op in block.ops:
-        if not show_backward and op.type.endswith("_grad"):
-            continue
-        outs = ", ".join(n for ns in op.outputs.values() for n in ns if n)
-        ins = ", ".join(n for ns in op.inputs.values() for n in ns if n)
-        attrs = ", ".join(
-            f"{k}={v!r}" for k, v in sorted(op.attrs.items())
-            if not hasattr(v, "ops"))  # skip sub-blocks
-        call = f"{op.type}({ins}"
-        if attrs:
-            call += f", {attrs}"
-        call += ")"
-        out.append(f"{outs or '_'} = {call}" if outs else call)
-    return "\n".join(out)
-
-
-def pprint_program_codes(program, show_backward=True):
-    chunks = []
-    for blk in program.blocks:
-        chunks.append(f"# block {blk.idx}")
-        chunks.append(pprint_block_codes(blk, show_backward))
-    return "\n".join(chunks)
+warnings.warn(
+    "paddle_tpu.debuger is deprecated; use paddle_tpu.analysis.visualize",
+    DeprecationWarning, stacklevel=2)

@@ -253,7 +253,9 @@ def lower_block(block, env, rng_key, training, aux):
     """Trace all ops of ``block`` into ``env`` (used for the main block and,
     recursively, by control-flow op lowerings for sub-blocks)."""
     from paddle_tpu import profiler as _profiler
+    from paddle_tpu.obs import numerics as _numerics
     profiling = _profiler.op_profiling_enabled() and aux.get("interpret")
+    probing = _numerics.probing_enabled() and aux.get("interpret")
     release = aux.get("release", {}).get(block.idx)
     rng_plan = aux.get("rng_plan")
     for i, op in enumerate(block.ops):
@@ -286,6 +288,10 @@ def lower_block(block, env, rng_key, training, aux):
             with jax.named_scope(_profiler.op_scope_name(op)):
                 opdef.lower(ctx)
         env.update(ctx.outputs)
+        if probing:
+            # per-op numerics probes (obs/numerics.py): stats of every
+            # output right after the op ran, first-non-finite capture
+            _numerics.record_op(op, ctx.outputs, env)
         _share_lod(op, ctx, env, aux)
         if release is not None:
             # early release (memory_optimization_transpiler.release_memory):
@@ -518,7 +524,12 @@ class Executor:
                     fetch_names, fetches, new_state,
                     repro=lambda: self._repro_payload(
                         program, feed_arrays, ro_state, inout_state,
-                        fetch_names))
+                        fetch_names),
+                    # for the fused health norms: the pre-step state
+                    # (valid: guarded steps never donate) and which of
+                    # its names are Parameters
+                    prev_state=inout_state,
+                    param_names=getattr(compiled, "param_names", ()))
             if _check_nan_inf_enabled(program):
                 _check_nan_inf(fetch_names, fetches, new_state)
             if phases:
@@ -902,7 +913,7 @@ class Executor:
     # ------------------------------------------------------------------
     def run_pipeline(self, program=None, pipeline=None, fetch_list=None,
                      scope=None, max_steps=None, return_numpy=True,
-                     on_step=None, sentinel=None):
+                     on_step=None, sentinel=None, ledger=None):
         """Drive one epoch (or ``max_steps`` batches) of a
         ``datapipe`` pipeline through :meth:`run`.
 
@@ -927,7 +938,16 @@ class Executor:
         iterator position) and resumes.  Skipped steps never appear in
         the returned fetch lists, and a rollback also drops the entries
         it rewound (their batches re-run and re-append), so each
-        applied batch appears exactly once."""
+        applied batch appears exactly once.
+
+        ``ledger``: a :class:`paddle_tpu.obs.ledger.RunLedger` appends
+        one step row per APPLIED batch (skipped/poisoned steps write no
+        row), BEFORE ``on_step`` runs — so a checkpoint committed by
+        ``on_step`` carries a sidecar whose ``rows_total`` includes its
+        own step, the exactly-once resume invariant.  When omitted, the
+        sentinel's checkpoint manager's ``ledger`` attribute (if any)
+        is used, so wiring the ledger into the manager arms the whole
+        loop.  Disabled path is a single ``None`` check per step."""
         from paddle_tpu import profiler as _profiler
         from paddle_tpu.fault import chaos as _chaos
         from paddle_tpu.fault.sentinel import NumericalFault
@@ -947,6 +967,10 @@ class Executor:
         mgr = sentinel.manager if sentinel is not None else None
         last_ckpt = getattr(mgr, "last_committed_step", None) \
             if mgr is not None else None
+        if ledger is None and mgr is not None:
+            ledger = getattr(mgr, "ledger", None)
+        fetch_name_list = [v.name if hasattr(v, "name") else str(v)
+                           for v in (fetch_list or [])]
         it = iter(pipeline)
         try:
             step = 0
@@ -958,10 +982,10 @@ class Executor:
                     batch = next(it)
                 except StopIteration:
                     break
+                stall = time.perf_counter() - t0
                 # recorded only on success: a normal epoch-end
                 # StopIteration is not an error-tagged span
-                _record_span("datapipe.next", t0,
-                             time.perf_counter() - t0, step=step)
+                _record_span("datapipe.next", t0, stall, step=step)
                 _chaos.fire("train.step", step=step)
                 try:
                     with _span("train.step", step=step):
@@ -974,6 +998,10 @@ class Executor:
                                                scope=scope,
                                                return_numpy=return_numpy,
                                                sentinel=sentinel)
+                        if ledger is not None:
+                            ledger.note_step(fetch_names=fetch_name_list,
+                                             fetches=fetches,
+                                             stall_seconds=stall)
                         if on_step is not None:
                             on_step(step, fetches)
                 except NumericalFault as fault:
@@ -1068,9 +1096,11 @@ class Executor:
             (n, _freeze_lod(scope.find_lod(n))) for n in feed_arrays
             if scope.find_lod(n) is not None))
         from paddle_tpu import profiler as _profiler
+        from paddle_tpu.obs import numerics as _numerics
         return (id(program), program._version, block.idx, _amp_enabled(program),
                 id(scope),  # interpret-mode steps bind the scope (ScopeEnv)
                 _profiler.op_profiling_enabled(),  # forces interpret mode
+                _numerics.probing_enabled(),  # forces interpret mode
                 bool(getattr(program, "_release_memory", False)),
                 tuple(sorted((n, str(a.dtype), a.shape)
                              for n, a in feed_arrays.items())),
@@ -1148,6 +1178,8 @@ class Executor:
         if interpret and not getattr(program, "expect_host_ops", False):
             _warn_host_op_cliff(program, block)
         interpret = interpret or _profiler.op_profiling_enabled()
+        from paddle_tpu.obs import numerics as _numerics
+        interpret = interpret or _numerics.probing_enabled()
         # the opt pipeline's compile-amortization gate: a run-once
         # initializer whose static cost proves the XLA compile can
         # never pay for itself executes op-by-op eagerly instead
@@ -1223,10 +1255,17 @@ class Executor:
                              if n in env}
             return fetches, new_state
 
+        # which inout state names are Parameters — the sentinel's fused
+        # health norms (train.param_norm / train.grad_norm) reduce over
+        # exactly these
+        param_names = tuple(
+            n for n in inout_names + create_state
+            if isinstance(_safe_var(block, n), framework.Parameter))
+
         return {"sig": sig, "step": step, "feed_names": feed_names,
                 "ro_names": ro_names, "inout_names": inout_names,
                 "create_state": create_state, "interpret": interpret,
-                "uses_rng": uses_rng}
+                "uses_rng": uses_rng, "param_names": param_names}
 
     # ------------------------------------------------------------------
     def _get_compiled(self, program, block, feed_arrays, fetch_names, scope,
@@ -1266,6 +1305,7 @@ class Executor:
                                   tuple(fetch_names), parts["uses_rng"])
         compiled.donated = donate and not parts["interpret"]
         compiled.perf = getattr(fn, "perf", None)
+        compiled.param_names = parts["param_names"]
         self._cache_insert(sig, compiled)
         return compiled
 
@@ -1279,6 +1319,13 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+def _safe_var(block, name):
+    try:
+        return block.var(name)
+    except Exception:
+        return None
 
 
 def _mfu_gauge_for(program):

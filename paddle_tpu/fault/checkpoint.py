@@ -23,11 +23,12 @@ from paddle_tpu.fault import chaos
 from paddle_tpu.obs.trace import span as _span
 
 __all__ = ["CheckpointManager", "CorruptCheckpoint", "MANIFEST_NAME",
-           "DATAPIPE_STATE_NAME", "write_manifest", "verify_checkpoint",
-           "commit_checkpoint"]
+           "DATAPIPE_STATE_NAME", "LEDGER_STATE_NAME", "write_manifest",
+           "verify_checkpoint", "commit_checkpoint"]
 
 MANIFEST_NAME = "MANIFEST.json"
 DATAPIPE_STATE_NAME = "datapipe_state.pkl"
+LEDGER_STATE_NAME = "ledger_state.pkl"
 GOOD_POINTER_NAME = "last_good"
 
 
@@ -215,13 +216,19 @@ class CheckpointManager:
     """
 
     def __init__(self, dirname, keep=5, executor=None, main_program=None,
-                 scope=None, datapipe=None, mesh=None, shard_specs=None):
+                 scope=None, datapipe=None, mesh=None, shard_specs=None,
+                 ledger=None):
         self.dirname = str(dirname)
         self.keep = keep
         self.executor = executor
         self.main_program = main_program
         self.scope = scope
         self.datapipe = datapipe
+        # optional obs.ledger.RunLedger: its resume cursor rides every
+        # checkpoint (same atomic commit) exactly like datapipe state,
+        # and every restore rewinds it — no duplicated/missing step rows
+        # across kill→restore or sentinel rollback
+        self.ledger = ledger
         self.mesh = mesh
         self.shard_specs = dict(shard_specs or {})
         self._async_pool = None       # lazily-built single writer thread
@@ -309,11 +316,14 @@ class CheckpointManager:
             # host copies: donation on the next step may delete the
             # device buffers this snapshot references
             state = {n: np.asarray(v) for n, v in state.items()}
-        extras = None
+        extras = {}
         if self.datapipe is not None:
-            extras = {_datapipe_state_name(): pickle.dumps(
-                self.datapipe.state_dict(), protocol=4)}
-        return state, extras
+            extras[_datapipe_state_name()] = pickle.dumps(
+                self.datapipe.state_dict(), protocol=4)
+        if self.ledger is not None:
+            extras[LEDGER_STATE_NAME] = pickle.dumps(
+                self.ledger.state_dict(), protocol=4)
+        return state, extras or None
 
     def _save_committed(self, step, state, extras):
         from paddle_tpu import io
@@ -511,6 +521,7 @@ class CheckpointManager:
                                  shardings=shardings, mesh=mesh)
         io._write_latest(self.dirname, step)
         self._restore_datapipe(step)
+        self._restore_ledger(step)
         return got
 
     # -- restore -----------------------------------------------------------
@@ -529,6 +540,7 @@ class CheckpointManager:
                                  mesh=mesh if mesh is not None
                                  else self.mesh)
         self._restore_datapipe(step)
+        self._restore_ledger(step)
         return got
 
     def _restore_datapipe(self, step):
@@ -559,6 +571,19 @@ class CheckpointManager:
         with open(p, "rb") as f:
             self.datapipe.load_state_dict(pickle.load(f))
         self.last_restore_rewound = True
+        return True
+
+    def _restore_ledger(self, step):
+        """Rewind the attached run ledger to the cursor saved next to
+        ``ckpt-<step>`` (no-op without a ledger or for checkpoints
+        written before one was attached — those rows simply stay)."""
+        if self.ledger is None:
+            return False
+        p = os.path.join(self.path(step), LEDGER_STATE_NAME)
+        if not os.path.exists(p):
+            return False
+        with open(p, "rb") as f:
+            self.ledger.load_state_dict(pickle.load(f))
         return True
 
     def restore_latest(self, shardings=None, mesh=None):
@@ -594,6 +619,7 @@ class CheckpointManager:
                     continue
                 io._write_latest(self.dirname, step)
                 self._restore_datapipe(step)
+                self._restore_ledger(step)
                 return got
             got = io.load_checkpoint(
                 self.executor, self.dirname,
@@ -603,6 +629,7 @@ class CheckpointManager:
             # just quarantined (load_checkpoint(step=None) keeps working)
             io._write_latest(self.dirname, step)
             self._restore_datapipe(step)
+            self._restore_ledger(step)
             return got
         # nothing restorable: drop a ``latest`` pointer that would now
         # name a quarantined dir (load_checkpoint(step=None) then fails

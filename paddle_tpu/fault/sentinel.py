@@ -52,7 +52,7 @@ from paddle_tpu.fault import chaos
 from paddle_tpu.obs.trace import span as _span, current_trace_id
 
 __all__ = ["Sentinel", "NumericalFault", "sentinel_from_env",
-           "replay_bundle", "BUNDLE_FORMAT"]
+           "replay_bundle", "load_bundle", "BUNDLE_FORMAT"]
 
 logger = logging.getLogger(__name__)
 
@@ -67,17 +67,19 @@ class NumericalFault(RuntimeError):
     ``"loss_spike"``; ``bad`` names the offending tensors; ``repro`` is
     the self-contained replay payload (see :func:`replay_bundle`);
     ``injected`` marks faults manufactured by the ``sentinel.nan``
-    failpoint.
+    failpoint; ``health`` is the fused norm digest (param/grad norm,
+    update ratio) of the tripping step, when the check computed one.
     """
 
     def __init__(self, message, step=None, reason=None, bad=None,
-                 repro=None, injected=False):
+                 repro=None, injected=False, health=None):
         super().__init__(message)
         self.step = step
         self.reason = reason
         self.bad = list(bad or [])
         self.repro = repro
         self.injected = injected
+        self.health = health
 
 
 def _metrics():
@@ -95,6 +97,9 @@ class _NullMetrics:
         pass
 
     def observe(self, name, value):
+        pass
+
+    def set_gauge(self, name, value):
         pass
 
 
@@ -169,13 +174,15 @@ class Sentinel:
         self._check_fn = None     # lazily-jitted fused finite check
         self._metrics_enabled = True   # replay guards flip this off
         self._warned_loss_name = False
+        self.last_health = None   # fused norm digest of the last check
 
     def _m(self):
         return _metrics() if self._metrics_enabled else _NULL_METRICS
 
     # -- detection (called by Executor.run on guarded steps) ------------
 
-    def after_step(self, fetch_names, fetches, new_state, repro=None):
+    def after_step(self, fetch_names, fetches, new_state, repro=None,
+                   prev_state=None, param_names=()):
         """Inspect one step's results BEFORE scope write-back.
 
         Applies the ``sentinel.nan`` poison when that failpoint fires,
@@ -183,7 +190,15 @@ class Sentinel:
         check and the EMA spike detector.  Returns the (possibly
         poisoned) ``(fetches, new_state)`` for write-back; raises
         :class:`NumericalFault` on a trip, in which case the executor
-        discards the update."""
+        discards the update.
+
+        ``prev_state``/``param_names`` (the executor's pre-step inout
+        state and which of those names are Parameters) extend the fused
+        reduction with the global param/update norms — still ONE device
+        computation and one host sync per guarded step — published as
+        the ``train.param_norm`` / ``train.grad_norm`` /
+        ``train.update_ratio`` gauges and carried into the escalation
+        context (quarantine bundles, rollback post-mortems)."""
         self._tick += 1
         if self._tick % self.cadence:
             return fetches, new_state
@@ -203,7 +218,7 @@ class Sentinel:
         try:
             with _span("sentinel.check", step=self._tick):
                 self._inspect(fetch_names, fetches, new_state, repro,
-                              injected)
+                              injected, prev_state, param_names)
         finally:
             # a tripped check raises out of _inspect — exactly the
             # expensive case (it pays the host-side culprit sweep), so
@@ -213,12 +228,19 @@ class Sentinel:
                               time.perf_counter() - t0)
         return fetches, new_state
 
-    def _inspect(self, fetch_names, fetches, new_state, repro, injected):
+    def _inspect(self, fetch_names, fetches, new_state, repro, injected,
+                 prev_state=None, param_names=()):
         m = self._m()
         m.inc("sentinel.checks")
         named = list(zip(fetch_names, fetches))
         named += list(new_state.items())
-        finite = self._device_all_finite([v for _, v in named])
+        finite, health = self._device_check([v for _, v in named],
+                                            new_state, prev_state,
+                                            param_names)
+        self.last_health = health
+        if health is not None:
+            from paddle_tpu.obs import numerics as _numerics
+            _numerics.set_health_gauges(m, health)
         if not finite:
             bad = [n for n, v in named if not _host_finite(v)]
             m.inc("sentinel.non_finite")
@@ -252,26 +274,42 @@ class Sentinel:
                 logger.warning("sentinel: repro payload capture failed",
                                exc_info=True)
         raise NumericalFault(message, step=self._tick, reason=reason,
-                             bad=bad, repro=payload, injected=injected)
+                             bad=bad, repro=payload, injected=injected,
+                             health=self.last_health)
 
-    def _device_all_finite(self, values):
-        """Fused ``jnp.isfinite(...).all()`` over every floating tensor,
-        all-reduced to ONE device scalar — the single host sync a check
-        step pays.  Culprit naming (rare) happens host-side after."""
-        import jax
+    def _device_check(self, values, new_state, prev_state, param_names):
+        """Fused ``jnp.isfinite(...).all()`` over every floating tensor
+        PLUS the global param/update norms (obs/numerics.py), all in ONE
+        device computation — the single host sync a check step pays.
+        Culprit naming (rare) happens host-side after.  Returns
+        ``(all_finite, health_dict_or_None)``."""
         import jax.numpy as jnp
+        from paddle_tpu.obs import numerics as _numerics
         leaves = [jnp.asarray(v) for v in values
                   if hasattr(v, "dtype") or _is_arraylike(v)]
         leaves = [l for l in leaves
                   if jnp.issubdtype(l.dtype, jnp.floating)]
-        if not leaves:
-            return True
+        new_params, old_params = [], []
+        if prev_state is not None:
+            for n in param_names:
+                nv = new_state.get(n)
+                ov = prev_state.get(n)
+                if nv is None or ov is None:
+                    continue
+                a, b = jnp.asarray(nv), jnp.asarray(ov)
+                if jnp.issubdtype(a.dtype, jnp.floating) and \
+                        a.shape == b.shape:
+                    new_params.append(a)
+                    old_params.append(b)
+        if not leaves and not new_params:
+            return True, None
         if self._check_fn is None:
-            def _all_finite(arrs):
-                return jnp.all(jnp.stack(
-                    [jnp.isfinite(a).all() for a in arrs]))
-            self._check_fn = jax.jit(_all_finite)
-        return bool(self._check_fn(leaves))
+            self._check_fn = _numerics.fused_check_fn()
+        finite, norms = self._check_fn(leaves, new_params, old_params)
+        import numpy as np
+        health = _numerics.health_from_norms(np.asarray(norms)) \
+            if norms.shape[0] else None
+        return bool(finite), health
 
     def _loss_value(self, fetch_names, fetches):
         idx = self._loss_index(fetch_names, fetches)
@@ -378,6 +416,9 @@ class Sentinel:
                          "spike_factor": self.spike_factor,
                          "ema_beta": self.ema_beta,
                          "loss_name": self.loss_name},
+            # fused norm digest of the tripping step — forensics can
+            # tell "params were already huge" from "one bad batch"
+            "health": getattr(fault, "health", None),
             "repro": fault.repro,
         }
         with _span("sentinel.quarantine", step=bundle["step"]):
@@ -419,6 +460,7 @@ class Sentinel:
                 reason=f"sentinel rollback to step {restored}",
                 extra={"restored_step": int(restored),
                        "fault": str(fault) if fault else None,
+                       "health": self.last_health,
                        "quarantine_dir": self.quarantine_dir})
         except Exception:
             pass
@@ -521,18 +563,10 @@ def sentinel_from_env(manager=None, spec=None, **overrides):
 # offline replay (`paddle_tpu replay <bundle>`)
 # ---------------------------------------------------------------------------
 
-def replay_bundle(path):
-    """Re-execute a quarantined step from its repro bundle and report
-    whether the numerical fault reproduces.
-
-    Rebuilds the program, pre-step state, batch, and RNG coordinates
-    recorded at quarantine time, runs ONE step under a detect-only
-    sentinel, and returns ``{"reproduced": bool, "reason", "bad",
-    "step", "injected"}``.  Bundles whose fault was manufactured by the
-    ``sentinel.nan`` failpoint re-arm it for one fire, so injected
-    drills replay deterministically too.  Run under
-    ``JAX_PLATFORMS=cpu`` (the CLI does this) to debug a TPU fault on a
-    workstation."""
+def load_bundle(path):
+    """Unpickle + sanity-check a quarantine bundle (shared by
+    :func:`replay_bundle` and ``numerics.localize_bundle``); a
+    malformed bundle raises ``ValueError`` (the CLI's exit 2)."""
     try:
         with open(path, "rb") as f:
             bundle = pickle.load(f)
@@ -546,6 +580,22 @@ def replay_bundle(path):
         # the CLI's "malformed bundle" verdict (exit 2) — never the
         # "replayed clean" one
         raise ValueError(f"{path}: malformed bundle: {e}") from e
+    return bundle
+
+
+def replay_bundle(path):
+    """Re-execute a quarantined step from its repro bundle and report
+    whether the numerical fault reproduces.
+
+    Rebuilds the program, pre-step state, batch, and RNG coordinates
+    recorded at quarantine time, runs ONE step under a detect-only
+    sentinel, and returns ``{"reproduced": bool, "reason", "bad",
+    "step", "injected"}``.  Bundles whose fault was manufactured by the
+    ``sentinel.nan`` failpoint re-arm it for one fire, so injected
+    drills replay deterministically too.  Run under
+    ``JAX_PLATFORMS=cpu`` (the CLI does this) to debug a TPU fault on a
+    workstation."""
+    bundle = load_bundle(path)
     repro = bundle.get("repro")
     if not repro:
         raise ValueError(f"{path}: bundle carries no repro payload")

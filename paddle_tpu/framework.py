@@ -296,8 +296,14 @@ class Operator:
                 attrs[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
             else:
                 attrs[k] = v
-        return {"type": self.type, "inputs": self.inputs,
-                "outputs": self.outputs, "attrs": attrs}
+        out = {"type": self.type, "inputs": self.inputs,
+               "outputs": self.outputs, "attrs": attrs}
+        # keep the diagnostic pointer across save/load round-trips:
+        # replay --localize names an op of a DESERIALIZED program, and
+        # without the site the report can only say "op #12"
+        if self.creation_site is not None:
+            out["creation_site"] = list(self.creation_site)
+        return out
 
     @staticmethod
     def from_dict(block, d, program):
@@ -309,7 +315,11 @@ class Operator:
                 attrs[k] = np.asarray(v["__ndarray__"], dtype=v["dtype"])
             else:
                 attrs[k] = v
-        return Operator(block, d["type"], d["inputs"], d["outputs"], attrs)
+        op = Operator(block, d["type"], d["inputs"], d["outputs"], attrs)
+        site = d.get("creation_site")
+        if site:
+            op.creation_site = (site[0], site[1])
+        return op
 
     def __repr__(self):
         ins = {k: v for k, v in self.inputs.items()}

@@ -28,6 +28,18 @@ Three FLEET-level layers on top (the multi-process plane):
   ``paddle_tpu bench check`` fails on regression past per-metric
   tolerance bands.
 
+And the TRAINING-health plane:
+
+- :mod:`paddle_tpu.obs.ledger` — the persistent run ledger: an
+  append-only, schema-validated JSONL step series (loss, grad/param
+  norms, MFU, tokens/s, datapipe stall, HBM headroom) with atomic
+  segment rotation, exactly-once resume through the checkpoint
+  sidecar, drift alerts, and the ``paddle_tpu runs tail|show|compare``
+  CLI family.
+- :mod:`paddle_tpu.obs.numerics` — per-op tensor-stat probes (the
+  ``paddle_tpu replay --localize`` fault localizer) and the fused
+  param/grad-norm health reduction the sentinel runs per guarded step.
+
 And the DEVICE-side plane:
 
 - :mod:`paddle_tpu.obs.perf` — XLA cost/memory attribution per jit key
@@ -50,6 +62,8 @@ from paddle_tpu.obs import aggregate
 from paddle_tpu.obs import bench_history
 from paddle_tpu.obs import perf
 from paddle_tpu.obs import slo
+from paddle_tpu.obs import ledger
+from paddle_tpu.obs import numerics
 from paddle_tpu.obs.trace import (span, record_span, trace_context,
                                   current_trace_id, new_trace_id,
                                   chrome_trace, dump_chrome_trace,
@@ -61,7 +75,8 @@ from paddle_tpu.obs.aggregate import (FleetScraper, assemble_fleet_trace,
 from paddle_tpu.obs.slo import SLOWatchdog, load_spec, validate_spec
 
 __all__ = ["trace", "flight", "prom", "aggregate", "bench_history",
-           "perf", "slo", "span", "record_span", "trace_context",
+           "perf", "slo", "ledger", "numerics",
+           "span", "record_span", "trace_context",
            "current_trace_id", "new_trace_id", "chrome_trace",
            "dump_chrome_trace", "set_process_name", "snapshot_payload",
            "write_postmortem", "read_postmortem", "render_prometheus",

@@ -64,7 +64,12 @@ _MERGED_SERIES = ("serving.request_seconds", "gen.ttft_seconds",
 # the fleet_mfu_mean / fleet_hbm_headroom_min rollups and the router's
 # /stats `fleet_perf` summary
 PERF_GAUGES = ("train.mfu", "gen.decode_mfu", "hbm.headroom_bytes",
-               "hbm.total_bytes", "hbm.high_watermark_bytes")
+               "hbm.total_bytes", "hbm.high_watermark_bytes",
+               # training-health gauges (obs.numerics fused norms):
+               # federated per replica and rolled up as
+               # fleet_grad_norm_max — the exploding replica pages you
+               "train.grad_norm", "train.param_norm",
+               "train.update_ratio")
 
 
 def replica_perf(scrapes):
@@ -321,6 +326,12 @@ def render_federated(scrapes, rps=None, tokens_per_sec=None):
     rollup("paddle_tpu_fleet_hbm_headroom_min_bytes",
            min(heads) if heads else None,
            "tightest device-memory headroom across replicas")
+    grads = [p["train.grad_norm"] for p in perf.values()
+             if p.get("train.grad_norm") is not None]
+    rollup("paddle_tpu_fleet_grad_norm_max",
+           max(grads) if grads else None,
+           "largest per-step update norm across training replicas "
+           "(the diverging replica surfaces here first)")
 
     lines.append("# HELP paddle_tpu_fleet_replica_up replica scrape "
                  "health (0 = unreachable/stale)")

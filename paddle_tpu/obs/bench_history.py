@@ -159,6 +159,25 @@ def validate_trajectory(obj):
                                     f"finite number, got {v!r}")
         if "baseline" in run and not isinstance(run["baseline"], bool):
             problems.append(f"{where}: baseline must be a boolean")
+        if "ledger" in run:
+            # optional provenance pointer at the run's ledger directory
+            # (obs.ledger): `bench check` refuses a record whose ledger
+            # schema version this build cannot read — comparing against
+            # rows it would misparse proves nothing
+            from paddle_tpu.obs.ledger import LEDGER_FORMAT
+            led = run["ledger"]
+            if not isinstance(led, dict):
+                problems.append(f"{where}: ledger must be an object")
+            else:
+                if not isinstance(led.get("path"), str) \
+                        or not led.get("path"):
+                    problems.append(f"{where}: ledger.path must be a "
+                                    f"non-empty string")
+                if led.get("format") != LEDGER_FORMAT:
+                    problems.append(
+                        f"{where}: ledger.format must be "
+                        f"{LEDGER_FORMAT}, got {led.get('format')!r} "
+                        f"(malformed ledger schema version)")
         if "mfu_basis" in run and run["mfu_basis"] not in MFU_BASES:
             problems.append(f"{where}: mfu_basis must be one of "
                             f"{MFU_BASES}, got {run['mfu_basis']!r}")

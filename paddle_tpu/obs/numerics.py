@@ -1,0 +1,324 @@
+"""Numerics observatory: per-op tensor-stat probes + fused training-health
+norms (docs/observability.md "Run ledger & numerics").
+
+Two halves, both cheap when disarmed:
+
+* **Op probes** — the reference's ``FLAGS_check_nan_inf`` walks every
+  output tensor after each op kernel and names the first non-finite
+  one; our compiled path fuses the whole step into one XLA executable,
+  so the walk only exists in interpreted op-by-op execution.  Arming a
+  :class:`ProbeCollector` (``with numerics.probe(collector): ...``)
+  forces interpret mode — exactly like op profiling — and the executor
+  calls :func:`record_op` after each lowered op with its concrete
+  outputs.  The collector keeps cheap host-side stats (finite fraction,
+  absmax, zero fraction, mean/std) for a bounded trail of recent ops
+  and captures the FIRST op producing a non-finite output together
+  with its ``creation_site`` and the stats of its *inputs* at that
+  moment.  :func:`localize_bundle` wires this into sentinel quarantine
+  bundles: ``paddle_tpu replay <bundle> --localize`` re-executes the
+  quarantined step on CPU op-by-op and the report names the poisoned
+  op.  The disarmed hot path is one module-global ``is None`` check.
+
+* **Health norms** — :func:`fused_check_fn` builds the jitted reduction
+  the sentinel runs on guarded steps: the existing all-finite check
+  PLUS the global parameter norm and update norm of the step, fused
+  into ONE device computation (a guarded step still pays exactly one
+  device sync).  :func:`set_health_gauges` publishes them as the
+  ``train.param_norm`` / ``train.grad_norm`` / ``train.update_ratio``
+  gauges (``train.grad_norm`` is the l2 norm of the applied parameter
+  update — the optimizer-scaled gradient step, the quantity that
+  explodes when gradients do), which the run ledger snapshots per step
+  and the fleet scraper federates.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+
+import numpy as np
+
+__all__ = ["ProbeCollector", "probe", "probing_enabled", "record_op",
+           "tensor_stats", "localize_bundle", "fused_check_fn",
+           "set_health_gauges"]
+
+logger = logging.getLogger(__name__)
+
+# the armed probe collector; the disarmed per-op cost is this one read
+_PROBE = None
+
+
+def probing_enabled():
+    """True while a probe collector is armed (forces interpret mode,
+    like ``profiler.op_profiling_enabled`` — the per-op hook only
+    exists in op-by-op execution)."""
+    return _PROBE is not None
+
+
+def tensor_stats(value):
+    """Cheap host-side stats of one tensor: finite fraction, absmax,
+    zero fraction, mean/std (over finite entries), dtype and shape.
+    Never raises — un-statable values degrade to their type name."""
+    try:
+        arr = np.asarray(value)
+    except Exception:
+        return {"kind": type(value).__name__}
+    kind = getattr(arr.dtype, "kind", None)
+    if kind in ("O", "S", "U", "M", "m"):
+        return {"kind": type(value).__name__}
+    out = {"dtype": str(arr.dtype), "shape": list(arr.shape)}
+    if arr.size == 0:
+        out.update(finite_frac=1.0, absmax=0.0, zero_frac=0.0,
+                   mean=0.0, std=0.0)
+        return out
+    # ml_dtypes low-precision floats (bfloat16, float8) register as
+    # structured kind "V" but cast cleanly to float32
+    if kind == "V" and "float" in str(arr.dtype):
+        kind = "f"
+        arr = arr.astype("float32")
+    if kind not in ("f", "c"):
+        if kind in ("i", "u", "b"):
+            a = arr.astype("float64")
+            out.update(finite_frac=1.0,
+                       absmax=float(np.abs(a).max()),
+                       zero_frac=float((a == 0).mean()),
+                       mean=float(a.mean()), std=float(a.std()))
+        return out
+    # float32 covers ml_dtypes (bfloat16) numpy can't reduce natively
+    a = arr.astype("float32", copy=False)
+    finite = np.isfinite(a)
+    n_finite = int(finite.sum())
+    out["finite_frac"] = n_finite / a.size
+    out["zero_frac"] = float((a == 0).mean())
+    if n_finite:
+        fin = a[finite] if n_finite != a.size else a
+        out["absmax"] = float(np.abs(fin).max())
+        out["mean"] = float(fin.mean())
+        out["std"] = float(fin.std())
+    else:
+        out.update(absmax=None, mean=None, std=None)
+    return out
+
+
+def _non_finite(stats):
+    frac = stats.get("finite_frac")
+    return frac is not None and frac < 1.0
+
+
+class ProbeCollector:
+    """Per-op stat collector for one interpreted execution.
+
+    ``trail`` bounds the rolling window of recent op stat rows;
+    ``poison_var`` (used by :func:`localize_bundle` on
+    ``sentinel.nan``-injected bundles) NaNs that variable at its
+    producing op, so the op-level poison lands exactly where the
+    sentinel's post-step poison *would have* originated and the drill
+    exercises the same localization machinery an organic fault does."""
+
+    def __init__(self, trail=16, poison_var=None):
+        self.trail = collections.deque(maxlen=max(1, int(trail)))
+        self.poison_var = poison_var
+        self.poisoned = False
+        self.first_bad = None
+        self.ops_probed = 0
+
+    def record_op(self, op, outputs, env):
+        from paddle_tpu.profiler import runtime_metrics
+        self.ops_probed += 1
+        runtime_metrics.inc("numerics.ops_probed")
+        if self.poison_var is not None and not self.poisoned \
+                and self.poison_var in outputs:
+            v = np.asarray(env[self.poison_var])
+            if getattr(v.dtype, "kind", None) == "f":
+                env[self.poison_var] = np.full_like(v, np.nan)
+                self.poisoned = True
+        out_stats = {n: tensor_stats(env.get(n))
+                     for n in op.output_arg_names if n}
+        row = {"index": self.ops_probed - 1, "type": op.type,
+               "outputs": out_stats}
+        self.trail.append(row)
+        if self.first_bad is None and \
+                any(_non_finite(s) for s in out_stats.values()):
+            runtime_metrics.inc("numerics.non_finite_ops")
+            in_stats = {n: tensor_stats(env.get(n))
+                        for n in op.input_arg_names
+                        if n and env.get(n) is not None}
+            self.first_bad = {
+                "index": self.ops_probed - 1,
+                "type": op.type,
+                "creation_site": list(getattr(op, "creation_site", None)
+                                      or ()) or None,
+                "outputs": out_stats,
+                "inputs": in_stats,
+                "trail": [dict(r) for r in self.trail],
+            }
+
+
+@contextlib.contextmanager
+def probe(collector):
+    """Arm ``collector`` as the process-global probe for the body."""
+    global _PROBE
+    prev = _PROBE
+    _PROBE = collector
+    try:
+        yield collector
+    finally:
+        _PROBE = prev
+
+
+def record_op(op, outputs, env):
+    """Executor hook: called after each lowered op in interpret mode
+    while a probe is armed (``lower_block``)."""
+    p = _PROBE
+    if p is not None:
+        p.record_op(op, outputs, env)
+
+
+# ---------------------------------------------------------------------------
+# op-level fault localization (`paddle_tpu replay <bundle> --localize`)
+# ---------------------------------------------------------------------------
+
+def _poison_target(program, fetch_names, loss_name=None):
+    """The variable an injected bundle's op-level poison lands on: the
+    recorded loss fetch when its producing op is in the program, else
+    the first fetch produced by any op."""
+    block = program.global_block()
+    produced = set()
+    for op in block.ops:
+        produced.update(n for n in op.output_arg_names if n)
+    if loss_name and loss_name in produced:
+        return loss_name
+    for name in fetch_names:
+        if name in produced:
+            return name
+    return None
+
+
+def localize_bundle(path, trail=16):
+    """Re-execute a quarantine bundle op-by-op on CPU with probes armed
+    and name the first op producing a non-finite output.
+
+    Returns ``{"localized": bool, "first_bad_op": {...} | None,
+    "step", "reason", "injected", "ops_probed", "bad", "health"}`` —
+    ``first_bad_op`` carries the op type, its ``creation_site``
+    (file, line of the user code that appended it), per-output stats,
+    the stats of its inputs at that moment, and the trailing stat rows
+    leading into it.  Bundles whose fault was manufactured by the
+    ``sentinel.nan`` failpoint poison the loss-producing op during the
+    re-execution (the sentinel's poison is post-step, so no op would
+    organically produce the NaN), exercising the same probe machinery.
+    Malformed / unreplayable bundles raise ``ValueError`` (the CLI's
+    exit 2), mirroring :func:`paddle_tpu.fault.sentinel.replay_bundle`.
+    """
+    from paddle_tpu.executor import Executor
+    from paddle_tpu.fault.sentinel import load_bundle
+    from paddle_tpu.framework import Program
+    from paddle_tpu.place import CPUPlace
+    from paddle_tpu.scope import Scope
+
+    bundle = load_bundle(path)
+    repro = bundle.get("repro")
+    if not repro:
+        raise ValueError(f"{path}: bundle carries no repro payload")
+    try:
+        program = Program.from_dict(repro["program"])
+        program.random_seed = repro.get("random_seed")
+        scope = Scope()
+        for name, value in (repro.get("state") or {}).items():
+            scope.set_var(name, value)
+        run_counter = int(repro.get("run_counter", 1)) - 1
+        feed = dict(repro["feed"])
+        fetch_names = list(repro["fetch_names"])
+    except Exception as e:
+        raise ValueError(
+            f"{path}: cannot rebuild repro payload: {e}") from e
+    exe = Executor(CPUPlace())
+    exe._run_counter = run_counter
+    det = bundle.get("detector") or {}
+    collector = ProbeCollector(trail=trail)
+    if bundle.get("injected"):
+        collector.poison_var = _poison_target(
+            program, fetch_names, loss_name=det.get("loss_name"))
+    try:
+        with probe(collector):
+            exe.run(program, feed=feed, fetch_list=fetch_names,
+                    scope=scope)
+    except Exception as e:
+        raise ValueError(
+            f"{path}: bundle does not re-execute: {e}") from e
+    return {
+        "localized": collector.first_bad is not None,
+        "first_bad_op": collector.first_bad,
+        "step": bundle.get("step"),
+        "reason": bundle.get("reason"),
+        "injected": bool(bundle.get("injected")),
+        "ops_probed": collector.ops_probed,
+        "bad": list(bundle.get("bad") or []),
+        "health": bundle.get("health"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# fused training-health norms (the sentinel's guarded-step reduction)
+# ---------------------------------------------------------------------------
+
+def fused_check_fn():
+    """Build the jitted fused guarded-step reduction: all-finite over
+    every floating check tensor PLUS the global parameter/update norms,
+    one device computation (jit retraces per pytree structure and is
+    cached thereafter — the sentinel holds one instance).
+
+    Signature: ``fn(arrs, new_params, old_params) -> (all_finite,
+    norms)`` where ``norms`` is ``[param_norm, update_norm]`` (empty
+    when no parameter pairs were passed)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _ssq(xs):
+        total = jnp.zeros((), jnp.float32)
+        for x in xs:
+            total = total + jnp.sum(
+                jnp.square(x.astype(jnp.float32)))
+        return total
+
+    def _fused(arrs, new_params, old_params):
+        if arrs:
+            finite = jnp.all(jnp.stack(
+                [jnp.isfinite(a).all() for a in arrs]))
+        else:
+            finite = jnp.asarray(True)
+        if new_params:
+            p = jnp.sqrt(_ssq(new_params))
+            u = jnp.sqrt(_ssq([n - o for n, o in
+                               zip(new_params, old_params)]))
+            norms = jnp.stack([p, u])
+        else:
+            norms = jnp.zeros((0,), jnp.float32)
+        return finite, norms
+
+    return jax.jit(_fused)
+
+
+def health_from_norms(norms):
+    """``(param_norm, update_norm)`` host floats -> the health dict the
+    sentinel stashes in its escalation context (quarantine bundles,
+    rollback post-mortems).  ``update_ratio`` is update/param — the
+    step-size signal that precedes most divergences."""
+    if norms is None or len(norms) < 2:
+        return None
+    param_norm = float(norms[0])
+    grad_norm = float(norms[1])
+    ratio = grad_norm / (param_norm + 1e-12)
+    return {"param_norm": param_norm, "grad_norm": grad_norm,
+            "update_ratio": ratio}
+
+
+def set_health_gauges(metrics, health):
+    """Publish the health dict as gauges.  Disabled path (no health
+    computed this step) is the ``None`` check — nothing else runs."""
+    if metrics is None or health is None:
+        return
+    metrics.set_gauge("train.param_norm", health["param_norm"])
+    metrics.set_gauge("train.grad_norm", health["grad_norm"])
+    metrics.set_gauge("train.update_ratio", health["update_ratio"])

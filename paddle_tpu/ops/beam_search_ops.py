@@ -164,11 +164,21 @@ def beam_search_decode_lower(ctx: LowerContext):
 # off the beam (step t); every complete path through the first t
 # expansions is a candidate, gold is appended as an extra path when it
 # fell off; the cost is softmax cross-entropy over the summed path
-# scores with gold as the hard label.  (Where the reference indexes the
-# parent-candidate matrix by sub-sequence row directly — its own
-# TODO(caoying) admits the -1-padding mismatch — this implementation maps
-# rows through the enumerated non-(-1) slots, which is the layout its
-# test generator produces.)
+# scores with gold as the hard label.
+#
+# Padding contract (resolves the reference's TODO(caoying)): a row of
+# Ids[i] may be right-padded with -1 when the beam under-filled;
+# expansion i+1 then has one sub-sequence per NON-(-1) slot of Ids[i],
+# in row-major order — padded slots own no sub-sequence.  The reference
+# instead indexed the parent-candidate matrix by raw ``row*beam_size +
+# col`` slot, which its own TODO admits drifts off by one sub-sequence
+# per preceding -1 pad (kmax_seq_score, its upstream, pads exactly
+# this way).  This implementation keeps the enumerated-slot mapping —
+# the layout kmax_seq_score and the reference's test generator both
+# produce — as the DOCUMENTED behavior; the divergence from the
+# reference's raw-slot indexing is intentional and pinned by
+# ``tests/test_beam_search.py::TestCrossEntropyOverBeam::
+# test_padded_row_maps_through_nonpad_slots``.
 # ---------------------------------------------------------------------------
 
 def _beam_cost_one_seq(scores, row_starts, ids, golds, beam_size):

@@ -342,6 +342,31 @@ class TestCrossEntropyOverBeam:
         want = -np.log(np.exp(z[0]) / np.exp(z).sum())
         np.testing.assert_allclose(cost.reshape(()), want, rtol=1e-5)
 
+    def test_padded_row_maps_through_nonpad_slots(self):
+        # the documented padding contract (the reference's
+        # TODO(caoying) case): ids0 has a -1 pad BEFORE the gold pick,
+        # so gold's sub-sequence in expansion 1 is the count of
+        # non-(-1) slots before it (here 1), NOT its raw slot index
+        # (here 2 — one past the last sub-sequence that exists)
+        sc0 = np.array([[0.5], [1.0], [0.2]], "float32")
+        ids0 = np.array([[2, -1, 1]], "int64")  # slot 1 under-filled
+        g0 = np.array([1], "int64")             # picked at slot 2
+        # 2 sub-seqs — one per non-pad slot of ids0 (ids 2, then 1)
+        sc1 = np.array([[0.3], [0.7], [0.9], [0.1]], "float32")
+        ids1 = np.array([[0, -1, -1], [1, 0, -1]], "int64")
+        g1 = np.array([1], "int64")
+        feeds = {"sc0": (sc0, [[0, 3]]),
+                 "ids0": ids0, "g0": g0,
+                 "sc1": (sc1, [[0, 2], [0, 2, 4]]),
+                 "ids1": ids1, "g1": g1}
+        (cost,) = self._run_cost(feeds, 2, [1, 2])
+        # paths (non-pad slots of ids1, row-major): (0,0) parent id 2,
+        # (1,0) and (1,1) parent id 1 — gold's row is sub-seq 1, so
+        # gold's path is (1,0): score 1.0 + 0.1
+        z = np.array([0.2 + 0.3, 1.0 + 0.1, 1.0 + 0.9])
+        want = -np.log(np.exp(z[1]) / np.exp(z).sum())
+        np.testing.assert_allclose(cost.reshape(()), want, rtol=1e-5)
+
     def test_gradients_numeric(self):
         # central differences on every candidate score, single expansion
         sc = np.array([[0.1], [0.9], [0.4], [0.3]], "float32")

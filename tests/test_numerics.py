@@ -1,0 +1,156 @@
+"""Numerics-observatory tests (``paddle_tpu.obs.numerics``): tensor
+stats, probe-forced interpret execution, organic NaN localization with
+creation-site attribution, the fused health-norm reduction, and the
+creation-site Program round-trip the localizer depends on."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.obs import numerics
+from paddle_tpu.profiler import RuntimeMetrics, runtime_metrics
+
+
+class TestTensorStats:
+    def test_finite_float(self):
+        s = numerics.tensor_stats(np.array([[1.0, -2.0], [0.0, 4.0]],
+                                           np.float32))
+        assert s["finite_frac"] == 1.0
+        assert s["absmax"] == 4.0
+        assert s["zero_frac"] == 0.25
+        assert s["shape"] == [2, 2]
+
+    def test_non_finite_fraction(self):
+        s = numerics.tensor_stats(
+            np.array([1.0, np.nan, np.inf, 2.0], np.float32))
+        assert s["finite_frac"] == 0.5
+        # stats computed over the finite entries only
+        assert s["absmax"] == 2.0 and s["mean"] == 1.5
+
+    def test_all_nan_degrades(self):
+        s = numerics.tensor_stats(np.full(3, np.nan, np.float32))
+        assert s["finite_frac"] == 0.0 and s["absmax"] is None
+
+    def test_int_bool_empty_and_unstatable(self):
+        assert numerics.tensor_stats(
+            np.array([0, 3], np.int64))["absmax"] == 3.0
+        assert numerics.tensor_stats(
+            np.array([], np.float32))["finite_frac"] == 1.0
+        assert numerics.tensor_stats(object())["kind"] == "object"
+
+    def test_bfloat16(self):
+        import jax.numpy as jnp
+        s = numerics.tensor_stats(jnp.asarray([1.0, 2.0], jnp.bfloat16))
+        assert s["finite_frac"] == 1.0 and s["absmax"] == 2.0
+
+
+class TestProbeExecution:
+    def test_probe_forces_interpret_and_counts_ops(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[3], dtype="float32")
+            h = layers.fc(x, 2)
+            loss = layers.reduce_mean(h)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((2, 3), np.float32)}
+        before = runtime_metrics.counter("numerics.ops_probed")
+        collector = numerics.ProbeCollector()
+        with numerics.probe(collector):
+            assert numerics.probing_enabled()
+            exe.run(main, feed=feed, fetch_list=[loss.name])
+        assert not numerics.probing_enabled()
+        assert collector.ops_probed >= len(main.global_block().ops)
+        assert runtime_metrics.counter("numerics.ops_probed") == \
+            before + collector.ops_probed
+        assert collector.first_bad is None
+
+    def test_organic_nan_localizes_to_first_bad_op(self):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[4], dtype="float32")
+            h = layers.fc(x, 4)
+            shifted = layers.elementwise_sub(
+                h, layers.fill_constant([1], "float32", 1e6))
+            bad = layers.log(shifted)   # log of a negative: NaN
+            loss = layers.reduce_mean(bad)
+        exe = fluid.Executor()
+        exe.run(startup)
+        before = runtime_metrics.counter("numerics.non_finite_ops")
+        collector = numerics.ProbeCollector(trail=4)
+        with numerics.probe(collector):
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[loss.name])
+        fb = collector.first_bad
+        assert fb is not None and fb["type"] == "log"
+        # the creation site names THIS test file, not framework code
+        assert fb["creation_site"][0].endswith("test_numerics.py")
+        # inputs were still finite going in — the op itself is guilty
+        assert all(s["finite_frac"] == 1.0
+                   for s in fb["inputs"].values())
+        assert any(s["finite_frac"] < 1.0
+                   for s in fb["outputs"].values())
+        assert len(fb["trail"]) <= 4
+        assert fb["trail"][-1]["type"] == "log"
+        assert runtime_metrics.counter("numerics.non_finite_ops") == \
+            before + 1
+
+    def test_trail_is_bounded(self):
+        class _Op:
+            type = "fake"
+            input_arg_names = []
+            output_arg_names = ["o"]
+            creation_site = ("f.py", 1)
+
+        c = numerics.ProbeCollector(trail=3)
+        for i in range(10):
+            c.record_op(_Op(), {"o": None},
+                        {"o": np.zeros(2, np.float32)})
+        assert len(c.trail) == 3 and c.ops_probed == 10
+
+
+class TestCreationSiteRoundTrip:
+    def test_to_dict_from_dict_preserves_site(self):
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = layers.data("x", shape=[3], dtype="float32")
+            layers.reduce_mean(x)
+        clone = fluid.Program.from_dict(main.to_dict())
+        for op, op2 in zip(main.global_block().ops,
+                           clone.global_block().ops):
+            assert op2.creation_site == op.creation_site
+            assert op2.creation_site[0].endswith("test_numerics.py")
+
+
+class TestFusedHealth:
+    def test_fused_check_reports_finite_and_norms(self):
+        import jax.numpy as jnp
+        fn = numerics.fused_check_fn()
+        old = [jnp.zeros((2, 2), jnp.float32)]
+        new = [jnp.full((2, 2), 0.5, jnp.float32)]
+        finite, norms = fn([jnp.ones(3)], new, old)
+        assert bool(finite)
+        health = numerics.health_from_norms(np.asarray(norms))
+        assert health["param_norm"] == pytest.approx(1.0)
+        assert health["grad_norm"] == pytest.approx(1.0)
+        assert health["update_ratio"] == pytest.approx(1.0)
+
+    def test_fused_check_flags_non_finite(self):
+        import jax.numpy as jnp
+        fn = numerics.fused_check_fn()
+        finite, norms = fn([jnp.asarray([1.0, jnp.nan])], [], [])
+        assert not bool(finite)
+        assert numerics.health_from_norms(np.asarray(norms)) is None
+
+    def test_set_health_gauges(self):
+        m = RuntimeMetrics()
+        numerics.set_health_gauges(m, None)        # disabled: no-op
+        assert m.gauge("train.grad_norm") is None
+        numerics.set_health_gauges(
+            m, {"param_norm": 2.0, "grad_norm": 0.5,
+                "update_ratio": 0.25})
+        assert m.gauge("train.param_norm") == 2.0
+        assert m.gauge("train.grad_norm") == 0.5
+        assert m.gauge("train.update_ratio") == 0.25

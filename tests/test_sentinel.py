@@ -329,6 +329,76 @@ class TestEscalationLadderEndToEnd:
         assert cli.main(["replay", path]) == 0
         assert cli.main(["replay", "--json", path]) == 0
 
+    def test_localize_names_the_poisoned_op(self, drill, capsys):
+        """The localization drill: ``replay --localize`` re-executes the
+        quarantined step op-by-op with probes armed and names the EXACT
+        op the poison landed on — the loss-producing ``reduce_mean``
+        appended by :func:`build_model` in THIS file — with its creation
+        site and the input-stat trail leading into it."""
+        from paddle_tpu.obs import numerics
+        sentinel = drill["sentinel"]
+        bundles = sorted(os.listdir(sentinel.quarantine_dir))
+        path = os.path.join(sentinel.quarantine_dir, bundles[0])
+        report = numerics.localize_bundle(path)
+        assert report["localized"] and report["injected"]
+        fb = report["first_bad_op"]
+        assert fb["type"] == "reduce_mean"
+        # creation site attributes the op to user code — this test file
+        assert fb["creation_site"][0].endswith("test_sentinel.py")
+        # the op's inputs were still finite: the fault is localized to
+        # this op, not inherited from upstream
+        assert all(s.get("finite_frac") == 1.0
+                   for s in fb["inputs"].values())
+        assert any(s.get("finite_frac", 1.0) < 1.0
+                   for s in fb["outputs"].values())
+        assert fb["trail"][-1]["type"] == "reduce_mean"
+        assert report["ops_probed"] >= fb["index"] + 1
+        # CLI: exit 0 = localized; the prose names op type + site
+        assert cli.main(["replay", "--localize", path]) == 0
+        out = capsys.readouterr().out
+        assert "reduce_mean" in out and "test_sentinel.py" in out
+        assert cli.main(["replay", "--localize", "--json", path]) == 0
+
+    def test_localize_clean_and_malformed_exit_codes(self, drill,
+                                                     tmp_path):
+        """Un-injected bundles replay finite op-by-op — exit 1 (nothing
+        to localize); garbage bundles are malformed — exit 2, mirroring
+        plain replay's triage contract."""
+        sentinel = drill["sentinel"]
+        bundles = sorted(os.listdir(sentinel.quarantine_dir))
+        path = os.path.join(sentinel.quarantine_dir, bundles[0])
+        with open(path, "rb") as f:
+            bundle = pickle.load(f)
+        bundle["injected"] = False   # no op-level poison: replays clean
+        clean = str(tmp_path / "clean.pkl")
+        with open(clean, "wb") as f:
+            pickle.dump(bundle, f, protocol=4)
+        assert cli.main(["replay", "--localize", clean]) == 1
+        garbage = tmp_path / "garbage.pkl"
+        garbage.write_bytes(b"\x80\x04not a pickle")
+        assert cli.main(["replay", "--localize", str(garbage)]) == 2
+        assert cli.main(["replay", "--localize",
+                         str(tmp_path / "missing.pkl")]) == 2
+
+    def test_bundle_and_sentinel_carry_health_digest(self, drill):
+        """Guarded steps fuse param/update norms into the finite check;
+        the digest rides the sentinel (escalation context), the
+        quarantine bundle (forensics), and the train.* gauges the
+        ledger snapshots."""
+        sentinel = drill["sentinel"]
+        assert sentinel.last_health is not None
+        assert set(sentinel.last_health) == \
+            {"param_norm", "grad_norm", "update_ratio"}
+        bundles = sorted(os.listdir(sentinel.quarantine_dir))
+        path = os.path.join(sentinel.quarantine_dir, bundles[0])
+        with open(path, "rb") as f:
+            bundle = pickle.load(f)
+        assert bundle["health"] is not None
+        assert "param_norm" in bundle["health"]
+        for g in ("train.param_norm", "train.grad_norm",
+                  "train.update_ratio"):
+            assert profiler.runtime_metrics.gauge(g) is not None
+
     def test_replay_clean_bundle_exits_nonzero(self, drill, tmp_path):
         """A bundle whose step replays clean (fault not injected, math
         fine) reports no repro — exit 1, the 'suspect hardware' verdict."""
