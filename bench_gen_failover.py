@@ -1,0 +1,305 @@
+"""Resumable-session failover benchmark: the ISSUE-20 chaos drill as a
+measured artifact — N router-fronted generative replicas, M concurrent
+streams, the busiest owner hard-killed mid-decode — recording what the
+failover COSTS (time-to-next-token after the kill, whole-stream resume
+overhead versus an unkilled reference) while asserting what it may
+never cost (lost tokens, duplicated tokens, client-visible errors:
+exactly-once delivery is an invariant, not a tolerance).
+
+Device work is MODELED WITH A SLEEP — the ``gen.decode.stall``
+failpoint fires once per decode iteration, so each replica behaves like
+one device producing tokens at a fixed cadence while the GIL stays
+free (the same honest 2-vCPU cost model as bench_fleet.py).  The
+hard-kill is ``InferenceServer.abort_streams()`` — the in-process
+SIGKILL analog: every live stream on the victim fails with a retryable
+error at a token boundary, exactly what a resume-capable router sees
+when a real replica dies mid-chunk.
+
+    python bench_gen_failover.py --streams 6 --replicas 3 \
+        --out BENCH_GEN_FAILOVER.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import tempfile
+import threading
+import time
+
+
+def build_bundle(dirname, num_slots=8):
+    from paddle_tpu.models import gen_lm
+    gen_lm.export_gen_model(dirname, gen_lm.GenConfig(),
+                            num_slots=num_slots)
+    return dirname
+
+
+def _prompts(n):
+    # distinct prompts, fixed (greedy decode is deterministic, so the
+    # reference and drill runs are comparable token-for-token)
+    base = [[2, 9], [5, 3], [7, 1], [4, 4], [6, 2], [3, 8],
+            [1, 7], [8, 5], [9, 2], [2, 6]]
+    return [base[i % len(base)] + [i // len(base)] if i >= len(base)
+            else base[i] for i in range(n)]
+
+
+def _read_stream(host, port, payload, timeout=120):
+    import http.client
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.request("POST", "/generate", json.dumps(payload).encode(),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    if resp.status != 200:
+        body = json.loads(resp.read())
+        conn.close()
+        return resp.status, body, []
+    events, stamps = [], []
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        events.append(json.loads(line))
+        stamps.append(time.monotonic())
+        if events[-1].get("done"):
+            break
+    conn.close()
+    return 200, events, stamps
+
+
+def _stream_tokens(events):
+    return [(e["index"], e["token"]) for e in events if "token" in e]
+
+
+def run_streams(servers, router, prompts, max_new, kill_after=None,
+                drain_deadline_s=None):
+    """Drive one concurrent stream per prompt through the router.  With
+    ``kill_after``, hard-kill the replica owning the first stream to
+    deliver that many tokens; with ``drain_deadline_s``, bound-drain
+    that owner instead (the rolling-restart migration path).  Returns
+    per-stream results plus the drill bookkeeping."""
+    results = [None] * len(prompts)
+
+    def consume(i):
+        results[i] = _read_stream(
+            router.addr[0], router.addr[1],
+            {"prompt": prompts[i], "max_new_tokens": max_new})
+
+    threads = [threading.Thread(target=consume, args=(i,))
+               for i in range(len(prompts))]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    t_kill = None
+    victim = None
+    if kill_after is not None or drain_deadline_s is not None:
+        trigger_at = kill_after if kill_after is not None else 2
+        deadline = time.monotonic() + 60
+        owner = None
+        while time.monotonic() < deadline:
+            snap = router.sessions.snapshot()
+            ready = [s for s in snap["sessions"]
+                     if s["delivered"] >= trigger_at]
+            if ready:
+                owner = ready[0]["replica"]
+                break
+            time.sleep(0.005)
+        if owner is None:
+            raise RuntimeError("no stream reached the kill point")
+        victim = next(
+            s for s in servers
+            if f"{s.addr[0]}:{s.addr[1]}" == owner)
+        t_kill = time.monotonic()
+        if kill_after is not None:
+            victim.abort_streams()
+        else:
+            victim.drain_sessions(deadline_s=drain_deadline_s)
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t_start
+    return {"results": results, "elapsed_sec": elapsed,
+            "t_kill": t_kill, "victim": victim}
+
+
+def _audit(run, prompts, max_new):
+    """Exactly-once audit: per-stream index coverage against the full
+    expected range — anything missing is LOST, anything repeated is
+    DUPLICATED, anything non-200 / error-tailed is a client error."""
+    lost = dup = errors = 0
+    token_seqs = []
+    for status, events, _ in run["results"]:
+        if status != 200:
+            errors += 1
+            token_seqs.append(None)
+            continue
+        if any(e.get("error") for e in events) or \
+                not any(e.get("done") for e in events):
+            errors += 1
+        pairs = _stream_tokens(events)
+        idxs = [i for i, _ in pairs]
+        dup += len(idxs) - len(set(idxs))
+        lost += len(set(range(max_new)) - set(idxs))
+        token_seqs.append([t for _, t in sorted(set(pairs))])
+    return lost, dup, errors, token_seqs
+
+
+def _ttft_after_kill(run):
+    """Worst time-to-next-token across streams measured from the kill:
+    the resumed stream pays re-route + full re-prefill here, so the max
+    is the failover's client-visible token gap."""
+    t_kill = run["t_kill"]
+    worst = 0.0
+    for status, events, stamps in run["results"]:
+        if status != 200:
+            continue
+        after = [s for s, e in zip(stamps, events)
+                 if s > t_kill and "token" in e]
+        if after and any(s <= t_kill for s in stamps):
+            worst = max(worst, after[0] - t_kill)
+    return worst * 1e3
+
+
+def run_bench(streams=6, replicas=3, max_new=12, stall_ms=30.0,
+              kill_after=3, bundle_dir=None):
+    from paddle_tpu import profiler
+    from paddle_tpu.fault import chaos
+    from paddle_tpu.fleet import FleetRouter
+    from paddle_tpu.serving import InferenceServer
+
+    if bundle_dir is None:
+        bundle_dir = build_bundle(
+            tempfile.mkdtemp(prefix="ptgenfo_") + "/bundle")
+    profiler.runtime_metrics.reset()
+    chaos.clear()
+    prompts = _prompts(streams)
+
+    def fleet():
+        srvs = []
+        for _ in range(replicas):
+            s = InferenceServer(bundle_dir, port=0, warmup=True,
+                                request_timeout=60.0)
+            s.start_background()
+            srvs.append(s)
+        for s in srvs:
+            assert s.wait_until_ready(300)
+        r = FleetRouter(
+            replicas=[f"{s.addr[0]}:{s.addr[1]}" for s in srvs])
+        r.start_background()
+        return srvs, r
+
+    def teardown(srvs, r):
+        r.shutdown()
+        for s in srvs:
+            s.shutdown()
+
+    chaos.inject("gen.decode.stall", delay=stall_ms / 1000.0)
+    try:
+        # -- unkilled reference: the token-identity oracle and the
+        # overhead denominator
+        srvs, router = fleet()
+        try:
+            ref = run_streams(srvs, router, prompts, max_new)
+        finally:
+            teardown(srvs, router)
+        ref_lost, ref_dup, ref_errors, ref_tokens = _audit(
+            ref, prompts, max_new)
+
+        # -- kill drill: busiest owner hard-killed mid-decode
+        srvs, router = fleet()
+        resumes0 = profiler.runtime_metrics.counter(
+            "gen.session.resumes")
+        spliced0 = profiler.runtime_metrics.counter(
+            "gen.session.spliced_tokens")
+        try:
+            kill = run_streams(srvs, router, prompts, max_new,
+                               kill_after=kill_after)
+        finally:
+            teardown(srvs, router)
+        lost, dup, errors, kill_tokens = _audit(kill, prompts, max_new)
+
+        # -- drain drill: the same fleet topology, the owner
+        # bound-drained instead (rolling-restart migration)
+        srvs, router = fleet()
+        migrations0 = profiler.runtime_metrics.counter(
+            "gen.session.migrations")
+        try:
+            drain = run_streams(srvs, router, prompts, max_new,
+                                drain_deadline_s=0.05)
+        finally:
+            teardown(srvs, router)
+        d_lost, d_dup, d_errors, drain_tokens = _audit(
+            drain, prompts, max_new)
+    finally:
+        chaos.clear()
+
+    return {
+        "streams": streams,
+        "replicas": replicas,
+        "max_new_tokens": max_new,
+        "stall_ms": stall_ms,
+        "reference": {
+            "elapsed_sec": ref["elapsed_sec"],
+            "lost_tokens": ref_lost,
+            "dup_tokens": ref_dup,
+            "client_errors": ref_errors,
+        },
+        "kill_drill": {
+            "elapsed_sec": kill["elapsed_sec"],
+            "killed_replica":
+                f"{kill['victim'].addr[0]}:{kill['victim'].addr[1]}",
+            "ttft_after_failover_ms": _ttft_after_kill(kill),
+            "lost_tokens": lost,
+            "dup_tokens": dup,
+            "client_errors": errors,
+            "token_identical": kill_tokens == ref_tokens,
+            "resumes": profiler.runtime_metrics.counter(
+                "gen.session.resumes") - resumes0,
+            "spliced_tokens": profiler.runtime_metrics.counter(
+                "gen.session.spliced_tokens") - spliced0,
+        },
+        "drain_drill": {
+            "elapsed_sec": drain["elapsed_sec"],
+            "lost_tokens": d_lost,
+            "dup_tokens": d_dup,
+            "client_errors": d_errors,
+            "token_identical": drain_tokens == ref_tokens,
+            "migrations": profiler.runtime_metrics.counter(
+                "gen.session.migrations") - migrations0,
+        },
+        "resume_overhead_ratio":
+            kill["elapsed_sec"] / ref["elapsed_sec"]
+            if ref["elapsed_sec"] else None,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=6)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--max-new", type=int, default=12)
+    ap.add_argument("--stall-ms", type=float, default=30.0)
+    ap.add_argument("--kill-after", type=int, default=3)
+    ap.add_argument("--out", default=None, help="write the JSON summary")
+    from paddle_tpu.obs import bench_history
+    bench_history.add_record_args(ap)
+    args = ap.parse_args(argv)
+    summary = run_bench(streams=args.streams, replicas=args.replicas,
+                        max_new=args.max_new, stall_ms=args.stall_ms,
+                        kill_after=args.kill_after)
+    text = json.dumps(summary, indent=2, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    bench_history.record_from_args("gen_failover", summary, args,
+                                   "bench_gen_failover.py")
+    ok = (summary["kill_drill"]["lost_tokens"] == 0
+          and summary["kill_drill"]["dup_tokens"] == 0
+          and summary["kill_drill"]["client_errors"] == 0
+          and summary["kill_drill"]["token_identical"]
+          and summary["drain_drill"]["client_errors"] == 0)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -634,6 +634,89 @@ def _check_ledger():
                     "cursor round-trip", failures)
 
 
+def _check_sessions():
+    """Resumable-session gate: the resume-event schema round-trips every
+    documented wire shape (token events, clean/legacy/new terminal
+    tails, migrate hand-backs) through JSON and the validators, a
+    malformed event fails, drain checkpoints validate, and the bounded
+    session table keeps its eviction invariants (capacity ceiling,
+    orphan accounting, eviction-on-done) — protocol drift fails a
+    release gate, not a production failover."""
+    import json as _json
+
+    from paddle_tpu.fleet import sessions
+
+    failures = []
+    good_events = [
+        {"token": 7, "index": 0},
+        {"token": 3, "index": 41},
+        {"done": True, "finish_reason": "eos", "tokens": 5,
+         "token_index": 5},
+        # legacy error tail: no token_index / retryable — must parse
+        {"error": {"type": "upstream_died", "message": "x"},
+         "done": True},
+        # new error tail: token_index high-water mark + retryable flag
+        {"error": {"type": "batcher_crashed", "message": "x"},
+         "done": True, "token_index": 9, "retryable": True},
+        {"migrate": {"resume_from": 4, "remaining_tokens": 12},
+         "done": True, "token_index": 4, "retryable": True},
+    ]
+    for ev in good_events:
+        round_tripped = _json.loads(_json.dumps(ev))
+        problems = sessions.validate_stream_event(round_tripped)
+        if problems:
+            failures.append(f"valid event {ev} rejected: {problems}")
+    bad_events = [
+        {"token": 7},                                   # no index
+        {"token": 7, "index": -1},
+        {"token": 7, "index": 0, "done": True},         # token+terminal
+        {"done": True},                                 # no kind
+        {"done": True, "finish_reason": "eos",
+         "error": {"type": "x"}},                       # two kinds
+        {"migrate": {"resume_from": 4}, "done": True},  # not retryable
+        {"error": "boom", "done": True},                # error not dict
+    ]
+    for ev in bad_events:
+        if not sessions.validate_stream_event(ev):
+            failures.append(f"invalid event {ev} accepted")
+    ckpt = {"prompt": [1, 2, 3], "tokens": [4, 5],
+            "remaining_tokens": 7, "eos_id": None, "reason": "draining"}
+    problems = sessions.validate_checkpoint(
+        _json.loads(_json.dumps(ckpt)))
+    if problems:
+        failures.append(f"valid checkpoint rejected: {problems}")
+    if not sessions.validate_checkpoint({"prompt": [],
+                                         "tokens": [],
+                                         "remaining_tokens": -1,
+                                         "reason": ""}):
+        failures.append("invalid checkpoint accepted")
+    # table invariants: bounded, LRU eviction counts unfinished
+    # sessions as orphaned, finish() evicts
+    table = sessions.SessionTable(capacity=4)
+    for i in range(7):
+        table.begin(f"s{i}", "127.0.0.1:1", [1, 2], 8)
+    if len(table) > 4:
+        failures.append(f"capacity 4 table holds {len(table)}")
+    if table.orphaned != 3:
+        failures.append(f"7 begins over capacity 4 orphaned "
+                        f"{table.orphaned}, want 3")
+    if table.owner("s6") != "127.0.0.1:1":
+        failures.append("youngest session evicted before the LRU one")
+    table.finish("s6")
+    if table.owner("s6") is not None or len(table) != 3:
+        failures.append("finish() did not evict the session")
+    if table.finish("s6") is not None:
+        failures.append("finish() of an unknown session returned "
+                        "an entry")
+    snap = table.snapshot()
+    if snap["count"] != 3 or snap["orphaned"] != 3 or \
+            len(snap["sessions"]) != 3:
+        failures.append(f"snapshot out of step with the table: {snap}")
+    return _section("sessions",
+                    "resume-event/checkpoint schema round-trip, "
+                    "session-table eviction invariants", failures)
+
+
 def _check_bench_trajectory():
     """``bench check --dry`` against the repo's BENCH_TRAJECTORY.json:
     a drifted or malformed trajectory schema fails the static gate (the
@@ -664,6 +747,7 @@ def run_selfcheck():
         _check_controller_policy(),
         _check_opt(),
         _check_ledger(),
+        _check_sessions(),
         _check_bench_trajectory(),
         _check_ckpt_manifest(),
         _check_perf(),

@@ -239,7 +239,10 @@ def _cmd_serve(args):
         # routing), finish in-flight, release the lease, exit 0
         with GracefulShutdown() as stop:
             stop.wait()
-        replica.drain()
+        migrated = replica.drain(deadline_s=args.drain_deadline_s)
+        if migrated:
+            print(f"drained: {len(migrated)} active session(s) "
+                  f"checkpoint-migrated to survivors", flush=True)
         return 0
     serve(args.model, host=args.host, port=args.port, **server_kwargs)
     return 0
@@ -256,7 +259,9 @@ def _cmd_generate(args):
     tokens = []
     for ev in client.generate(prompt, max_new_tokens=args.max_new,
                               eos_id=args.eos_id,
-                              stream=not args.no_stream):
+                              stream=not args.no_stream,
+                              session_id=args.session_id,
+                              resume=not args.no_resume):
         if "token" in ev:
             tokens.append(ev["token"])
             print(ev["token"], flush=True)
@@ -1353,6 +1358,11 @@ def main(argv=None):
     p.add_argument("--lease-ttl", type=float, default=5.0,
                    help="fleet lease TTL seconds; missing renews this "
                         "long drops the replica from routing")
+    p.add_argument("--drain-deadline-s", type=float, default=30.0,
+                   help="rolling-restart drain bound: seconds in-flight "
+                        "generative streams may run to completion "
+                        "before the rest are checkpoint-migrated to "
+                        "survivors")
     p.add_argument("--advertise-host", default=None,
                    help="host other machines should dial (default: the "
                         "bind host)")
@@ -1383,6 +1393,12 @@ def main(argv=None):
                         "X-Deadline-Ms)")
     p.add_argument("--no-stream", action="store_true",
                    help="buffered reply instead of chunked streaming")
+    p.add_argument("--session-id", default=None,
+                   help="resumable-session id (default: minted per "
+                        "request; reuse one to resume after a failure)")
+    p.add_argument("--no-resume", action="store_true",
+                   help="disable mid-stream resume: a dead replica "
+                        "surfaces as a terminal error event instead")
     p.set_defaults(fn=_cmd_generate)
 
     p = sub.add_parser("router", help="health-aware fleet router over "

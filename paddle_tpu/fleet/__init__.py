@@ -39,7 +39,11 @@ from paddle_tpu.fleet.controller import ControllerPolicy, \
     FleetController, load_policy
 from paddle_tpu.fleet.replica import FleetReplica
 from paddle_tpu.fleet.router import FleetRouter
+from paddle_tpu.fleet.sessions import SessionTable, new_session_id, \
+    validate_checkpoint, validate_stream_event
 from paddle_tpu.fleet.traffic import TrafficReplay
 
 __all__ = ["FleetReplica", "FleetRouter", "FleetController",
-           "ControllerPolicy", "load_policy", "TrafficReplay"]
+           "ControllerPolicy", "load_policy", "SessionTable",
+           "TrafficReplay", "new_session_id", "validate_checkpoint",
+           "validate_stream_event"]

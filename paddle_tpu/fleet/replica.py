@@ -220,12 +220,20 @@ class FleetReplica:
                     pass  # master still down: retry next beat
 
     # -- exits -------------------------------------------------------------
-    def drain(self):
+    def drain(self, deadline_s=30.0):
         """Rolling-restart drain: deregister (the router stops routing
-        new requests), stop heartbeats, then shut the server down — stop
+        new requests), stop heartbeats, checkpoint-migrate any active
+        generative sessions, then shut the server down — stop
         accepting, finish in-flight, release resources.  The lease is
         released *before* the listener closes, so the fleet's ready
-        count drops by exactly one with no refused-connection window."""
+        count drops by exactly one with no refused-connection window.
+
+        ``deadline_s`` bounds how long in-flight generative streams may
+        run to natural completion; on expiry the remaining sessions are
+        checkpointed at a token boundary and handed back (as ``migrate``
+        tails on their still-open streams) for re-placement on a
+        survivor, instead of being awaited forever.  Returns the list
+        of migrated session checkpoints (empty for non-gen bundles)."""
         self._stop.set()
         with self._lease_lock:
             # under the lock: an in-flight rejoin either registered
@@ -236,8 +244,17 @@ class FleetReplica:
             except Exception:
                 pass  # master gone: the lease TTL expires it anyway
             self.server.lease_state = None
+        # checkpoint BEFORE the listener closes: migrate tails must
+        # flush on the streams' still-open connections
+        checkpoints = []
+        try:
+            checkpoints = self.server.drain_sessions(deadline_s)
+        except Exception:
+            logger.exception("replica %s: session drain failed",
+                             self.replica_id)
         self.server.shutdown()
         self._master.close()
+        return checkpoints
 
     def kill(self):
         """In-process hard-kill: stop heartbeats and close the listener
@@ -248,6 +265,14 @@ class FleetReplica:
         ``fleet.replica.kill=kill`` for the real thing)."""
         self.killed = True
         self._stop.set()
+        try:
+            # sever active generative streams too: closing the listener
+            # alone leaves handler threads decoding — a real SIGKILL
+            # kills them, so the in-process analog must as well (their
+            # clients see a retryable error tail and resume elsewhere)
+            self.server.abort_streams()
+        except Exception:
+            pass
         try:
             self.server._server.shutdown()
         except Exception:

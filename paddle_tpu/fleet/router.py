@@ -27,9 +27,25 @@ watchdog (``slo_spec=`` / ``PADDLE_TPU_SLO``) evaluates declarative
 objectives over the runtime metrics in a background thread, surfacing
 its breach log under ``/stats``.
 
+Streamed ``/generate`` traffic is *session-aware*: each request mints
+(or carries) a session id tracked in a bounded
+:class:`~paddle_tpu.fleet.sessions.SessionTable` — owning replica,
+prompt hash, tokens delivered — so follow-ups and resumes route back
+to the owner (affine routing), and when the owner dies mid-stream the
+router re-prefills ``prompt + tokens_so_far`` on a survivor with a
+``resume_from`` index and splices the continuation into the SAME
+client response, deduplicating on the monotone ``token_index`` every
+event carries (greedy decode is deterministic, so the splice is
+token-identical and exactly-once).
+
 Failpoints: ``fleet.route.blackhole`` fires per forward attempt (armed
 ``error`` turns the attempt into a connection failure — the drill for a
-partitioned replica the lease hasn't expired yet).
+partitioned replica the lease hasn't expired yet);
+``gen.session.kill_owner`` fires per relayed token (armed ``error``
+simulates the owning replica dying after producing that token — the
+mid-stream failover drill); ``gen.stream.truncate`` fires per upstream
+stream read (armed ``error`` tears the stream mid-chunk — the torn
+transport drill).
 """
 
 from __future__ import annotations
@@ -79,10 +95,12 @@ class FleetRouter:
     def __init__(self, master_addr=None, replicas=None, host="127.0.0.1",
                  port=0, retry=None, poll_interval=0.25,
                  default_deadline=30.0, attempt_timeout=30.0,
-                 down_cooldown=1.0, slo_spec=None, scrape_timeout=2.0):
+                 down_cooldown=1.0, slo_spec=None, scrape_timeout=2.0,
+                 session_capacity=1024):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         from paddle_tpu.fault.retry import RetryPolicy, parse_hostport
+        from paddle_tpu.fleet.sessions import SessionTable
         if master_addr is None and not replicas:
             raise ValueError("FleetRouter needs master_addr or replicas")
         self._master_addr = master_addr
@@ -109,6 +127,9 @@ class FleetRouter:
         # last N failovers: (request_id, failed addrs..., served-by) —
         # the drill's evidence that a specific request changed replicas
         self.failover_log = collections.deque(maxlen=256)
+        # live generative sessions: affine routing + mid-stream resume
+        # state (evicted on terminal delivery; bounded, orphan-counting)
+        self.sessions = SessionTable(capacity=session_capacity)
         # fleet observability plane: federation scraper over the
         # routing table (obs.aggregate) + optional SLO watchdog
         # (obs.slo; explicit spec wins over PADDLE_TPU_SLO)
@@ -193,6 +214,7 @@ class FleetRouter:
                         "failovers": [list(f) for f in
                                       router.failover_log],
                         "admission": router.admission_state(),
+                        "sessions": router.sessions.snapshot(),
                     }
                     # per-replica MFU / HBM headroom from the latest
                     # federation pass (empty before the first
@@ -349,13 +371,18 @@ class FleetRouter:
                         "down": e["down_until"] > now}
                     for a, e in self._table.items()}
 
-    def _pick(self, tried):
+    def _pick(self, tried, prefer=None):
         """Least-outstanding live replica, preferring one not yet tried
         by THIS request; falls back to tried replicas only when every
         live one has failed this chain (single-replica fleets still
-        retry)."""
+        retry).  ``prefer`` (a session's owning replica — affine
+        routing) wins outright while it is live and not yet tried."""
         now = time.monotonic()
         with self._lock:
+            if prefer is not None and prefer not in tried:
+                e = self._table.get(prefer)
+                if e is not None and e["down_until"] <= now:
+                    return prefer
             live = [(e["outstanding"], a) for a, e in self._table.items()
                     if e["down_until"] <= now]
             if not live:
@@ -607,14 +634,43 @@ class FleetRouter:
         Failover semantics: retryable failures BEFORE the first
         forwarded byte (connection failure, retryable 503/504, upstream
         dying without producing a chunk) fail over to a sibling replica
-        exactly like :meth:`route`; once a chunk has been forwarded the
-        stream cannot be replayed — an upstream death then terminates
-        the relay with a structured trailing error line instead."""
+        exactly like :meth:`route`.  MID-stream, the request is a
+        tracked *session*: when the owning replica dies (or hands the
+        stream back with a drain-time ``migrate`` tail), the router
+        re-submits ``prompt + tokens_delivered`` with a ``resume_from``
+        index to a survivor and splices the deterministic continuation
+        into the same client response — the caller sees one
+        uninterrupted, duplicate-free token sequence.  Only when every
+        resume attempt fails does the relay terminate with a structured
+        trailing error line (now carrying ``token_index`` +
+        ``retryable``)."""
         from paddle_tpu import profiler as _profiler
         from paddle_tpu.fault.retry import RetryError
+        from paddle_tpu.fleet import sessions as _sessions
         deadline_at = time.monotonic() + budget
         tried = []
         t0 = time.perf_counter()
+        # parse once for the session registry; malformed bodies forward
+        # verbatim (the replica owns request validation and the 400)
+        sess = None
+        try:
+            req = json.loads(raw)
+            prompt = [int(t) for t in req.get("prompt") or []]
+            if prompt:
+                sid = str(req.get("session_id")
+                          or _sessions.new_session_id())
+                sess = {"sid": sid, "prompt": prompt,
+                        "max_new": int(req.get("max_new_tokens", 16)),
+                        "eos_id": req.get("eos_id"),
+                        "stream": bool(req.get("stream", True)),
+                        "resume_from0": int(req.get("resume_from", 0)
+                                            or 0),
+                        "tokens": [], "sent_headers": False,
+                        "resumed": False}
+                self.sessions.begin(sid, None, prompt, sess["max_new"],
+                                    delivered=sess["resume_from0"])
+        except (AttributeError, TypeError, ValueError):
+            sess = None
 
         def attempt():
             remaining = deadline_at - time.monotonic()
@@ -622,12 +678,19 @@ class FleetRouter:
                 raise _DeadlineExhausted(
                     f"deadline ({budget * 1e3:.0f}ms) exhausted after "
                     f"{len(tried)} attempt(s)")
-            addr = self._pick(tried)
+            # affine routing: a follow-up/resume for a known session
+            # goes back to the owning replica when it is still live
+            prefer = (self.sessions.owner(sess["sid"])
+                      if sess is not None else None)
+            addr = self._pick(tried, prefer=prefer)
             tried.append(addr)
+            if sess is not None:
+                self.sessions.note(sess["sid"], replica=addr)
             with _span("fleet.attempt", replica=addr,
                        attempt=len(tried)):
                 return self._forward_stream(addr, handler, raw,
-                                            request_id, remaining)
+                                            request_id, remaining,
+                                            sess=sess)
 
         def on_retry(attempt_no, exc, delay):
             _profiler.runtime_metrics.inc("fleet.retries")
@@ -638,6 +701,8 @@ class FleetRouter:
                           path="/generate"):
                 outcome = self._retry.call(attempt, on_retry=on_retry,
                                            deadline=budget)
+            if sess is not None and outcome == "passthrough":
+                self.sessions.finish(sess["sid"])
             if outcome == "ok":
                 # only CLEAN completions count: a relay terminated by a
                 # mid-stream upstream death delivered an error tail,
@@ -648,7 +713,9 @@ class FleetRouter:
                     self.failover_log.append((request_id, *tried))
             return
         except _StreamAborted:
-            # downstream client hung up mid-stream: nothing to reply to
+            # downstream client hung up mid-stream: nothing to reply
+            # to (the session entry stays until the orphan eviction —
+            # a reconnecting client may still resume it)
             handler.close_connection = True
             return
         except _DeadlineExhausted as e:
@@ -676,23 +743,55 @@ class FleetRouter:
         finally:
             _profiler.runtime_metrics.observe(
                 "fleet.request_seconds", time.perf_counter() - t0)
+        if sess is not None and sess["sent_headers"]:
+            # the 200 + chunked headers are already downstream: the
+            # terminal failure must ride the stream as an error TAIL,
+            # not a second status line
+            try:
+                err = json.loads(body).get("error") or {}
+            except ValueError:
+                err = {}
+            self._finish_stream(
+                handler,
+                error=err.get("message", "stream failed"),
+                etype=err.get("type", "upstream_died"),
+                token_index=sess["resume_from0"] + len(sess["tokens"]),
+                retryable=True)
+            self.sessions.finish(sess["sid"])
+            return
+        if sess is not None:
+            self.sessions.finish(sess["sid"])
         handler._reply_raw(code, body, ctype, headers)
 
-    def _forward_stream(self, addr, handler, raw, request_id, remaining):
+    def _forward_stream(self, addr, handler, raw, request_id, remaining,
+                        sess=None):
         """One streamed attempt; returns ``"ok"`` when the relay ran to
-        clean completion, ``"upstream_died"`` when it was terminated by
-        a structured error tail, ``"passthrough"`` when the upstream
-        reply was passed through verbatim (permanent error).  Raises
-        retryable errors only while NOTHING has been forwarded
-        downstream yet."""
+        clean completion, ``"upstream_error"`` when it relayed a
+        terminal error tail, ``"upstream_died"`` when a SESSION-less
+        relay was terminated mid-stream, ``"passthrough"`` when the
+        upstream reply was passed through verbatim (permanent error).
+
+        With session state (``sess``), a mid-stream owner death, a
+        retryable error tail, or a drain-time ``migrate`` tail raises
+        ``_Transient`` INSTEAD of terminating the relay: the retry
+        policy re-enters this method with ``sess["resumed"]`` set, the
+        request body is rebuilt as ``prompt + tokens_delivered`` with a
+        ``resume_from`` index, and the survivor's continuation is
+        spliced into the SAME downstream chunked response — exactly-once
+        token delivery across replica death, keyed on the monotone
+        ``token_index``."""
         import http.client
 
+        from paddle_tpu import profiler as _profiler
         from paddle_tpu.fault import chaos
         try:
             chaos.fire("fleet.route.blackhole", replica=addr)
         except chaos.FaultInjected as e:
             self._mark_down(addr)
             raise _Transient(f"route to {addr} blackholed") from e
+        sid = sess["sid"] if sess is not None else None
+        resumed = bool(sess and sess["resumed"])
+        body = raw if sess is None else self._resume_body(sess, raw)
         with self._lock:
             entry = self._table.get(addr)
             if entry is not None:
@@ -704,11 +803,35 @@ class FleetRouter:
             "X-Request-Id": request_id,
             "X-Deadline-Ms": str(int(remaining * 1000)),
         }
+
+        def resume_or_die(msg, mark_down=True, cause=None):
+            # one mid-stream fault, one decision: sessions fail over
+            # (the policy re-enters with a rebuilt resume body);
+            # session-less relays terminate with a legacy error tail
+            self._drop_conn(addr)
+            if mark_down:
+                self._mark_down(addr)
+            if sess is not None:
+                if sess["sent_headers"] and \
+                        len(sess["tokens"]) >= sess["max_new"]:
+                    # every budgeted token is already delivered — only
+                    # the done tail was lost: synthesize it, no resume
+                    self._synthesize_done(handler, sess)
+                    return "ok"
+                sess["resumed"] = True
+                _profiler.runtime_metrics.inc("gen.session.resumes")
+                raise _Transient(
+                    f"session {sid}: {msg} — resuming from token "
+                    f"{sess['resume_from0'] + len(sess['tokens'])}"
+                ) from cause
+            self._finish_stream(handler, error=msg)
+            return "upstream_died"
+
         try:
             for retry_fresh in (False, True):
                 reused, conn = self._pooled_conn(addr, timeout)
                 try:
-                    conn.request("POST", "/generate", raw, headers)
+                    conn.request("POST", "/generate", body, headers)
                     resp = conn.getresponse()
                     break
                 except (OSError, http.client.HTTPException) as e:
@@ -719,23 +842,24 @@ class FleetRouter:
                     raise ConnectionError(
                         f"replica {addr} unreachable: {e}") from e
             if resp.status != 200:
-                body = resp.read()
+                rbody = resp.read()
                 from paddle_tpu.fault.retry import parse_retry_after
                 hint_raw = resp.getheader("Retry-After")
                 if resp.will_close:
                     self._drop_conn(addr)
                 try:
-                    parsed = json.loads(body)
+                    parsed = json.loads(rbody)
                 except ValueError:
                     parsed = {"retryable":
                               resp.status in (429, 502, 503, 504)}
                 if parsed.get("retryable"):
                     if resp.status == 429 and \
-                            not self._alternative_with_headroom(addr):
+                            not self._alternative_with_headroom(addr) \
+                            and not (sess and sess["sent_headers"]):
                         # no sibling with scraped headroom: the 429 +
                         # Retry-After passes through verbatim
                         handler._reply_raw(
-                            resp.status, body, "application/json",
+                            resp.status, rbody, "application/json",
                             {"Retry-After": hint_raw} if hint_raw
                             else None)
                         return "passthrough"
@@ -748,7 +872,23 @@ class FleetRouter:
                     if hint is not None:
                         exc.retry_after = hint
                     raise exc
-                handler._reply_raw(resp.status, body, "application/json")
+                if sess is not None and sess["sent_headers"]:
+                    # a resume attempt hit a PERMANENT error (e.g.
+                    # resume_unsupported) after the 200 went downstream:
+                    # terminate the stream with a non-retryable tail
+                    err = parsed.get("error") or {}
+                    self._finish_stream(
+                        handler,
+                        error=err.get("message",
+                                      f"upstream replied {resp.status}"),
+                        etype=err.get("type", "upstream_error"),
+                        token_index=(sess["resume_from0"]
+                                     + len(sess["tokens"])),
+                        retryable=False)
+                    self.sessions.finish(sid)
+                    return "upstream_error"
+                handler._reply_raw(resp.status, rbody,
+                                   "application/json")
                 return "passthrough"
             # the replica holds its 200 until the first token exists,
             # so the first line is imminent; reading it BEFORE sending
@@ -766,57 +906,133 @@ class FleetRouter:
                 raise _Transient(
                     f"replica {addr} closed the stream before the "
                     f"first chunk")
-            try:
-                handler.send_response(200)
-                handler.send_header(
-                    "Content-Type",
-                    resp.getheader("Content-Type",
-                                   "application/x-ndjson"))
-                handler.send_header("Transfer-Encoding", "chunked")
-                if request_id:
-                    handler.send_header("X-Request-Id", request_id)
-                handler.end_headers()
-                self._relay_chunk(handler, first)
-            except OSError as e:
-                self._drop_conn(addr)
-                raise _StreamAborted(str(e)) from e
-            last = first
+            ctype = resp.getheader("Content-Type",
+                                   "application/x-ndjson")
+            if sess is None:
+                return self._relay_stream_verbatim(
+                    addr, handler, request_id, resp, first, ctype)
+            # session-aware relay: parse each upstream line, dedupe on
+            # token_index, convert resumable faults into failover
+            terminal = None
+            line = first
             while True:
                 try:
+                    obj = json.loads(line)
+                except ValueError:
+                    obj = None
+                if obj is not None and "token" in obj \
+                        and "index" in obj:
+                    delivered = (sess["resume_from0"]
+                                 + len(sess["tokens"]))
+                    idx = obj["index"]
+                    if idx < delivered:
+                        # replayed prefix after a resume: exactly-once
+                        # delivery is THIS drop
+                        _profiler.runtime_metrics.inc(
+                            "gen.session.dedup_drops")
+                    elif idx > delivered:
+                        return resume_or_die(
+                            f"token_index gap (got {idx}, expected "
+                            f"{delivered})", mark_down=False)
+                    else:
+                        try:
+                            chaos.fire("gen.session.kill_owner",
+                                       replica=addr, session=sid)
+                        except chaos.FaultInjected as e:
+                            # the drill: the owner dies after producing
+                            # this token but before the relay — it is
+                            # lost upstream and a survivor must
+                            # regenerate it
+                            return resume_or_die(
+                                f"owner {addr} killed (fault "
+                                f"injection)", cause=e)
+                        try:
+                            self._ensure_stream_headers(
+                                handler, sess, request_id, ctype)
+                            self._relay_chunk(handler, line)
+                        except OSError as e:
+                            self._drop_conn(addr)
+                            raise _StreamAborted(str(e)) from e
+                        sess["tokens"].append(int(obj["token"]))
+                        if resumed:
+                            _profiler.runtime_metrics.inc(
+                                "gen.session.spliced_tokens")
+                        self.sessions.note(sid, delivered=delivered + 1)
+                elif obj is not None and obj.get("done") \
+                        and "migrate" in obj:
+                    # drain-time hand-back: the owner checkpointed the
+                    # stream at a token boundary — re-place it on a
+                    # survivor (the owner is NOT down, just leaving)
+                    return resume_or_die(
+                        f"owner {addr} draining (migrate tail at "
+                        f"token {obj['migrate'].get('resume_from')})",
+                        mark_down=False)
+                elif obj is not None and obj.get("done") \
+                        and obj.get("error") is not None \
+                        and obj.get("retryable"):
+                    # the replica ended the stream with a RETRYABLE
+                    # failure tail (scheduler abort, stall): resume
+                    # on a sibling instead of surfacing it
+                    return resume_or_die(
+                        f"retryable upstream error tail "
+                        f"({(obj.get('error') or {}).get('type')})",
+                        mark_down=False)
+                elif obj is not None and obj.get("done"):
+                    # clean finish or non-retryable error: relay the
+                    # tail verbatim and evict the session
+                    try:
+                        self._ensure_stream_headers(
+                            handler, sess, request_id, ctype)
+                        self._relay_chunk(handler, line)
+                    except OSError as e:
+                        self._drop_conn(addr)
+                        raise _StreamAborted(str(e)) from e
+                    terminal = ("upstream_error" if obj.get("error")
+                                else "ok")
+                    self.sessions.finish(sid)
+                else:
+                    # unparseable / unknown event shape: relay verbatim
+                    try:
+                        self._ensure_stream_headers(
+                            handler, sess, request_id, ctype)
+                        self._relay_chunk(handler, line)
+                    except OSError as e:
+                        self._drop_conn(addr)
+                        raise _StreamAborted(str(e)) from e
+                try:
+                    chaos.fire("gen.stream.truncate", replica=addr,
+                               session=sid)
                     line = resp.readline()
+                except chaos.FaultInjected as e:
+                    if terminal is not None:
+                        self._drop_conn(addr)
+                        line = b""
+                    else:
+                        return resume_or_die(
+                            "stream truncated (fault injection)",
+                            mark_down=False, cause=e)
                 except (OSError, http.client.HTTPException) as e:
-                    # upstream died MID-stream: the request cannot be
-                    # replayed (tokens already delivered) — terminate
-                    # with a structured error line the client can parse
-                    self._drop_conn(addr)
-                    self._mark_down(addr)
-                    self._finish_stream(handler, error=(
-                        f"replica {addr} died mid-stream: {e}"))
-                    return "upstream_died"
+                    if terminal is not None:
+                        self._drop_conn(addr)
+                        line = b""
+                    else:
+                        return resume_or_die(
+                            f"owner {addr} died mid-stream: {e}",
+                            cause=e)
                 if not line:
                     break
-                last = line
-                try:
-                    self._relay_chunk(handler, line)
-                except OSError as e:
-                    self._drop_conn(addr)
-                    raise _StreamAborted(str(e)) from e
+            if terminal is None:
+                # EOF without a terminal tail: the owner closed the
+                # stream mid-decode (hard kill between chunks)
+                return resume_or_die(
+                    f"owner {addr} closed the stream without a "
+                    f"terminal event")
             try:
                 handler.wfile.write(b"0\r\n\r\n")
                 handler.wfile.flush()
             except OSError as e:
                 raise _StreamAborted(str(e)) from e
-            # a replica-side failure (scheduler crash, stall) ends the
-            # stream CLEANLY with an {"error": ..., "done": true} tail
-            # — one JSON parse of the final line keeps that out of the
-            # success metrics without re-encoding the relayed body
-            if b'"error"' in last:
-                try:
-                    if json.loads(last).get("error"):
-                        return "upstream_error"
-                except ValueError:
-                    pass
-            return "ok"
+            return terminal
         finally:
             with self._lock:
                 entry = self._table.get(addr)
@@ -824,16 +1040,133 @@ class FleetRouter:
                     entry["outstanding"] = max(
                         0, entry["outstanding"] - 1)
 
+    def _relay_stream_verbatim(self, addr, handler, request_id, resp,
+                               first, ctype):
+        """The session-less relay (body did not parse as a generate
+        request): chunks pass through verbatim, a mid-stream upstream
+        death terminates with a legacy error tail — no resume."""
+        import http.client
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", ctype)
+            handler.send_header("Transfer-Encoding", "chunked")
+            if request_id:
+                handler.send_header("X-Request-Id", request_id)
+            handler.end_headers()
+            self._relay_chunk(handler, first)
+        except OSError as e:
+            self._drop_conn(addr)
+            raise _StreamAborted(str(e)) from e
+        last = first
+        while True:
+            try:
+                line = resp.readline()
+            except (OSError, http.client.HTTPException) as e:
+                # upstream died MID-stream: the request cannot be
+                # replayed (tokens already delivered) — terminate
+                # with a structured error line the client can parse
+                self._drop_conn(addr)
+                self._mark_down(addr)
+                self._finish_stream(handler, error=(
+                    f"replica {addr} died mid-stream: {e}"))
+                return "upstream_died"
+            if not line:
+                break
+            last = line
+            try:
+                self._relay_chunk(handler, line)
+            except OSError as e:
+                self._drop_conn(addr)
+                raise _StreamAborted(str(e)) from e
+        try:
+            handler.wfile.write(b"0\r\n\r\n")
+            handler.wfile.flush()
+        except OSError as e:
+            raise _StreamAborted(str(e)) from e
+        # a replica-side failure (scheduler crash, stall) ends the
+        # stream CLEANLY with an {"error": ..., "done": true} tail
+        # — one JSON parse of the final line keeps that out of the
+        # success metrics without re-encoding the relayed body
+        if b'"error"' in last:
+            try:
+                if json.loads(last).get("error"):
+                    return "upstream_error"
+            except ValueError:
+                pass
+        return "ok"
+
+    @staticmethod
+    def _resume_body(sess, raw):
+        """The request body for one attempt: the caller's bytes
+        verbatim until a resume happens, then a rebuilt re-prefill
+        request — the original prompt plus every token already
+        delivered downstream, with ``resume_from`` so the survivor
+        numbers its continuation exactly where the dead owner
+        stopped."""
+        if not sess["resumed"]:
+            return raw
+        delivered = sess["resume_from0"] + len(sess["tokens"])
+        p = {"prompt": sess["prompt"] + sess["tokens"],
+             "max_new_tokens": sess["max_new"] - len(sess["tokens"]),
+             "resume_from": delivered,
+             "stream": sess["stream"],
+             "session_id": sess["sid"]}
+        if sess["eos_id"] is not None:
+            p["eos_id"] = sess["eos_id"]
+        return json.dumps(p).encode()
+
+    @staticmethod
+    def _ensure_stream_headers(handler, sess, request_id, ctype):
+        """Send the downstream 200 + chunked headers exactly once per
+        CLIENT response, even when the upstream relay fails over
+        mid-stream (the spliced continuation rides the same
+        response)."""
+        if sess["sent_headers"]:
+            return
+        handler.send_response(200)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Transfer-Encoding", "chunked")
+        if request_id:
+            handler.send_header("X-Request-Id", request_id)
+        handler.end_headers()
+        sess["sent_headers"] = True
+
+    def _synthesize_done(self, handler, sess, reason="length"):
+        """Every budgeted token reached the client but the owner died
+        before its done tail: the router KNOWS the stream is complete,
+        so it synthesizes the terminal event instead of burning a
+        resume that would be rejected for an empty budget."""
+        delivered = sess["resume_from0"] + len(sess["tokens"])
+        line = (json.dumps({"done": True, "finish_reason": reason,
+                            "tokens": delivered,
+                            "token_index": delivered}) + "\n").encode()
+        try:
+            self._relay_chunk(handler, line)
+            handler.wfile.write(b"0\r\n\r\n")
+            handler.wfile.flush()
+        except OSError:
+            handler.close_connection = True
+        self.sessions.finish(sess["sid"])
+
     @staticmethod
     def _relay_chunk(handler, line):
         handler.wfile.write(b"%x\r\n" % len(line) + line + b"\r\n")
         handler.wfile.flush()
 
-    def _finish_stream(self, handler, error):
+    def _finish_stream(self, handler, error, etype="upstream_died",
+                       token_index=None, retryable=True):
+        """Terminate an already-started chunked relay with a structured
+        error tail.  New tails carry the ``token_index`` high-water
+        mark plus a top-level ``retryable`` flag so resuming clients
+        know exactly where the stream stopped; legacy tails (neither
+        field) must keep parsing — the protocol regression test holds
+        both shapes against the schema."""
+        obj = {"error": {"type": etype, "message": error},
+               "done": True, "retryable": bool(retryable)}
+        if token_index is not None:
+            obj["token_index"] = int(token_index)
         try:
-            line = (json.dumps(
-                {"error": {"type": "upstream_died", "message": error},
-                 "done": True}) + "\n").encode()
+            line = (json.dumps(obj) + "\n").encode()
             self._relay_chunk(handler, line)
             handler.wfile.write(b"0\r\n\r\n")
             handler.wfile.flush()

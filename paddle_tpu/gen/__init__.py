@@ -10,6 +10,8 @@ MicroBatcher`.  HTTP streaming lives in ``paddle_tpu/serving.py``
 ``paddle_tpu/fleet/router.py``."""
 
 from paddle_tpu.gen.predictor import GenPredictor, is_gen_bundle
-from paddle_tpu.gen.scheduler import GenScheduler, GenStream
+from paddle_tpu.gen.scheduler import GenScheduler, GenStream, \
+    SchedulerDraining, StreamMigrated
 
-__all__ = ["GenPredictor", "GenScheduler", "GenStream", "is_gen_bundle"]
+__all__ = ["GenPredictor", "GenScheduler", "GenStream",
+           "SchedulerDraining", "StreamMigrated", "is_gen_bundle"]

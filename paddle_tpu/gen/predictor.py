@@ -319,6 +319,16 @@ class GenPredictor:
         return {"gen_ids": ids, "gen_pos": pos, "gen_mask": mask,
                 "gen_attn_bias": bias.astype(np.float32), "gen_last": last}
 
+    def can_resume(self, total_len):
+        """True when a resumed stream of ``total_len`` tokens (original
+        prompt + every token already emitted) still fits a prefill
+        bucket — the admissibility gate for deterministic re-prefill
+        failover.  A stream that has decoded past ``max_prompt_len``
+        cannot be re-prefilled on this bundle (the serving handler
+        replies a non-retryable ``resume_unsupported`` rather than a
+        confusing prompt-length 400)."""
+        return 0 < int(total_len) <= self.max_prompt_len
+
     def prefill(self, prompt):
         """Run one prompt (list/array of token ids); returns
         ``(logits [V], kv)`` where ``kv`` is the per-layer masked K/V

@@ -36,7 +36,24 @@ from paddle_tpu.obs.trace import span as _span
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["GenScheduler", "GenStream"]
+__all__ = ["GenScheduler", "GenStream", "SchedulerDraining",
+           "StreamMigrated"]
+
+
+class SchedulerDraining(RuntimeError):
+    """The scheduler stopped admitting new sessions (rolling-restart
+    drain): retryable by contract — a sibling replica will take the
+    request."""
+
+
+class StreamMigrated(RuntimeError):
+    """A locally-iterated stream was checkpoint-migrated at a token
+    boundary (drain-time hand-back); ``.checkpoint`` holds everything a
+    survivor needs to continue token-identically."""
+
+    def __init__(self, checkpoint):
+        super().__init__("stream checkpoint-migrated at token boundary")
+        self.checkpoint = checkpoint
 
 
 class GenStream:
@@ -105,6 +122,8 @@ class GenStream:
                 yield value
             elif kind == "done":
                 return
+            elif kind == "migrate":
+                raise StreamMigrated(value)
             else:
                 raise value
 
@@ -173,6 +192,21 @@ class GenScheduler:
         self._closed = False
         self._restarts = 0
         self._failed = None
+        # drain-time migration (rolling restarts): _draining rejects
+        # new admissions; _migrate_req asks the scheduler thread to
+        # checkpoint every remaining stream at the next token boundary
+        # (between decode iterations — the only place a stream is
+        # guaranteed whole-token); _abort_exc is the in-process
+        # hard-kill analog (fail everything retryable, no checkpoint)
+        self._draining = False
+        self._migrate_req = False
+        self._migrate_done = None
+        self._abort_exc = None
+        # streams popped from _queue but not yet seated in _slots
+        # (prefill in flight): drain()'s all-idle check must count
+        # these or it can declare the scheduler empty mid-admission
+        self._admitting = 0
+        self.migrated = []        # checkpoints handed back by drain()
         self._thread = self._spawn_thread()
 
     # -- public surface ----------------------------------------------------
@@ -225,6 +259,9 @@ class GenScheduler:
         with self._cv:
             if self._closed:
                 raise RuntimeError("generation scheduler is shut down")
+            if self._draining:
+                raise SchedulerDraining(
+                    "replica is draining: not admitting new sessions")
             if self._failed is not None:
                 raise BatcherCrashed(
                     f"generation scheduler is down after "
@@ -242,6 +279,57 @@ class GenScheduler:
             self._closed = True
             self._cv.notify_all()
         self._thread.join(timeout=10)
+
+    def drain(self, deadline_s=None):
+        """Stop admitting new sessions, await the live ones to natural
+        completion for up to ``deadline_s`` seconds (None = unbounded),
+        then checkpoint-migrate whatever remains at the next token
+        boundary.  Returns the list of checkpoints handed back (empty
+        when every stream finished inside the deadline) — each one is
+        ``{"prompt", "tokens", "remaining_tokens", "eos_id",
+        "reason"}``, everything a survivor replica needs to continue
+        the stream token-identically via deterministic re-prefill.
+
+        A length-cap decode used to be able to hold a rolling restart
+        open for minutes; with a deadline it costs at most
+        ``deadline_s`` plus one decode iteration."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        deadline_at = None if deadline_s is None \
+            else time.monotonic() + float(deadline_s)
+        while True:
+            with self._cv:
+                if (not self._queue and not self._slots and
+                        not self._admitting) or \
+                        self._closed or self._failed is not None:
+                    return list(self.migrated)
+            if deadline_at is not None and \
+                    time.monotonic() >= deadline_at:
+                break
+            time.sleep(0.005)
+        done = threading.Event()
+        with self._cv:
+            self._migrate_done = done
+            self._migrate_req = True
+            self._cv.notify_all()
+        done.wait(timeout=30.0)
+        return list(self.migrated)
+
+    def abort_streams(self, exc=None):
+        """In-process hard-kill support: ask the scheduler thread to
+        fail every queued and active stream with a RETRYABLE error at
+        the next token boundary — what a real ``kill -9`` looks like to
+        a resume-capable client, minus the socket corpse.  Returns
+        immediately (the kill is asynchronous, like a crash)."""
+        if exc is None:
+            from paddle_tpu.serving import BatcherCrashed
+            exc = BatcherCrashed(
+                "replica hard-killed mid-decode; stream aborted — "
+                "resume on a survivor")
+        with self._cv:
+            self._abort_exc = exc
+            self._cv.notify_all()
 
     # -- scheduler thread --------------------------------------------------
     def _spawn_thread(self):
@@ -292,12 +380,19 @@ class GenScheduler:
         while True:
             with self._cv:
                 while not self._queue and not self._slots and \
-                        not self._closed:
+                        not self._closed and self._abort_exc is None \
+                        and not self._migrate_req:
                     self._cv.wait(0.05)
                 if self._closed:
                     queued, self._queue = self._queue, []
                     active, self._slots = list(self._slots.items()), {}
                     break
+            # kill/migrate run HERE — between decode iterations, the
+            # only point every live stream is at a whole-token boundary
+            if self._abort_exc is not None:
+                self._do_abort()
+            if self._migrate_req:
+                self._do_migrate()
             self._sweep_queue()
             self._admit()
             if self._slots:
@@ -319,6 +414,65 @@ class GenScheduler:
             slot.stream.fail(err)
         for stream in queued:
             stream.fail(err)
+
+    def _do_abort(self):
+        """Scheduler-thread half of :meth:`abort_streams`: wholesale
+        reset (slots, free list, page pool), every stream failed with
+        the retryable kill error."""
+        with self._cv:
+            exc, self._abort_exc = self._abort_exc, None
+            queued, self._queue = self._queue, []
+            active, self._slots = list(self._slots.values()), {}
+            self._free = list(range(self.predictor.num_slots))
+        if getattr(self.predictor, "paged", False):
+            self.predictor.free_all_pages()
+        for slot in active:
+            slot.stream.fail(exc)
+        for stream in queued:
+            stream.fail(exc)
+
+    def _do_migrate(self):
+        """Scheduler-thread half of :meth:`drain`'s expiry path:
+        checkpoint every remaining stream at its current token boundary
+        and hand it back as a ``("migrate", checkpoint)`` event, then
+        release the slot/pages.  Queued (never-admitted) streams
+        migrate with zero emitted tokens."""
+        with self._cv:
+            queued, self._queue = self._queue, []
+            active = sorted(self._slots.items())
+        for idx, slot in active:
+            if not slot.stream.cancelled:
+                self._checkpoint_out(slot.stream)
+            else:
+                slot.stream.finish("disconnect")
+            if getattr(self.predictor, "paged", False):
+                self.predictor.free_slot_pages(idx)
+            with self._cv:
+                self._slots.pop(idx, None)
+                self._free.append(idx)
+        for stream in queued:
+            if not stream.cancelled:
+                self._checkpoint_out(stream)
+            else:
+                stream.finish("disconnect")
+        with self._cv:
+            self._migrate_req = False
+            done, self._migrate_done = self._migrate_done, None
+        if done is not None:
+            done.set()
+
+    def _checkpoint_out(self, stream):
+        from paddle_tpu import profiler as _profiler
+        ckpt = {"prompt": list(stream.prompt),
+                "tokens": list(stream.tokens),
+                "remaining_tokens": max(
+                    0, stream.max_new_tokens - len(stream.tokens)),
+                "eos_id": stream.eos_id,
+                "reason": "draining"}
+        self.migrated.append(ckpt)
+        _profiler.runtime_metrics.inc("gen.session.migrations")
+        stream.finish_reason = "migrated"
+        stream._push(("migrate", ckpt))
 
     def _sweep_queue(self):
         """Fail expired/abandoned QUEUED requests immediately — an
@@ -385,6 +539,7 @@ class GenScheduler:
                         return
                 stream = self._queue.pop(0)
                 slot_idx = self._free.pop(0)
+                self._admitting += 1
             if self.prefill_budget is not None:
                 cost = self.predictor.prefill_cost(len(stream.prompt))
                 spent += cost
@@ -395,8 +550,9 @@ class GenScheduler:
             try:
                 admitted = self._prefill_into(slot_idx, stream)
             finally:
-                if not admitted:
-                    with self._cv:
+                with self._cv:
+                    self._admitting -= 1
+                    if not admitted:
                         self._free.append(slot_idx)
 
     def _prefill_into(self, slot_idx, stream):

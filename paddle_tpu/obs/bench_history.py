@@ -90,6 +90,16 @@ BENCH_METRICS = {
                   "scale_ups": ("higher", 0.50),
                   "lost_accepted": ("max_abs", 0.0),
                   "sheds_without_retry_after": ("max_abs", 0.0)},
+    # ISSUE-20 resumable-session gate: the kill-owner chaos drill must
+    # lose/duplicate ZERO tokens and error ZERO streams (exactly-once
+    # delivery is an invariant, not a tolerance), the worst
+    # failover-induced token gap must stay bounded, and a resumed
+    # stream may not cost more than the band over an unkilled one
+    "gen_failover": {"ttft_after_failover_ms": ("lower", 0.75),
+                     "resume_overhead_ratio": ("lower", 0.50),
+                     "lost_tokens": ("max_abs", 0.0),
+                     "dup_tokens": ("max_abs", 0.0),
+                     "client_errors": ("max_abs", 0.0)},
     "train_transformer": {"tokens_per_sec_per_chip": ("higher", 0.10),
                           "mfu": ("higher", 0.05),
                           # measured (cost-analysis-based) MFU from the
@@ -316,6 +326,16 @@ def summary_metrics(bench, summary):
                     summary["kill_drill"]["traffic"]["lost_accepted"],
                 "sheds_without_retry_after":
                     summary["sheds_without_retry_after"]}
+    if bench == "gen_failover":
+        kill = summary["kill_drill"]
+        return {"ttft_after_failover_ms": kill["ttft_after_failover_ms"],
+                "resume_overhead_ratio":
+                    summary["resume_overhead_ratio"],
+                "lost_tokens": kill["lost_tokens"],
+                "dup_tokens": kill["dup_tokens"],
+                "client_errors": (kill["client_errors"]
+                                  + summary["drain_drill"]
+                                  ["client_errors"])}
     if bench == "train_transformer":
         out = {"tokens_per_sec_per_chip":
                summary["tokens_per_sec_per_chip"],
@@ -327,7 +347,7 @@ def summary_metrics(bench, summary):
     raise ValueError(f"no trajectory extraction for bench {bench!r} "
                      f"(known: serving, datapipe, fleet, decode, paged, "
                      f"elastic, embedding, compile, train_transformer, "
-                     f"autoscale)")
+                     f"autoscale, gen_failover)")
 
 
 def add_record_args(parser):

@@ -989,20 +989,62 @@ class InferenceServer:
                     max_new = int(req.get("max_new_tokens", 16))
                     eos_id = req.get("eos_id")
                     do_stream = bool(req.get("stream", True))
+                    # resumable sessions: resume_from=k means the
+                    # prompt already carries the original prompt plus
+                    # the k tokens the client holds — event indices
+                    # continue at k, so the splice stays monotone and
+                    # duplicate-free across replicas
+                    resume_from = int(req.get("resume_from", 0) or 0)
+                    if resume_from < 0:
+                        raise ValueError(
+                            f"resume_from must be >= 0, "
+                            f"got {resume_from}")
                 except (ValueError, KeyError, TypeError) as e:
                     self._error(400, "bad_request", str(e),
                                 retryable=False)
                     return
+                if resume_from > 0:
+                    predictor = server.gen_predictor
+                    eff_eos = predictor.eos_id if eos_id is None \
+                        else int(eos_id)
+                    try:
+                        tail_tok = int(prompt[-1]) if prompt else None
+                    except (TypeError, ValueError):
+                        tail_tok = None
+                    if tail_tok is not None and tail_tok == eff_eos:
+                        # the owner died AFTER emitting EOS but before
+                        # the done tail: nothing left to decode — a
+                        # re-prefill here would invent tokens past EOS,
+                        # so synthesize the terminal tail instead
+                        self._finish_resumed_eos(do_stream, resume_from)
+                        return
+                    if hasattr(predictor, "can_resume") and \
+                            not predictor.can_resume(len(prompt)):
+                        self._error(400, "resume_unsupported",
+                                    f"resumed sequence of {len(prompt)} "
+                                    f"tokens exceeds this bundle's max "
+                                    f"prompt length "
+                                    f"{predictor.max_prompt_len}",
+                                    retryable=False)
+                        return
                 with _trace.trace_context(self._request_id), \
                         _span("gen.request",
                               request_id=self._request_id,
                               path=self.path, port=server.addr[1]):
+                    from paddle_tpu.gen import SchedulerDraining
                     try:
                         stream = gen.submit(prompt, max_new_tokens=max_new,
                                             deadline=budget, eos_id=eos_id,
                                             timeout=timeout)
                     except QueueFull as e:
                         self._error(503, "overloaded", str(e),
+                                    retryable=True)
+                        return
+                    except SchedulerDraining as e:
+                        # rolling restart in progress: retryable 503 —
+                        # the router (or resume-capable client) places
+                        # the session on a sibling replica
+                        self._error(503, "draining", str(e),
                                     retryable=True)
                         return
                     except BatcherCrashed as e:
@@ -1035,8 +1077,18 @@ class InferenceServer:
                     if first[0] == "error":
                         self._gen_error(first[1])
                         return
+                    if first[0] == "migrate":
+                        # drained while still queued: zero tokens were
+                        # produced, so a plain retryable 503 IS the
+                        # resume (no splice state to carry)
+                        self._error(503, "draining",
+                                    "replica is draining: session "
+                                    "migrated before first token",
+                                    retryable=True)
+                        return
                     if not do_stream:
-                        self._generate_buffered(stream, first)
+                        self._generate_buffered(stream, first,
+                                                resume_from)
                         return
                     self.send_response(200)
                     self.send_header("Content-Type",
@@ -1046,8 +1098,11 @@ class InferenceServer:
                         self.send_header("X-Request-Id", self._request_id)
                     self.end_headers()
                     try:
-                        self._write_chunk({"token": first[1], "index": 0})
-                        index = 1
+                        # indices continue at resume_from: the monotone
+                        # token_index the router/client dedupe on
+                        self._write_chunk({"token": first[1],
+                                           "index": resume_from})
+                        index = resume_from + 1
                         while True:
                             ev = stream.next_event(timeout=300)
                             if ev is None:
@@ -1057,7 +1112,9 @@ class InferenceServer:
                                 self._write_chunk(
                                     {"error": {"type": "stalled",
                                                "message": "generation "
-                                               "stalled"}, "done": True})
+                                               "stalled"}, "done": True,
+                                     "token_index": index,
+                                     "retryable": True})
                                 break
                             kind, value = ev
                             if kind == "token":
@@ -1068,14 +1125,34 @@ class InferenceServer:
                                 self._write_chunk(
                                     {"done": True,
                                      "finish_reason": value,
-                                     "tokens": len(stream.tokens)})
+                                     "tokens": resume_from
+                                     + len(stream.tokens),
+                                     "token_index": resume_from
+                                     + len(stream.tokens)})
+                                break
+                            elif kind == "migrate":
+                                # drain-time hand-back at a token
+                                # boundary: the router (or a resume-
+                                # capable client) re-places the session
+                                # on a survivor from exactly this index
+                                self._write_chunk(
+                                    {"migrate": {
+                                        "resume_from": index,
+                                        "remaining_tokens": value[
+                                            "remaining_tokens"]},
+                                     "done": True,
+                                     "token_index": index,
+                                     "retryable": True})
                                 break
                             else:
                                 self._write_chunk(
                                     {"error": {
                                         "type": type(value).__name__,
                                         "message": str(value)},
-                                     "done": True})
+                                     "done": True,
+                                     "token_index": index,
+                                     "retryable":
+                                         self._gen_retryable(value)})
                                 break
                         self.wfile.write(b"0\r\n\r\n")
                     except (OSError, chaos.FaultInjected):
@@ -1086,7 +1163,7 @@ class InferenceServer:
                         stream.cancel()
                         self.close_connection = True
 
-            def _generate_buffered(self, stream, first):
+            def _generate_buffered(self, stream, first, resume_from=0):
                 """stream=false: collect the full generation and reply
                 with a normal Content-Length body."""
                 tokens = [first[1]]
@@ -1102,15 +1179,67 @@ class InferenceServer:
                     elif kind == "done":
                         self._reply(200, {"tokens": tokens,
                                           "finish_reason": value,
-                                          "done": True})
+                                          "done": True,
+                                          "token_index": resume_from
+                                          + len(tokens)})
+                        return
+                    elif kind == "migrate":
+                        # buffered callers hold no partial state, so a
+                        # retryable 503 re-runs the whole request on a
+                        # survivor (greedy decode: same tokens)
+                        self._error(503, "draining",
+                                    "replica is draining: session "
+                                    "migrated mid-generation",
+                                    retryable=True)
                         return
                     else:
                         self._gen_error(value)
                         return
 
+            def _finish_resumed_eos(self, do_stream, resume_from):
+                """A resume whose prompt already ends in EOS: the owner
+                died between emitting EOS and the done tail — reply the
+                terminal tail directly instead of re-prefilling past
+                end-of-sequence."""
+                if not do_stream:
+                    self._reply(200, {"tokens": [],
+                                      "finish_reason": "eos",
+                                      "done": True,
+                                      "token_index": resume_from})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                if self._request_id:
+                    self.send_header("X-Request-Id", self._request_id)
+                self.end_headers()
+                try:
+                    self._write_chunk({"done": True,
+                                       "finish_reason": "eos",
+                                       "tokens": resume_from,
+                                       "token_index": resume_from})
+                    self.wfile.write(b"0\r\n\r\n")
+                except (OSError, chaos.FaultInjected):
+                    self.close_connection = True
+
+            @staticmethod
+            def _gen_retryable(exc):
+                """Whether a mid-stream failure is safe to resume via
+                re-prefill on a sibling replica (the tail's top-level
+                ``retryable`` flag)."""
+                from paddle_tpu.gen import SchedulerDraining
+                return isinstance(exc, (DeadlineExceeded, QueueFull,
+                                        BatcherCrashed,
+                                        SchedulerDraining,
+                                        ConnectionError))
+
             def _gen_error(self, exc):
+                from paddle_tpu.gen import SchedulerDraining
                 if isinstance(exc, DeadlineExceeded):
                     self._error(504, "deadline_exceeded", str(exc),
+                                retryable=True)
+                elif isinstance(exc, SchedulerDraining):
+                    self._error(503, "draining", str(exc),
                                 retryable=True)
                 elif isinstance(exc, QueueFull):
                     self._error(503, "overloaded", str(exc),
@@ -1161,6 +1290,25 @@ class InferenceServer:
         t = threading.Thread(target=self._server.serve_forever, daemon=True)
         t.start()
         return t
+
+    def drain_sessions(self, deadline_s=None):
+        """Rolling-restart half-step: stop admitting new generative
+        sessions, await live streams to natural completion for up to
+        ``deadline_s`` seconds, then checkpoint-migrate the remainder
+        at a token boundary (the handlers flush ``migrate`` tails to
+        their still-open connections).  Returns the checkpoints handed
+        back; a no-op (empty list) for non-generation bundles.  Call
+        BEFORE :meth:`shutdown` so the tails reach the wire."""
+        if self._gen is None:
+            return []
+        return self._gen.drain(deadline_s)
+
+    def abort_streams(self):
+        """In-process hard-kill support (chaos drills): fail every live
+        generative stream with a retryable error, as an abruptly killed
+        replica would.  No-op for non-generation bundles."""
+        if self._gen is not None:
+            self._gen.abort_streams()
 
     def shutdown(self):
         # stop accepting FIRST: closing the batcher while handlers are
@@ -1391,7 +1539,8 @@ class ServingClient:
                 for o, dt in zip(resp["outputs"], dtypes)]
 
     def generate(self, prompt, max_new_tokens=16, eos_id=None,
-                 stream=True, retry=True):
+                 stream=True, retry=True, session_id=None, resume=True,
+                 max_resumes=8):
         """Stream a generation from ``/generate``: returns an iterator
         of parsed ndjson events — ``{"token": id, "index": i}`` per
         produced token, then ``{"done": true, "finish_reason": ...}``
@@ -1401,22 +1550,43 @@ class ServingClient:
 
         Pre-stream failures (connection errors, retryable 503/504
         replies) retry/fail over under the client's policy like
-        ``predict``; once streaming has begun the request is NOT
-        replayed — a mid-stream failure surfaces as an error event."""
+        ``predict``.  MID-stream failures are resumable (``resume=``,
+        the router-less failover path): on a dead socket, a torn
+        chunk, a retryable error tail, or a drain-time ``migrate``
+        tail, the client re-submits ``prompt + tokens_so_far`` with a
+        ``resume_from`` index to a (preferably different) replica and
+        splices the continuation, deduplicating on each event's
+        monotone ``token_index`` — greedy decode is deterministic, so
+        the client-visible sequence is identical to an unbroken
+        stream.  A NON-retryable mid-stream failure (or ``resume=
+        False``, or ``max_resumes`` exhausted) surfaces as the
+        documented terminal error event, never as a raw exception out
+        of the iterator."""
         import http.client
         from paddle_tpu.fault.retry import RetryError, parse_hostport
 
         rid = _trace.current_trace_id() or _trace.new_trace_id()
-        payload = {"prompt": [int(t) for t in prompt],
-                   "max_new_tokens": int(max_new_tokens),
-                   "stream": bool(stream)}
-        if eos_id is not None:
-            payload["eos_id"] = int(eos_id)
-        body = json.dumps(payload).encode()
+        if session_id is None:
+            from paddle_tpu.fleet.sessions import new_session_id
+            session_id = new_session_id()
+        orig_prompt = [int(t) for t in prompt]
+        max_new = int(max_new_tokens)
+        toks = []       # tokens delivered to the caller so far
         history = []
         hints = {}      # attempt index -> Retry-After seconds
         deadline_at = None if self._deadline is None \
             else time.monotonic() + self._deadline
+
+        def payload():
+            p = {"prompt": orig_prompt + toks,
+                 "max_new_tokens": max_new - len(toks),
+                 "stream": bool(stream),
+                 "session_id": session_id}
+            if toks:
+                p["resume_from"] = len(toks)
+            if eos_id is not None:
+                p["eos_id"] = int(eos_id)
+            return p
 
         def attempt():
             from paddle_tpu.fault.retry import parse_retry_after
@@ -1430,6 +1600,7 @@ class ServingClient:
                 remaining = max(deadline_at - time.monotonic(), 0.001)
                 headers["X-Deadline-Ms"] = str(int(remaining * 1000) or 1)
                 timeout = min(timeout, remaining)
+            body = json.dumps(payload()).encode()
             conn = http.client.HTTPConnection(host, port, timeout=timeout)
             try:
                 conn.request("POST", "/generate", body, headers)
@@ -1460,37 +1631,100 @@ class ServingClient:
                                    retryable=False)
             return conn, resp
 
-        try:
+        def connect():
             if retry:
-                conn, resp = self._retry.call(attempt,
-                                              deadline=self._deadline)
-            else:
-                conn, resp = attempt()
+                return self._retry.call(attempt,
+                                        deadline=self._deadline)
+            return attempt()
+
+        try:
+            conn, resp = connect()
         except RetryError as e:
             e.history = _history_with_hints(history, hints)
             raise
 
         def events():
             import http.client
+
+            from paddle_tpu import profiler as _profiler
+            nonlocal conn, resp
+            resumes = 0
+            resumable = bool(resume) and stream
             try:
                 while True:
+                    failure = None
+                    obj = None
                     try:
                         line = resp.readline()
                         if not line:
-                            return
-                        obj = json.loads(line)
+                            if not resumable:
+                                return      # legacy: silent clean EOF
+                            failure = ConnectionError(
+                                "stream closed without a terminal "
+                                "event")
+                        else:
+                            obj = json.loads(line)
                     except (OSError, http.client.HTTPException,
                             ValueError) as e:
-                        # the documented mid-stream contract: failures
-                        # surface as a terminal error EVENT, never as a
-                        # raw exception out of the iterator
+                        failure = e
+                    if failure is None:
+                        if "token" in obj and "index" in obj:
+                            idx = obj["index"]
+                            if idx < len(toks):
+                                # replayed prefix after a resume: the
+                                # exactly-once guarantee is THIS drop
+                                _profiler.runtime_metrics.inc(
+                                    "gen.session.dedup_drops")
+                                continue
+                            if idx == len(toks):
+                                toks.append(int(obj["token"]))
+                                yield obj
+                                continue
+                            # an index GAP means tokens were torn out
+                            # of the transport: resume from what we
+                            # actually hold
+                            failure = ConnectionError(
+                                f"token_index gap: got {idx}, "
+                                f"expected {len(toks)}")
+                        elif obj.get("done") and "migrate" in obj:
+                            failure = ConnectionError(
+                                "session migrated (replica draining)")
+                        elif obj.get("done") and obj.get("error") \
+                                and obj.get("retryable") and resumable:
+                            failure = ConnectionError(
+                                f"retryable mid-stream error tail: "
+                                f"{obj['error'].get('type')}")
+                        else:
+                            yield obj
+                            if obj.get("done"):
+                                return
+                            continue
+                    # a resumable fault: re-submit prompt + toks with
+                    # resume_from and splice the continuation
+                    if not resumable or resumes >= max_resumes:
+                        yield {"error": {"type": type(failure).__name__,
+                                         "message": str(failure)},
+                               "done": True,
+                               "token_index": len(toks),
+                               "retryable": True}
+                        return
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                    try:
+                        conn, resp = connect()
+                    except (RetryError, ServingError,
+                            ConnectionError) as e:
                         yield {"error": {"type": type(e).__name__,
                                          "message": str(e)},
-                               "done": True}
+                               "done": True,
+                               "token_index": len(toks),
+                               "retryable": not isinstance(
+                                   e, ServingError)}
                         return
-                    yield obj
-                    if obj.get("done"):
-                        return
+                    resumes += 1
+                    _profiler.runtime_metrics.inc("gen.session.resumes")
             finally:
                 conn.close()
 

@@ -1,0 +1,88 @@
+"""bench_gen_failover smoke: the kill-owner chaos drill must deliver
+every stream token-identical to the unkilled reference with zero lost,
+zero duplicated tokens and zero client errors — on EVERY attempt, at
+smoke scale (exactly-once delivery is an invariant, not a tolerance).
+BENCH_GEN_FAILOVER.json records the full acceptance run (3 replicas,
+6 concurrent streams)."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+import bench_gen_failover  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def smoke_summary():
+    return bench_gen_failover.run_bench(streams=3, replicas=2,
+                                        max_new=8, stall_ms=25.0,
+                                        kill_after=2)
+
+
+def test_summary_schema(smoke_summary):
+    assert {"streams", "replicas", "max_new_tokens", "stall_ms",
+            "reference", "kill_drill", "drain_drill",
+            "resume_overhead_ratio"} <= set(smoke_summary)
+    kill = smoke_summary["kill_drill"]
+    assert {"ttft_after_failover_ms", "lost_tokens", "dup_tokens",
+            "client_errors", "token_identical", "resumes",
+            "spliced_tokens", "killed_replica"} <= set(kill)
+
+
+def test_reference_run_is_clean(smoke_summary):
+    ref = smoke_summary["reference"]
+    assert ref["lost_tokens"] == 0
+    assert ref["dup_tokens"] == 0
+    assert ref["client_errors"] == 0
+
+
+def test_kill_drill_exactly_once(smoke_summary):
+    kill = smoke_summary["kill_drill"]
+    assert kill["lost_tokens"] == 0, kill
+    assert kill["dup_tokens"] == 0, kill
+    assert kill["client_errors"] == 0, kill
+    assert kill["token_identical"], kill
+    # the kill was survived BY resume, not by luck: at least one stream
+    # was re-prefilled on a survivor and its continuation spliced in
+    assert kill["resumes"] >= 1, kill
+    assert kill["spliced_tokens"] >= 1, kill
+    assert kill["ttft_after_failover_ms"] > 0, kill
+
+
+def test_drain_drill_migrates_without_errors(smoke_summary):
+    drain = smoke_summary["drain_drill"]
+    assert drain["client_errors"] == 0, drain
+    assert drain["lost_tokens"] == 0 and drain["dup_tokens"] == 0
+    assert drain["token_identical"], drain
+    assert drain["migrations"] >= 1, drain
+
+
+def test_trajectory_gate_wiring(smoke_summary, tmp_path):
+    """The smoke run's metrics flow through the shared recorder into a
+    trajectory `paddle_tpu bench check` accepts — and a run that loses
+    one token flips the gate to exit-1 (the zero-tolerance invariant
+    the trajectory enforces)."""
+    from paddle_tpu import cli
+    from paddle_tpu.obs import bench_history
+
+    path = str(tmp_path / "traj.json")
+    metrics = bench_history.summary_metrics("gen_failover",
+                                            smoke_summary)
+    assert metrics["lost_tokens"] == 0 and metrics["dup_tokens"] == 0
+    bench_history.record("gen_failover", metrics, path=path,
+                         baseline=True, source="test_bench_gen_failover")
+    bench_history.record("gen_failover", dict(metrics), path=path)
+    assert cli.main(["bench", "check", "--trajectory", path]) == 0
+    degraded = dict(metrics, lost_tokens=1)
+    bench_history.record("gen_failover", degraded, path=path)
+    assert cli.main(["bench", "check", "--trajectory", path]) == 1
+
+
+@pytest.mark.slow
+def test_acceptance_full_run():
+    summary = bench_gen_failover.run_bench()
+    kill = summary["kill_drill"]
+    assert kill["lost_tokens"] == 0 and kill["dup_tokens"] == 0
+    assert kill["client_errors"] == 0 and kill["token_identical"]

@@ -52,7 +52,7 @@ def test_selfcheck_sections_are_complete():
     assert {"zoo-lint", "zoo-distribute", "zoo-pipeline", "gen-bundle",
             "paged-kv", "embedding", "diagnostic-registry",
             "metric-registry", "failpoint-registry", "slo-spec",
-            "bench-trajectory", "perf", "ledger"} <= names
+            "bench-trajectory", "perf", "ledger", "sessions"} <= names
 
 
 def test_slo_spec_section_fails_on_malformed_env_spec(tmp_path,

@@ -1,0 +1,645 @@
+"""Resumable generative sessions: exactly-once token delivery across
+mid-stream replica death (router-side re-prefill + splice), drain-time
+checkpoint migration at token boundaries, client-side resume for
+router-less deployments, and the resume-protocol schema — the ISSUE-20
+failover stack end to end."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from paddle_tpu import profiler
+from paddle_tpu.fault import chaos
+from paddle_tpu.fleet import FleetRouter, SessionTable, \
+    validate_checkpoint, validate_stream_event
+from paddle_tpu.gen import GenPredictor, GenScheduler, \
+    SchedulerDraining, StreamMigrated
+from paddle_tpu.models import gen_lm
+from paddle_tpu.serving import InferenceServer, ServingClient
+
+import numpy as np
+
+
+@pytest.fixture(scope="module")
+def bundle_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("genlm_sess") / "bundle")
+    gen_lm.export_gen_model(d, gen_lm.GenConfig(), num_slots=4)
+    return d
+
+
+@pytest.fixture(scope="module")
+def predictor(bundle_dir):
+    p = GenPredictor(bundle_dir)
+    p.warmup()
+    return p
+
+
+def _server(bundle_dir, **kw):
+    kw.setdefault("warmup", True)
+    kw.setdefault("request_timeout", 30.0)
+    server = InferenceServer(bundle_dir, port=0, **kw)
+    server.start_background()
+    assert server.wait_until_ready(180)
+    return server
+
+
+def _addr(server):
+    return f"{server.addr[0]}:{server.addr[1]}"
+
+
+def _ref_greedy(predictor, prompt, n):
+    seq = list(prompt)
+    out = []
+    for _ in range(n):
+        logits, _ = predictor.prefill(seq)
+        t = int(np.argmax(logits))
+        out.append(t)
+        seq.append(t)
+    return out
+
+
+def _read_stream(host, port, payload, headers=None, timeout=60):
+    import http.client
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request("POST", "/generate", json.dumps(payload).encode(), hdrs)
+    resp = conn.getresponse()
+    if resp.status != 200:
+        body = json.loads(resp.read())
+        conn.close()
+        return resp.status, body, []
+    events, stamps = [], []
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        events.append(json.loads(line))
+        stamps.append(time.monotonic())
+        if events[-1].get("done"):
+            break
+    conn.close()
+    return 200, events, stamps
+
+
+def _counter(name):
+    return profiler.runtime_metrics.counter(name)
+
+
+# ---------------------------------------------------------------------------
+# session table + resume-protocol schema (no bundle needed)
+# ---------------------------------------------------------------------------
+
+class TestSessionTable:
+    def test_lru_eviction_counts_orphans(self):
+        t = SessionTable(capacity=3)
+        for i in range(5):
+            t.begin(f"s{i}", f"r{i}", [1, 2, 3], 8)
+        assert len(t) == 3
+        assert t.orphaned == 2
+        # the two OLDEST were evicted; the youngest three survive
+        assert t.owner("s0") is None and t.owner("s1") is None
+        assert t.owner("s4") == "r4"
+
+    def test_begin_retouches_lru_order(self):
+        t = SessionTable(capacity=2)
+        t.begin("a", "r1", [1], 4)
+        t.begin("b", "r1", [1], 4)
+        t.begin("a", "r2", [1], 4, delivered=3)   # resume: re-touch
+        t.begin("c", "r1", [1], 4)                # evicts b, not a
+        assert t.owner("a") == "r2"
+        assert t.owner("b") is None
+        assert t.lookup("a")["delivered"] == 3
+
+    def test_finish_evicts_without_orphan(self):
+        t = SessionTable(capacity=8)
+        t.begin("a", "r1", [1], 4)
+        entry = t.finish("a")
+        assert entry["done"] is True
+        assert len(t) == 0 and t.orphaned == 0
+        assert t.finish("a") is None
+
+    def test_note_updates_owner_and_delivered(self):
+        t = SessionTable()
+        t.begin("a", "r1", [1, 2], 8)
+        t.note("a", replica="r2", delivered=5)
+        e = t.lookup("a")
+        assert e["replica"] == "r2" and e["delivered"] == 5
+        assert t.note("missing") is None
+
+    def test_snapshot_shape(self):
+        t = SessionTable(capacity=4)
+        t.begin("a", "r1", [1, 2], 8, delivered=2)
+        snap = t.snapshot()
+        assert snap["count"] == 1 and snap["capacity"] == 4
+        assert snap["sessions"][0]["sid"] == "a"
+        assert snap["sessions"][0]["delivered"] == 2
+
+
+class TestResumeProtocolSchema:
+    def test_token_and_terminal_shapes_validate(self):
+        assert validate_stream_event({"token": 3, "index": 0}) == []
+        assert validate_stream_event(
+            {"done": True, "finish_reason": "eos", "tokens": 4,
+             "token_index": 4}) == []
+        assert validate_stream_event(
+            {"migrate": {"resume_from": 2, "remaining_tokens": 6},
+             "done": True, "token_index": 2, "retryable": True}) == []
+
+    def test_legacy_error_tail_still_parses(self):
+        """Satellite regression: the OLD terminal error tail — no
+        token_index, no top-level retryable — must keep validating, and
+        the new tail with both fields must too."""
+        legacy = {"error": {"type": "upstream_died", "message": "x"},
+                  "done": True}
+        new = {"error": {"type": "upstream_died", "message": "x"},
+               "done": True, "token_index": 7, "retryable": True}
+        assert validate_stream_event(legacy) == []
+        assert validate_stream_event(new) == []
+
+    def test_malformed_events_fail(self):
+        assert validate_stream_event({"token": 3})
+        assert validate_stream_event({"token": 3, "index": True})
+        assert validate_stream_event({"done": True})
+        assert validate_stream_event(
+            {"migrate": {"resume_from": 2}, "done": True})  # !retryable
+        assert validate_stream_event(
+            {"error": {"type": "x"}, "done": True,
+             "retryable": "yes"})
+
+    def test_checkpoint_schema(self):
+        good = {"prompt": [1, 2], "tokens": [3], "remaining_tokens": 4,
+                "eos_id": None, "reason": "draining"}
+        assert validate_checkpoint(good) == []
+        assert validate_checkpoint({"prompt": [], "tokens": [],
+                                    "remaining_tokens": 0,
+                                    "reason": "draining"})
+        assert validate_checkpoint({"prompt": [1], "tokens": [],
+                                    "remaining_tokens": -1,
+                                    "reason": "draining"})
+
+    def test_router_finish_stream_tail_carries_new_fields(self):
+        """The router's terminal error tail now includes the
+        ``token_index`` high-water mark and a top-level ``retryable``
+        flag, and the result round-trips the schema."""
+        import io
+
+        class _FakeHandler:
+            def __init__(self):
+                self.wfile = io.BytesIO()
+                self.close_connection = False
+
+        router = FleetRouter(replicas=["127.0.0.1:1"])
+        router.start_background()
+        try:
+            fake = _FakeHandler()
+            router._finish_stream(fake, error="owner died",
+                                  etype="upstream_died",
+                                  token_index=5, retryable=True)
+            line = fake.wfile.getvalue().split(b"\r\n")[1]
+            tail = json.loads(line)
+            assert tail["token_index"] == 5
+            assert tail["retryable"] is True
+            assert tail["error"]["type"] == "upstream_died"
+            assert validate_stream_event(tail) == []
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# client-side resume protocol against a scripted (model-free) server
+# ---------------------------------------------------------------------------
+
+def _tok(i):
+    return {"token": 100 + i, "index": i}
+
+
+def _done(n, reason="length"):
+    return {"done": True, "finish_reason": reason, "tokens": n,
+            "token_index": n}
+
+
+def _scripted_server(scripts):
+    """One scripted reply per expected request: stream ``events`` as
+    ndjson chunks, then either end the chunked body cleanly or (with
+    ``cut``) sever the socket mid-stream."""
+    received = []
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n))
+            received.append(req)
+            spec = scripts[min(len(received) - 1, len(scripts) - 1)]
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for ev in spec["events"]:
+                line = (json.dumps(ev) + "\n").encode()
+                self.wfile.write(b"%x\r\n" % len(line) + line + b"\r\n")
+                self.wfile.flush()
+            self.close_connection = True
+            if spec.get("cut"):
+                self.connection.close()
+                return
+            self.wfile.write(b"0\r\n\r\n")
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, received
+
+
+class TestClientResumeProtocol:
+    def _run(self, scripts, **gen_kw):
+        srv, received = _scripted_server(scripts)
+        try:
+            client = ServingClient(f"{srv.server_address[0]}:"
+                                   f"{srv.server_address[1]}")
+            gen_kw.setdefault("max_new_tokens", 5)
+            events = list(client.generate([1, 2], **gen_kw))
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        return events, received
+
+    def test_socket_cut_resumes_sequence_identical(self):
+        """Acceptance: the socket dying after k events yields a
+        client-visible sequence identical to an unbroken stream."""
+        base = _counter("gen.session.resumes")
+        events, received = self._run([
+            {"events": [_tok(0), _tok(1), _tok(2)], "cut": True},
+            {"events": [_tok(3), _tok(4), _done(5)]},
+        ])
+        toks = [e["token"] for e in events if "token" in e]
+        assert toks == [100, 101, 102, 103, 104]
+        assert events[-1]["done"] and events[-1]["finish_reason"] == \
+            "length"
+        assert not any(e.get("error") for e in events)
+        assert _counter("gen.session.resumes") == base + 1
+        # the resume request re-prefills prompt + delivered tokens
+        assert len(received) == 2
+        assert received[1]["prompt"] == [1, 2, 100, 101, 102]
+        assert received[1]["resume_from"] == 3
+        assert received[1]["max_new_tokens"] == 2
+        assert received[1]["session_id"] == received[0]["session_id"]
+
+    def test_duplicate_indices_are_dropped(self):
+        """Exactly-once: replayed token_index events never reach the
+        caller."""
+        base = _counter("gen.session.dedup_drops")
+        events, _ = self._run([
+            {"events": [_tok(0), _tok(1), _tok(2), _tok(1), _tok(2),
+                        _tok(3), _tok(4), _done(5)]},
+        ])
+        toks = [e["token"] for e in events if "token" in e]
+        assert toks == [100, 101, 102, 103, 104]
+        assert _counter("gen.session.dedup_drops") == base + 2
+
+    def test_retryable_error_tail_resumes(self):
+        events, received = self._run([
+            {"events": [_tok(0), _tok(1),
+                        {"error": {"type": "batcher_crashed",
+                                   "message": "aborted"},
+                         "done": True, "token_index": 2,
+                         "retryable": True}]},
+            {"events": [_tok(2), _tok(3), _tok(4), _done(5)]},
+        ])
+        toks = [e["token"] for e in events if "token" in e]
+        assert toks == [100, 101, 102, 103, 104]
+        assert not any(e.get("error") for e in events)
+        assert received[1]["resume_from"] == 2
+
+    def test_migrate_tail_resumes(self):
+        events, received = self._run([
+            {"events": [_tok(0),
+                        {"migrate": {"resume_from": 1,
+                                     "remaining_tokens": 4},
+                         "done": True, "token_index": 1,
+                         "retryable": True}]},
+            {"events": [_tok(1), _tok(2), _tok(3), _tok(4), _done(5)]},
+        ])
+        toks = [e["token"] for e in events if "token" in e]
+        assert toks == [100, 101, 102, 103, 104]
+        assert received[1]["resume_from"] == 1
+
+    def test_non_retryable_error_tail_surfaces_terminal(self):
+        """The documented contract survives: a non-retryable mid-stream
+        failure is a terminal error EVENT, not a resume and not an
+        exception."""
+        events, received = self._run([
+            {"events": [_tok(0),
+                        {"error": {"type": "bad_feed",
+                                   "message": "nope"},
+                         "done": True, "token_index": 1,
+                         "retryable": False}]},
+        ])
+        assert len(received) == 1          # no resume attempted
+        assert events[-1]["error"]["type"] == "bad_feed"
+        assert events[-1]["done"]
+
+    def test_legacy_error_tail_without_retryable_surfaces(self):
+        """Old replicas end failed streams with an error tail carrying
+        NEITHER token_index nor retryable — the client must surface it
+        unchanged, not guess at a resume."""
+        events, received = self._run([
+            {"events": [_tok(0),
+                        {"error": {"type": "upstream_died",
+                                   "message": "legacy"},
+                         "done": True}]},
+        ])
+        assert len(received) == 1
+        assert events[-1]["error"]["type"] == "upstream_died"
+
+    def test_resume_disabled_preserves_legacy_eof_behavior(self):
+        """With resume off, a severed stream ends exactly as it always
+        did — the delivered prefix, no reconnect, no synthesized
+        events."""
+        events, received = self._run([
+            {"events": [_tok(0), _tok(1)], "cut": True},
+        ], resume=False)
+        assert len(received) == 1
+        toks = [e["token"] for e in events if "token" in e]
+        assert toks == [100, 101]
+        assert not any(e.get("done") for e in events)
+
+
+# ---------------------------------------------------------------------------
+# router-side mid-stream failover (real bundle, chaos failpoints)
+# ---------------------------------------------------------------------------
+
+class TestRouterMidStreamFailover:
+    def _fleet(self, bundle_dir, n=2):
+        servers = [_server(bundle_dir) for _ in range(n)]
+        router = FleetRouter(replicas=[_addr(s) for s in servers])
+        router.start_background()
+        return servers, router
+
+    def test_kill_owner_mid_stream_token_identical(self, bundle_dir,
+                                                   predictor):
+        """Tentpole acceptance: the owner dies after its 4th produced
+        token; the stream completes on a survivor token-identical to an
+        unkilled reference — zero lost, zero duplicated."""
+        servers, router = self._fleet(bundle_dir)
+        chaos.inject("gen.decode.stall", delay=0.02)
+        chaos.inject("gen.session.kill_owner", error=True, times=1,
+                     after=3)
+        resumes = _counter("gen.session.resumes")
+        spliced = _counter("gen.session.spliced_tokens")
+        try:
+            status, events, _ = _read_stream(
+                router.addr[0], router.addr[1],
+                {"prompt": [2, 9], "max_new_tokens": 10})
+            assert status == 200
+            toks = [e["token"] for e in events if "token" in e]
+            idxs = [e["index"] for e in events if "token" in e]
+            assert idxs == list(range(10)), "lost or duplicated tokens"
+            assert toks == _ref_greedy(predictor, [2, 9], 10)
+            tail = events[-1]
+            assert tail["done"] and tail["finish_reason"] == "length"
+            assert tail["token_index"] == 10
+            assert _counter("gen.session.resumes") == resumes + 1
+            assert _counter("gen.session.spliced_tokens") == spliced + 7
+            # terminal delivery evicted the session
+            assert len(router.sessions) == 0
+        finally:
+            chaos.clear()
+            router.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_truncated_stream_resumes(self, bundle_dir, predictor):
+        """A torn transport (chunk boundary tear, no replica death)
+        rides the same resume path."""
+        servers, router = self._fleet(bundle_dir)
+        chaos.inject("gen.decode.stall", delay=0.02)
+        chaos.inject("gen.stream.truncate", error=True, times=1,
+                     after=2)
+        try:
+            status, events, _ = _read_stream(
+                router.addr[0], router.addr[1],
+                {"prompt": [5, 9, 3], "max_new_tokens": 8})
+            assert status == 200
+            toks = [e["token"] for e in events if "token" in e]
+            idxs = [e["index"] for e in events if "token" in e]
+            assert idxs == list(range(8))
+            assert toks == _ref_greedy(predictor, [5, 9, 3], 8)
+            assert events[-1]["finish_reason"] == "length"
+        finally:
+            chaos.clear()
+            router.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_replica_hard_kill_severs_and_resumes(self, bundle_dir,
+                                                  predictor):
+        """An in-process hard-kill (InferenceServer.abort_streams — the
+        scheduler-thread stream abort a SIGKILL implies) surfaces as a
+        retryable tail the router converts into a survivor resume."""
+        servers, router = self._fleet(bundle_dir)
+        chaos.inject("gen.decode.stall", delay=0.04)
+        got = {}
+
+        def consume():
+            got["result"] = _read_stream(
+                router.addr[0], router.addr[1],
+                {"prompt": [7, 1], "max_new_tokens": 10})
+
+        t = threading.Thread(target=consume)
+        try:
+            t.start()
+            # wait until the router has relayed a few tokens, then
+            # hard-kill the owning replica's streams
+            deadline = time.monotonic() + 20
+            owner = None
+            while time.monotonic() < deadline:
+                snap = router.sessions.snapshot()
+                if snap["sessions"] and \
+                        snap["sessions"][0]["delivered"] >= 2:
+                    owner = snap["sessions"][0]["replica"]
+                    break
+                time.sleep(0.01)
+            assert owner is not None, "stream never started"
+            victim = next(s for s in servers if _addr(s) == owner)
+            victim.abort_streams()
+            t.join(timeout=60)
+            assert not t.is_alive()
+            status, events, _ = got["result"]
+            assert status == 200
+            toks = [e["token"] for e in events if "token" in e]
+            idxs = [e["index"] for e in events if "token" in e]
+            assert idxs == list(range(10))
+            assert toks == _ref_greedy(predictor, [7, 1], 10)
+            assert events[-1]["finish_reason"] == "length"
+        finally:
+            chaos.clear()
+            t.join(timeout=5)
+            router.shutdown()
+            for s in servers:
+                s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# drain-time migration (scheduler, server, and through the router)
+# ---------------------------------------------------------------------------
+
+class TestDrainMigration:
+    def test_drain_waits_for_fast_streams(self, predictor):
+        sched = GenScheduler(predictor, queue_size=8)
+        try:
+            s = sched.submit([5], max_new_tokens=3)
+            ckpts = sched.drain(deadline_s=30.0)
+            assert ckpts == []
+            assert len(list(s)) == 3
+            assert s.finish_reason == "length"
+        finally:
+            sched.close()
+
+    def test_drain_rejects_new_sessions(self, predictor):
+        sched = GenScheduler(predictor, queue_size=8)
+        try:
+            sched.drain(deadline_s=1.0)
+            with pytest.raises(SchedulerDraining):
+                sched.submit([1], max_new_tokens=2)
+        finally:
+            sched.close()
+
+    def test_drain_deadline_checkpoints_slow_stream(self, predictor):
+        """Satellite regression: a deliberately slow stream cannot pin
+        the drain — on deadline expiry it is checkpointed at a token
+        boundary, and the checkpoint resumes token-identically on a
+        fresh scheduler."""
+        migrations = _counter("gen.session.migrations")
+        sched = GenScheduler(predictor, queue_size=8)
+        chaos.inject("gen.decode.stall", delay=0.05)
+        try:
+            s = sched.submit([3, 4], max_new_tokens=12)
+            assert s.next_event(timeout=30)[0] == "token"
+            t0 = time.monotonic()
+            ckpts = sched.drain(deadline_s=0.25)
+            # bounded: nowhere near the 12 * 0.05s full run + margin
+            assert time.monotonic() - t0 < 10.0
+            assert len(ckpts) == 1
+            ckpt = ckpts[0]
+            assert validate_checkpoint(ckpt) == []
+            assert ckpt["prompt"] == [3, 4]
+            assert len(ckpt["tokens"]) + ckpt["remaining_tokens"] == 12
+            assert 1 <= len(ckpt["tokens"]) < 12
+            assert _counter("gen.session.migrations") == migrations + 1
+            # the stream's consumer sees the hand-back, not an error
+            with pytest.raises(StreamMigrated) as ei:
+                for _ in s:
+                    pass
+            assert ei.value.checkpoint["prompt"] == [3, 4]
+        finally:
+            chaos.clear()
+            sched.close()
+        # resume the checkpoint on a survivor: token-identical to an
+        # undrained reference (greedy decode is deterministic)
+        survivor = GenScheduler(predictor, queue_size=8)
+        try:
+            cont = survivor.submit(ckpt["prompt"] + ckpt["tokens"],
+                                   max_new_tokens=ckpt
+                                   ["remaining_tokens"])
+            full = ckpt["tokens"] + list(cont)
+            assert full == _ref_greedy(predictor, [3, 4], 12)
+        finally:
+            survivor.close()
+
+    def test_rolling_restart_through_router_completes_stream(
+            self, bundle_dir, predictor):
+        """Tentpole acceptance: draining the owner mid-stream hands the
+        session back (migrate tail) and the router re-places it on the
+        surviving replica — the client sees one complete, error-free,
+        token-identical stream."""
+        servers = [_server(bundle_dir) for _ in range(2)]
+        router = FleetRouter(replicas=[_addr(s) for s in servers])
+        router.start_background()
+        chaos.inject("gen.decode.stall", delay=0.04)
+        got = {}
+
+        def consume():
+            got["result"] = _read_stream(
+                router.addr[0], router.addr[1],
+                {"prompt": [2, 9], "max_new_tokens": 10})
+
+        t = threading.Thread(target=consume)
+        try:
+            t.start()
+            deadline = time.monotonic() + 20
+            owner = None
+            while time.monotonic() < deadline:
+                snap = router.sessions.snapshot()
+                if snap["sessions"] and \
+                        snap["sessions"][0]["delivered"] >= 2:
+                    owner = snap["sessions"][0]["replica"]
+                    break
+                time.sleep(0.01)
+            assert owner is not None, "stream never started"
+            victim = next(s for s in servers if _addr(s) == owner)
+            # rolling restart: bound the drain so the active stream is
+            # checkpoint-migrated instead of awaited
+            ckpts = victim.drain_sessions(deadline_s=0.05)
+            assert len(ckpts) == 1
+            assert validate_checkpoint(ckpts[0]) == []
+            # a draining replica refuses NEW sessions retryably
+            host, port = victim.addr
+            status, body, _ = _read_stream(
+                host, port, {"prompt": [1], "max_new_tokens": 2})
+            assert status == 503
+            assert body["error"]["type"] == "draining"
+            assert body["retryable"] is True
+            t.join(timeout=60)
+            assert not t.is_alive()
+            status, events, _ = got["result"]
+            assert status == 200
+            toks = [e["token"] for e in events if "token" in e]
+            idxs = [e["index"] for e in events if "token" in e]
+            assert not any(e.get("error") for e in events)
+            assert idxs == list(range(10))
+            assert toks == _ref_greedy(predictor, [2, 9], 10)
+        finally:
+            chaos.clear()
+            t.join(timeout=5)
+            router.shutdown()
+            for s in servers:
+                s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# client resume against a real replica (router-less deployment)
+# ---------------------------------------------------------------------------
+
+class TestClientResumeIntegration:
+    def test_client_resumes_after_stream_abort(self, bundle_dir,
+                                               predictor):
+        """Router-less failover: the replica's streams are hard-aborted
+        mid-decode; ServingClient.generate re-prefills and the caller
+        sees the unbroken sequence."""
+        server = _server(bundle_dir)
+        chaos.inject("gen.decode.stall", delay=0.04)
+        try:
+            client = ServingClient(_addr(server))
+            it = client.generate([2, 9], max_new_tokens=10)
+            events = []
+            for ev in it:
+                events.append(ev)
+                if len([e for e in events if "token" in e]) == 3:
+                    server.abort_streams()
+            toks = [e["token"] for e in events if "token" in e]
+            idxs = [e["index"] for e in events if "token" in e]
+            assert idxs == list(range(10))
+            assert toks == _ref_greedy(predictor, [2, 9], 10)
+            assert events[-1]["done"]
+            assert not any(e.get("error") for e in events)
+        finally:
+            chaos.clear()
+            server.shutdown()
